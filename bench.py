@@ -1,0 +1,71 @@
+"""Benchmark: global placement solve latency at the BASELINE.json target tier.
+
+Measures p99 wall-clock of the full jitted solve (cost assembly + Sinkhorn +
+Gumbel/auction rounding) at 100k models x 1k instances on the available
+device, against the reference's serial Java janitor/reaper rebalance loop
+(>30 s at this scale — BASELINE.json north_star; ModelMesh.java:6526-6527
+documents ~10 min reaper passes in production).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = baseline_ms / measured_ms (higher is better; >1 beats ref).
+
+Env overrides (for the smaller BASELINE.json ladder tiers / CPU smoke):
+MM_BENCH_MODELS, MM_BENCH_INSTANCES, MM_BENCH_REPS, MM_BENCH_FORCE_CPU=1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("MM_BENCH_FORCE_CPU") == "1" or (
+    os.environ.get("JAX_PLATFORMS", "") == "cpu"
+):
+    jax.config.update("jax_platforms", "cpu")
+
+BASELINE_MS = 30_000.0  # reference serial rebalance loop @ 100k x 1k
+NUM_MODELS = int(os.environ.get("MM_BENCH_MODELS", 100_000))
+NUM_INSTANCES = int(os.environ.get("MM_BENCH_INSTANCES", 1_000))
+WARMUP = 2
+REPS = int(os.environ.get("MM_BENCH_REPS", 100))
+
+
+def main() -> None:
+    from modelmesh_tpu import ops
+
+    dev = jax.devices()[0]
+    problem = ops.random_problem(
+        jax.random.PRNGKey(0), NUM_MODELS, NUM_INSTANCES, capacity_slack=2.0
+    )
+    problem = jax.device_put(problem, dev)
+    jax.block_until_ready(problem)
+
+    solve = ops.solve_placement
+    for _ in range(WARMUP):
+        jax.block_until_ready(solve(problem))
+
+    times_ms = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(solve(problem))
+        times_ms.append((time.perf_counter() - t0) * 1e3)
+
+    import numpy as np
+
+    p99 = float(np.percentile(np.asarray(times_ms), 99))
+    result = {
+        "metric": f"global-rebalance p99 latency @ {NUM_MODELS//1000}k models x "
+        f"{NUM_INSTANCES} instances ({dev.platform})",
+        "value": round(p99, 3),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_MS / p99, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

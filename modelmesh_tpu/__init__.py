@@ -1,0 +1,35 @@
+"""modelmesh_tpu — a TPU-native model-serving management/routing framework.
+
+A brand-new implementation of the capabilities of kserve/modelmesh
+(reference: /root/reference, Java): a decentralized fleet of serving
+instances forming a distributed LRU cache of loaded models, coordinated
+through a shared KV registry, fronting model-runtime containers via a small
+gRPC SPI.
+
+Unlike the reference, every placement decision is factored behind the
+:class:`modelmesh_tpu.placement.PlacementStrategy` interface, whose flagship
+implementation solves the global model x instance assignment problem as a
+batched optimization in JAX on TPU (log-domain Sinkhorn + auction rounding,
+shard_map-sharded at the 1M-model scale).
+
+Package layout (mirrors SURVEY.md section 7 build plan):
+
+- ``ops/``       JAX kernels: cost assembly, Sinkhorn, auction rounding.
+- ``parallel/``  Device mesh helpers + sharded solver (shard_map/pjit).
+- ``placement/`` PlacementStrategy SPI, greedy reference-parity strategy,
+                 JAX global strategy.
+- ``cache/``     Weighted timestamped LRU (clhm equivalent,
+                 reference: src/main/java/com/ibm/watson/modelmesh/clhm/).
+- ``kv/``        Coordination substrate: KVStore, KVTable/TableView,
+                 SessionNode leases, LeaderElection, DynamicConfig
+                 (reference: com.ibm.watson.kvutils surface).
+- ``runtime/``   ModelRuntime gRPC SPI client + loaders
+                 (reference: model-runtime.proto, SidecarModelMesh.java).
+- ``serving/``   The instance core: cache-entry lifecycle, routing loops,
+                 autoscaling tasks, API server (reference: ModelMesh.java,
+                 ModelMeshApi.java).
+- ``models/``    Example TPU-served model families + solver cost models.
+- ``observability/``  Metrics facade, payload processors.
+"""
+
+__version__ = "0.1.0"

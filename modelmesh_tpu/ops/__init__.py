@@ -1,0 +1,29 @@
+"""JAX kernels for the global placement solver."""
+
+from modelmesh_tpu.ops.auction import MAX_COPIES, AuctionResult, auction
+from modelmesh_tpu.ops.costs import (
+    INFEASIBLE,
+    CostWeights,
+    PlacementProblem,
+    assemble_cost,
+    random_problem,
+)
+from modelmesh_tpu.ops.sinkhorn import SinkhornResult, plan_logits, sinkhorn
+from modelmesh_tpu.ops.solve import Placement, SolveConfig, solve_placement
+
+__all__ = [
+    "MAX_COPIES",
+    "AuctionResult",
+    "auction",
+    "INFEASIBLE",
+    "CostWeights",
+    "PlacementProblem",
+    "assemble_cost",
+    "random_problem",
+    "SinkhornResult",
+    "plan_logits",
+    "sinkhorn",
+    "Placement",
+    "SolveConfig",
+    "solve_placement",
+]

@@ -1,0 +1,143 @@
+"""Integral rounding of the Sinkhorn soft plan: Gumbel-top-k + price repair.
+
+Rounding strategy (all vectorized, one ``lax.scan``, no per-model loops):
+
+1. **Gumbel-top-k sampling.** The Sinkhorn plan logits define, per model, a
+   distribution over instances whose *column* marginals already respect
+   capacity shares. Adding Gumbel noise and taking the top-``copies`` per row
+   draws distinct instances approximately proportional to that distribution —
+   so the *expected* instance load already matches capacity. This is the key
+   de-herding device: deterministic argmax would send near-identical rows to
+   the same instance; sampling spreads them like the soft plan says to.
+
+2. **Price repair.** Residual sampling variance (and anything the soft plan
+   got wrong) is cleaned up by a few dozen rounds of congestion pricing:
+   instances above capacity raise their price, below-capacity prices decay,
+   with a diminishing step size so the dynamics anneal instead of limit-
+   cycling. Bertsekas-auction flavor, synchronous and batched.
+
+The result is *advisory*: per-instance local guards (churn age, unload buffer
+accounting — serving layer) remain authoritative, exactly as SURVEY.md
+section 7 "hard parts" #4 prescribes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Max copies of a single model the solver will place (reference scales copies
+# per request load; the per-round top-k width bounds it).
+MAX_COPIES: int = 8
+
+_NEG_INF = -1.0e9
+_JITTER_KEY = 0x5EED
+
+
+class AuctionResult(NamedTuple):
+    indices: jax.Array   # i32[N, MAX_COPIES] chosen instance per copy slot
+    valid: jax.Array     # bool[N, MAX_COPIES] slot is a real, feasible pick
+    load: jax.Array      # f32[M] implied memory load of the assignment
+    prices: jax.Array    # f32[M] final congestion prices
+    overflow: jax.Array  # f32[] sum of capacity overflow (diagnostic)
+
+
+def _select(scores_minus_price: jax.Array, copies: jax.Array):
+    """Top-MAX_COPIES per row + per-slot validity mask.
+
+    Clusters smaller than MAX_COPIES instances still return MAX_COPIES-wide
+    results (padded invalid) so output shapes are static.
+    """
+    k = min(MAX_COPIES, scores_minus_price.shape[1])
+    vals, idx = jax.lax.top_k(scores_minus_price, k)  # [N, k]
+    if k < MAX_COPIES:
+        pad = ((0, 0), (0, MAX_COPIES - k))
+        vals = jnp.pad(vals, pad, constant_values=_NEG_INF)
+        idx = jnp.pad(idx, pad)
+    slot = jnp.arange(MAX_COPIES, dtype=jnp.int32)[None, :]
+    valid = (slot < copies[:, None]) & (vals > _NEG_INF / 2)
+    return idx, valid
+
+
+def _implied_load(
+    idx: jax.Array, valid: jax.Array, sizes: jax.Array, num_instances: int
+) -> jax.Array:
+    contrib = sizes[:, None] * valid.astype(jnp.float32)  # [N, K]
+    return (
+        jnp.zeros((num_instances,), jnp.float32)
+        .at[idx.reshape(-1)]
+        .add(contrib.reshape(-1))
+    )
+
+
+def gumbel_perturb(
+    scores: jax.Array, tau: float = 1.0, key_seed: int = _JITTER_KEY
+) -> jax.Array:
+    """Add Gumbel(0, tau) noise so top-k draws ~ softmax(scores / tau)."""
+    g = jax.random.gumbel(jax.random.PRNGKey(key_seed), scores.shape)
+    return scores.astype(jnp.float32) + tau * g
+
+
+def price_step(load, cap, price, eta_t):
+    """One synchronous congestion-price update (shared with sharded solver).
+
+    Rise with clipped overload pressure; decay gently when under 90% full.
+    """
+    pressure = load / cap - 1.0
+    step = jnp.where(
+        pressure > 0,
+        jnp.clip(pressure, 0.0, 2.0),
+        0.25 * jnp.minimum(pressure + 0.1, 0.0),
+    )
+    return jnp.clip(price + eta_t * step, 0.0, None)
+
+
+@partial(jax.jit, static_argnames=("iters", "eta", "price_scale", "tau", "seed"))
+def auction(
+    scores: jax.Array,      # [N, M] plan logits, higher is better (bf16 ok)
+    sizes: jax.Array,       # f32[N]
+    copies: jax.Array,      # i32[N]
+    capacity: jax.Array,    # f32[M]
+    feasible: jax.Array,    # bool[N, M]
+    *,
+    iters: int = 40,
+    eta: float = 0.5,
+    price_scale: float = 1.0,
+    tau: float = 1.0,
+    seed: int = _JITTER_KEY,
+) -> AuctionResult:
+    """Gumbel-top-k sampling + annealed congestion-price repair.
+
+    ``price_scale`` converts prices into score units; with Sinkhorn plan
+    logits the useful spread is O(1), so the default 1.0 is right — the
+    per-iteration step is ``eta * price_scale * clip(overload)`` with a
+    1/(1 + 3t/T) anneal.
+    """
+    num_instances = capacity.shape[0]
+    scores_f32 = (
+        gumbel_perturb(scores, tau, seed) if tau > 0 else scores.astype(jnp.float32)
+    )
+    scores_f32 = jnp.where(feasible, scores_f32, _NEG_INF)
+    cap = jnp.maximum(capacity.astype(jnp.float32), 1e-6)
+    copies = jnp.minimum(copies, MAX_COPIES)
+
+    def body(price, t):
+        idx, valid = _select(scores_f32 - price[None, :], copies)
+        load = _implied_load(idx, valid, sizes, num_instances)
+        eta_t = eta * price_scale / (1.0 + 3.0 * t / iters)
+        return price_step(load, cap, price, eta_t), None
+
+    price0 = jnp.zeros((num_instances,), jnp.float32)
+    price, _ = jax.lax.scan(
+        body, price0, jnp.arange(iters, dtype=jnp.float32)
+    )
+
+    idx, valid = _select(scores_f32 - price[None, :], copies)
+    load = _implied_load(idx, valid, sizes, num_instances)
+    overflow = jnp.sum(jnp.maximum(load - cap, 0.0))
+    return AuctionResult(
+        indices=idx, valid=valid, load=load, prices=price, overflow=overflow
+    )

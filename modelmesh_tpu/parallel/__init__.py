@@ -1,0 +1,21 @@
+"""Device-mesh helpers and the multi-chip sharded placement solver."""
+
+from modelmesh_tpu.parallel.mesh import (
+    INSTANCE_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    problem_shardings,
+)
+from modelmesh_tpu.parallel.sharded_solver import (
+    make_sharded_solver,
+    shard_problem,
+)
+
+__all__ = [
+    "INSTANCE_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "problem_shardings",
+    "make_sharded_solver",
+    "shard_problem",
+]

@@ -1,0 +1,61 @@
+"""Device-mesh helpers for the sharded placement solver.
+
+Axis convention:
+- ``"mdl"``  — shards the model axis (rows of the cost matrix). This is the
+  long dimension (up to 1M models) and the primary sharding axis.
+- ``"inst"`` — optionally shards the instance axis (columns) for cost
+  assembly and column-potential work; rows are gathered before top-k.
+
+The solver's collectives (psum / pmax / all_gather) ride whatever fabric the
+mesh spans: ICI within a slice, DCN across hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODEL_AXIS = "mdl"
+INSTANCE_AXIS = "inst"
+
+
+def make_mesh(
+    shape: Sequence[int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a (mdl, inst) mesh. Default: all devices on the model axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices), 1)
+    arr = np.asarray(devices).reshape(tuple(shape))
+    return Mesh(arr, (MODEL_AXIS, INSTANCE_AXIS))
+
+
+def problem_pspec():
+    """PartitionSpec pytree for a PlacementProblem: model-axis arrays sharded
+    on ``mdl``, instance-axis arrays on ``inst``, matrices on both.
+
+    Single source of truth for the solver's input layout — used both as
+    shard_map in_specs and (wrapped in NamedSharding) for device_put.
+    """
+    from modelmesh_tpu.ops.costs import PlacementProblem
+
+    row = P(MODEL_AXIS)
+    col = P(INSTANCE_AXIS)
+    mat = P(MODEL_AXIS, INSTANCE_AXIS)
+    return PlacementProblem(
+        sizes=row, copies=row, rates=row, loaded=mat, feasible=mat,
+        capacity=col, reserved=col, lru_age=col, busyness=col, zone=col,
+    )
+
+
+def problem_shardings(mesh: Mesh):
+    """NamedSharding pytree for device_put of a PlacementProblem."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        problem_pspec(),
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -1,0 +1,31 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX backends init.
+
+Mirrors the reference's strategy of simulating a multi-instance cluster on a
+single machine (AbstractModelMeshClusterTest.java:100-198) — here the
+multi-*chip* analog is XLA's host-platform device-count override.
+
+Note: the ambient environment may register a remote-TPU PJRT plugin at
+interpreter startup and force ``jax_platforms`` via jax.config (so the
+JAX_PLATFORMS env var alone is NOT enough). We override through jax.config
+before any backend is initialized.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng_key():
+    return jax.random.PRNGKey(0)

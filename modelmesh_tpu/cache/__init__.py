@@ -1,0 +1,5 @@
+"""Per-instance weighted timestamp-LRU cache (clhm equivalent)."""
+
+from modelmesh_tpu.cache.lru import EvictionListener, WeightedLRUCache, now_ms
+
+__all__ = ["EvictionListener", "WeightedLRUCache", "now_ms"]

@@ -1,0 +1,286 @@
+"""Weighted, timestamp-ordered LRU cache — the per-instance model cache.
+
+Equivalent in capability to the reference's vendored ConcurrentLinkedHashMap
+fork (MM/clhm/ConcurrentLinkedHashMap.java): a weighted-capacity map ordered
+by *explicit* last-used timestamps rather than access order alone, because
+the serving layer backdates entries (e.g. newly registered models are
+inserted with lastUsed an hour in the past, ModelMesh.java:3097-3147) and
+force-refreshes timestamps from the shared registry.
+
+Capabilities mirrored (reference methods cited for parity checking):
+- put_if_absent(key, value, weight, last_used)     (clhm putIfAbsent :806)
+- get(..) touching now / get_quietly(..) no touch  (:742, getQuietly :784)
+- last_used(key) / force_last_used(key, ts)        (getLastUsedTime :742,
+                                                    forceSetLastUsedTime :751)
+- replace_quietly(key, old, new)                   (replaceQuietly :960)
+- oldest_time()                                    (oldestTime :1125)
+- descending_items() newest->oldest               (descendingLruMap :1087)
+- items_used_since(cutoff) newest->oldest         (descendingMapWithCutoff :1226)
+- weighted capacity + eviction listener dispatched under the eviction lock
+  with the evicted entry's timestamp                (EvictionListenerWithTime
+                                                    :1816, dispatch :582-583)
+- exposed eviction lock for unload-buffer accounting (getEvictionLock :283)
+- update_weight(key, new_weight) re-accounting      (weight adjust on sizing)
+
+Implementation notes: Python-side we keep a dict of entries plus a lazy
+min-heap on (last_used, seq) for eviction order; stale heap nodes are
+skipped on pop. All mutation happens under a single re-entrant lock which
+is *the* eviction lock the unload-buffer manager shares, mirroring the
+reference's design where unload accounting runs under the CLHM eviction
+lock (ModelCacheUnloadBufManager.java:51-54).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Generic, Iterator, Optional, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+# listener(key, value, last_used_ms) — called under the eviction lock.
+EvictionListener = Callable[[Any, Any, int], None]
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+@dataclass
+class _Entry(Generic[V]):
+    value: V
+    weight: int
+    last_used: int
+    seq: int              # tie-break for equal timestamps (insertion order)
+    heap_stale: bool = field(default=False)  # true if heap node is outdated
+
+
+class WeightedLRUCache(Generic[K, V]):
+    """Thread-safe weighted LRU with out-of-band timestamps."""
+
+    def __init__(
+        self,
+        capacity: int,
+        eviction_listener: Optional[EvictionListener] = None,
+    ) -> None:
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self._capacity = capacity
+        self._listener = eviction_listener
+        self._entries: dict[K, _Entry[V]] = {}
+        self._heap: list[tuple[int, int, K]] = []  # (last_used, seq, key)
+        self._weight = 0
+        self._seq = 0
+        self._lock = threading.RLock()
+
+    # -- locking ----------------------------------------------------------
+
+    @property
+    def eviction_lock(self) -> threading.RLock:
+        """The lock all mutation runs under; shared with unload accounting."""
+        return self._lock
+
+    # -- capacity ---------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._capacity = capacity
+            self._evict_over_capacity()
+
+    @property
+    def weight(self) -> int:
+        return self._weight
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: K) -> bool:
+        return key in self._entries
+
+    # -- core ops ---------------------------------------------------------
+
+    def put_if_absent(
+        self, key: K, value: V, weight: int, last_used: Optional[int] = None
+    ) -> Optional[V]:
+        """Insert unless present; returns the existing value if present.
+
+        ``last_used`` may be in the past (backdated registration) or future.
+        Insertion may synchronously evict other entries (never the new one,
+        unless it alone exceeds capacity — then it is rejected by raising
+        ``ValueError``, mirroring the reference's pathological-size refusal
+        at ModelMesh.java:2172-2196 which is handled a level up).
+        """
+        ts = now_ms() if last_used is None else last_used
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                return existing.value
+            if weight > self._capacity:
+                raise ValueError(
+                    f"entry weight {weight} exceeds cache capacity "
+                    f"{self._capacity}"
+                )
+            self._seq += 1
+            entry = _Entry(value=value, weight=weight, last_used=ts, seq=self._seq)
+            self._entries[key] = entry
+            self._weight += weight
+            heapq.heappush(self._heap, (ts, entry.seq, key))
+            self._evict_over_capacity(exclude=key)
+            return None
+
+    def get(self, key: K, touch_ts: Optional[int] = None) -> Optional[V]:
+        """Lookup, refreshing the entry's last-used timestamp."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            self._touch(key, entry, now_ms() if touch_ts is None else touch_ts)
+            return entry.value
+
+    def get_quietly(self, key: K) -> Optional[V]:
+        """Lookup without disturbing LRU order (reference getQuietly)."""
+        entry = self._entries.get(key)
+        return None if entry is None else entry.value
+
+    def replace_quietly(self, key: K, old_value: V, new_value: V) -> bool:
+        """CAS the value without touching LRU order."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry.value is not old_value:
+                return False
+            entry.value = new_value
+            return True
+
+    def remove(self, key: K) -> Optional[V]:
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return None
+            self._weight -= entry.weight
+            return entry.value
+
+    def remove_if_value(self, key: K, value: V) -> bool:
+        """Remove only if the mapped value is identical (CAS-remove)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry.value is not value:
+                return False
+            del self._entries[key]
+            self._weight -= entry.weight
+            return True
+
+    # -- timestamps -------------------------------------------------------
+
+    def last_used(self, key: K) -> Optional[int]:
+        entry = self._entries.get(key)
+        return None if entry is None else entry.last_used
+
+    def force_last_used(self, key: K, ts: int) -> bool:
+        """Set an entry's timestamp (may move it either direction)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return False
+            self._touch(key, entry, ts, force=True)
+            return True
+
+    def oldest_time(self) -> Optional[int]:
+        """Timestamp of the least-recently-used entry, None if empty."""
+        with self._lock:
+            while self._heap:
+                ts, seq, key = self._heap[0]
+                entry = self._entries.get(key)
+                if entry is None or entry.seq != seq or entry.last_used != ts:
+                    heapq.heappop(self._heap)  # stale node
+                    continue
+                return ts
+            return None
+
+    # -- weight updates ---------------------------------------------------
+
+    def update_weight(self, key: K, new_weight: int) -> Optional[int]:
+        """Re-account an entry's weight (model sizing). Returns old weight.
+
+        Growing an entry may evict others (never the updated entry itself).
+        """
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            old = entry.weight
+            entry.weight = new_weight
+            self._weight += new_weight - old
+            if new_weight > old:
+                self._evict_over_capacity(exclude=key)
+            return old
+
+    # -- iteration --------------------------------------------------------
+
+    def descending_items(self) -> Iterator[tuple[K, V, int]]:
+        """(key, value, last_used) newest -> oldest. Snapshot iteration."""
+        with self._lock:
+            snapshot = sorted(
+                ((e.last_used, e.seq, k, e.value) for k, e in self._entries.items()),
+                reverse=True,
+            )
+        for ts, _seq, k, v in snapshot:
+            yield k, v, ts
+
+    def items_used_since(self, cutoff: int) -> Iterator[tuple[K, V, int]]:
+        """Entries with last_used >= cutoff, newest -> oldest."""
+        for k, v, ts in self.descending_items():
+            if ts < cutoff:
+                return
+            yield k, v, ts
+
+    def ascending_items(self) -> Iterator[tuple[K, V, int]]:
+        """(key, value, last_used) oldest -> newest. Snapshot iteration."""
+        items = list(self.descending_items())
+        return iter(items[::-1])
+
+    def keys(self):
+        return list(self._entries.keys())
+
+    # -- internals --------------------------------------------------------
+
+    def _touch(self, key: K, entry: _Entry[V], ts: int, force: bool = False) -> None:
+        if not force and ts <= entry.last_used:
+            return  # never move an entry backwards on plain access
+        entry.last_used = ts
+        heapq.heappush(self._heap, (ts, entry.seq, key))
+
+    def _evict_over_capacity(self, exclude: Optional[K] = None) -> None:
+        """Pop LRU entries until within capacity. Caller holds the lock."""
+        while self._weight > self._capacity and self._entries:
+            victim = self._pop_lru(exclude)
+            if victim is None:
+                return  # only the excluded entry remains
+            key, entry = victim
+            del self._entries[key]
+            self._weight -= entry.weight
+            if self._listener is not None:
+                self._listener(key, entry.value, entry.last_used)
+
+    def _pop_lru(self, exclude: Optional[K]) -> Optional[tuple[K, _Entry[V]]]:
+        skipped: Optional[tuple[int, int, K]] = None
+        while self._heap:
+            ts, seq, key = heapq.heappop(self._heap)
+            entry = self._entries.get(key)
+            if entry is None or entry.seq != seq or entry.last_used != ts:
+                continue  # stale
+            if key == exclude:
+                skipped = (ts, seq, key)
+                continue
+            if skipped is not None:
+                heapq.heappush(self._heap, skipped)
+            return key, entry
+        if skipped is not None:
+            heapq.heappush(self._heap, skipped)
+        return None

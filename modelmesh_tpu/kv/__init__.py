@@ -1,0 +1,38 @@
+"""Coordination substrate: versioned KV, tables, sessions, leader election."""
+
+from modelmesh_tpu.kv.config import DynamicConfig
+from modelmesh_tpu.kv.memory import InMemoryKV
+from modelmesh_tpu.kv.session import LeaderElection, SessionNode
+from modelmesh_tpu.kv.store import (
+    CasFailed,
+    Compare,
+    EventType,
+    KeyValue,
+    KVStore,
+    Op,
+    WatchEvent,
+)
+from modelmesh_tpu.kv.table import (
+    KVTable,
+    Record,
+    TableEvent,
+    TableView,
+)
+
+__all__ = [
+    "DynamicConfig",
+    "InMemoryKV",
+    "LeaderElection",
+    "SessionNode",
+    "CasFailed",
+    "Compare",
+    "EventType",
+    "KeyValue",
+    "KVStore",
+    "Op",
+    "WatchEvent",
+    "KVTable",
+    "Record",
+    "TableEvent",
+    "TableView",
+]

@@ -1,0 +1,248 @@
+"""In-memory KVStore with full etcd-style semantics.
+
+Used directly by unit tests and wrapped by the gRPC KV service
+(kv/service.py) for multi-process cluster tests — mirroring how the
+reference tests run against a real etcd child process
+(AbstractModelMeshTest.java:83-192) without requiring etcd in the image.
+
+Watch events are dispatched on a dedicated thread so callbacks may freely
+re-enter the store. Lease expiry runs on a sweeper thread; expired leases
+delete their attached keys and emit DELETE events (ephemeral-node semantics
+for instance liveness, reference: SessionNode usage at ModelMesh.java:788).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Iterable, Optional, Sequence
+
+from modelmesh_tpu.kv.store import (
+    Compare,
+    EventType,
+    KeyValue,
+    KVStore,
+    Op,
+    WatchCallback,
+    WatchEvent,
+    WatchHandle,
+)
+
+
+class _Watcher(WatchHandle):
+    def __init__(self, store: "InMemoryKV", prefix: str, callback: WatchCallback):
+        self.prefix = prefix
+        self.callback = callback
+        self._store = store
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+        with self._store._lock:
+            self._store._watchers.discard(self)
+
+
+class InMemoryKV(KVStore):
+    def __init__(self, sweep_interval_s: float = 0.1):
+        self._lock = threading.RLock()
+        self._data: dict[str, KeyValue] = {}
+        self._rev = 0
+        self._lease_seq = itertools.count(1)
+        # lease_id -> (deadline_monotonic, ttl_s, set[key])
+        self._leases: dict[int, tuple[float, float, set[str]]] = {}
+        self._watchers: set[_Watcher] = set()
+        self._events: "queue.Queue" = queue.Queue()
+        self._closed = threading.Event()
+        self._history: list[WatchEvent] = []  # for start_rev replay
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="kv-dispatch", daemon=True
+        )
+        self._dispatcher.start()
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop,
+            args=(sweep_interval_s,),
+            name="kv-lease-sweeper",
+            daemon=True,
+        )
+        self._sweeper.start()
+
+    # -- reads ------------------------------------------------------------
+
+    def get(self, key: str) -> Optional[KeyValue]:
+        with self._lock:
+            return self._data.get(key)
+
+    def range(self, prefix: str) -> list[KeyValue]:
+        with self._lock:
+            return sorted(
+                (kv for k, kv in self._data.items() if k.startswith(prefix)),
+                key=lambda kv: kv.key,
+            )
+
+    # -- writes -----------------------------------------------------------
+
+    def put(self, key: str, value: bytes, lease: int = 0) -> KeyValue:
+        with self._lock:
+            return self._put_locked(key, value, lease)
+
+    def _put_locked(self, key: str, value: bytes, lease: int) -> KeyValue:
+        if lease and lease not in self._leases:
+            raise ValueError(f"lease {lease} does not exist")
+        self._rev += 1
+        prev = self._data.get(key)
+        kv = KeyValue(
+            key=key,
+            value=value,
+            create_rev=prev.create_rev if prev else self._rev,
+            mod_rev=self._rev,
+            version=(prev.version + 1) if prev else 1,
+            lease=lease,
+        )
+        self._data[key] = kv
+        if prev and prev.lease and prev.lease != lease:
+            attached = self._leases.get(prev.lease)
+            if attached:
+                attached[2].discard(key)
+        if lease:
+            self._leases[lease][2].add(key)
+        self._emit(WatchEvent(EventType.PUT, kv, prev))
+        return kv
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._delete_locked(key)
+
+    def _delete_locked(self, key: str) -> bool:
+        prev = self._data.pop(key, None)
+        if prev is None:
+            return False
+        self._rev += 1
+        if prev.lease:
+            attached = self._leases.get(prev.lease)
+            if attached:
+                attached[2].discard(key)
+        tomb = KeyValue(
+            key=key, value=b"", create_rev=prev.create_rev,
+            mod_rev=self._rev, version=0, lease=0,
+        )
+        self._emit(WatchEvent(EventType.DELETE, tomb, prev))
+        return True
+
+    def txn(
+        self,
+        compares: Iterable[Compare],
+        on_success: Iterable[Op],
+        on_failure: Iterable[Op] = (),
+    ) -> tuple[bool, list[KeyValue]]:
+        with self._lock:
+            ok = all(
+                (self._data.get(c.key).version if self._data.get(c.key) else 0)
+                == c.version
+                for c in compares
+            )
+            results: list[KeyValue] = []
+            for op in on_success if ok else on_failure:
+                if op.value is None:
+                    self._delete_locked(op.key)
+                else:
+                    results.append(self._put_locked(op.key, op.value, op.lease))
+            return ok, results
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(
+        self,
+        prefix: str,
+        callback: WatchCallback,
+        start_rev: Optional[int] = None,
+    ) -> WatchHandle:
+        w = _Watcher(self, prefix, callback)
+        with self._lock:
+            replay = []
+            if start_rev is not None:
+                replay = [
+                    ev
+                    for ev in self._history
+                    if ev.kv.mod_rev > start_rev and ev.kv.key.startswith(prefix)
+                ]
+            self._watchers.add(w)
+        if replay:
+            self._events.put((w, replay))
+        return w
+
+    def _emit(self, event: WatchEvent) -> None:
+        # Caller holds the lock.
+        self._history.append(event)
+        for w in list(self._watchers):
+            if event.kv.key.startswith(w.prefix):
+                self._events.put((w, [event]))
+
+    def _dispatch_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                w, events = self._events.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if w.cancelled:
+                continue
+            try:
+                w.callback(events)
+            except Exception:  # watcher bugs must not kill dispatch
+                import traceback
+
+                traceback.print_exc()
+
+    # -- leases -----------------------------------------------------------
+
+    def lease_grant(self, ttl_s: float) -> int:
+        with self._lock:
+            lease_id = next(self._lease_seq)
+            self._leases[lease_id] = (time.monotonic() + ttl_s, ttl_s, set())
+            return lease_id
+
+    def lease_keepalive(self, lease_id: int) -> bool:
+        with self._lock:
+            entry = self._leases.get(lease_id)
+            if entry is None:
+                return False
+            _, ttl_s, keys = entry
+            self._leases[lease_id] = (time.monotonic() + ttl_s, ttl_s, keys)
+            return True
+
+    def lease_revoke(self, lease_id: int) -> None:
+        with self._lock:
+            entry = self._leases.pop(lease_id, None)
+            if entry is None:
+                return
+            for key in list(entry[2]):
+                self._delete_locked(key)
+
+    def _sweep_loop(self, interval: float) -> None:
+        while not self._closed.wait(interval):
+            now = time.monotonic()
+            with self._lock:
+                expired = [
+                    lid for lid, (dl, _, _) in self._leases.items() if dl < now
+                ]
+                for lid in expired:
+                    entry = self._leases.pop(lid)
+                    for key in list(entry[2]):
+                        self._delete_locked(key)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed.set()
+
+    # -- test helpers -------------------------------------------------------
+
+    def wait_idle(self, timeout: float = 5.0) -> None:
+        """Block until the watch event queue has drained (tests)."""
+        deadline = time.monotonic() + timeout
+        while not self._events.empty():
+            if time.monotonic() > deadline:
+                raise TimeoutError("watch queue did not drain")
+            time.sleep(0.005)
+        time.sleep(0.02)  # let the in-flight callback finish

@@ -1,0 +1,157 @@
+"""Ephemeral session nodes and leader election on top of KV leases.
+
+Parity targets from the reference's kv-utils usage:
+- SessionNode: an instance's liveness advertisement — an ephemeral key bound
+  to a TTL lease, auto-refreshed, republished if the lease is lost
+  (ModelMesh.java:788 `myNode.start()`; liveness semantics in SURVEY.md
+  section 5.3).
+- LeaderElection: lowest-create-revision candidate wins (etcd election
+  recipe); used for the reaper/janitorial leader role
+  (ModelMesh.java:819-825 leaderLatch).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from modelmesh_tpu.kv.store import EventType, KVStore
+
+
+class SessionNode:
+    """Ephemeral key kept alive by a background keepalive thread."""
+
+    def __init__(
+        self,
+        store: KVStore,
+        key: str,
+        value: bytes,
+        ttl_s: float = 5.0,
+        keepalive_interval_s: Optional[float] = None,
+    ):
+        self.store = store
+        self.key = key
+        self._value = value
+        self.ttl_s = ttl_s
+        self._interval = keepalive_interval_s or ttl_s / 3.0
+        self._lease: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        self._establish()
+        self._thread = threading.Thread(
+            target=self._keepalive_loop, name=f"session-{self.key}", daemon=True
+        )
+        self._thread.start()
+
+    def _establish(self) -> None:
+        with self._lock:
+            self._lease = self.store.lease_grant(self.ttl_s)
+            self.store.put(self.key, self._value, lease=self._lease)
+
+    def update(self, value: bytes) -> None:
+        """Republish the node's value (instance record refresh)."""
+        with self._lock:
+            self._value = value
+            if self._lease is not None:
+                self.store.put(self.key, value, lease=self._lease)
+
+    def _keepalive_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            with self._lock:
+                lease = self._lease
+            if lease is None or not self.store.lease_keepalive(lease):
+                # Lease lost (KV hiccup / expiry): re-grant and republish.
+                try:
+                    self._establish()
+                except Exception:
+                    pass  # retry next tick
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        with self._lock:
+            if self._lease is not None:
+                try:
+                    self.store.lease_revoke(self._lease)
+                except Exception:
+                    pass
+                self._lease = None
+
+
+class LeaderElection:
+    """Lowest-create-revision election under a prefix.
+
+    Each candidate writes an ephemeral key; the candidate whose key has the
+    lowest create revision is leader. A prefix watch re-evaluates on any
+    membership change and invokes ``on_change(is_leader)`` on transitions.
+    """
+
+    def __init__(
+        self,
+        store: KVStore,
+        prefix: str,
+        candidate_id: str,
+        on_change: Callable[[bool], None],
+        ttl_s: float = 5.0,
+    ):
+        if not prefix.endswith("/"):
+            prefix += "/"
+        self.store = store
+        self.prefix = prefix
+        self.candidate_id = candidate_id
+        self.on_change = on_change
+        self._node = SessionNode(
+            store, prefix + candidate_id, candidate_id.encode(), ttl_s=ttl_s
+        )
+        self._is_leader = False
+        self._lock = threading.Lock()
+        self._watch = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def start(self) -> None:
+        self._node.start()
+        self._watch = self.store.watch(self.prefix, self._on_events)
+        self._evaluate()
+
+    def _on_events(self, events) -> None:
+        if any(
+            ev.type in (EventType.PUT, EventType.DELETE) for ev in events
+        ):
+            self._evaluate()
+
+    def _evaluate(self) -> None:
+        kvs = self.store.range(self.prefix)
+        leader = min(kvs, key=lambda kv: kv.create_rev).key if kvs else None
+        me = leader == self.prefix + self.candidate_id
+        fire = False
+        with self._lock:
+            if me != self._is_leader:
+                self._is_leader = me
+                fire = True
+        if fire:
+            try:
+                self.on_change(me)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def close(self) -> None:
+        if self._watch is not None:
+            self._watch.cancel()
+        self._node.close()
+        with self._lock:
+            was = self._is_leader
+            self._is_leader = False
+        if was:
+            try:
+                self.on_change(False)
+            except Exception:
+                pass

@@ -1,0 +1,156 @@
+"""KV store abstraction — the coordination substrate interface.
+
+Capability-equivalent to the surface of the reference's (external) kv-utils
+library as used by the serving core (SURVEY.md section 2: KVTable/TableView,
+SessionNode leases, LeaderElection, DynamicConfig; usage at
+ModelMesh.java:582-628, 783-825): versioned CAS, prefix range/watch,
+TTL leases with ephemeral keys, transactions.
+
+The model follows etcd3 semantics (global revision, per-key create/mod
+revision + version counter, lease ids) so an etcd-backed implementation can
+slot in without changing callers; tests and single-host clusters use the
+in-memory / gRPC-served implementations.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import enum
+from typing import Callable, Iterable, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyValue:
+    key: str
+    value: bytes
+    create_rev: int    # revision at which the key was created
+    mod_rev: int       # revision of the last modification
+    version: int       # per-key modification counter (1 on create)
+    lease: int = 0     # owning lease id, 0 = none
+
+
+class EventType(enum.Enum):
+    PUT = "put"
+    DELETE = "delete"
+
+
+@dataclasses.dataclass(frozen=True)
+class WatchEvent:
+    type: EventType
+    kv: KeyValue                      # for DELETE: last-seen kv (value b"")
+    prev: Optional[KeyValue] = None
+
+
+WatchCallback = Callable[[Sequence[WatchEvent]], None]
+
+
+class WatchHandle(abc.ABC):
+    @abc.abstractmethod
+    def cancel(self) -> None: ...
+
+
+@dataclasses.dataclass(frozen=True)
+class Compare:
+    """Transaction guard: compare a key's version (etcd-style).
+
+    version == 0 asserts the key does NOT exist.
+    """
+
+    key: str
+    version: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Op:
+    """Transaction mutation: put (value is not None) or delete."""
+
+    key: str
+    value: Optional[bytes] = None
+    lease: int = 0
+
+
+class CasFailed(Exception):
+    """Conditional update lost the race; reread and retry."""
+
+
+class KVStore(abc.ABC):
+    """Versioned KV with prefix watch, leases, and transactions."""
+
+    # -- reads ------------------------------------------------------------
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[KeyValue]: ...
+
+    @abc.abstractmethod
+    def range(self, prefix: str) -> list[KeyValue]: ...
+
+    # -- writes -----------------------------------------------------------
+
+    @abc.abstractmethod
+    def put(self, key: str, value: bytes, lease: int = 0) -> KeyValue: ...
+
+    def put_if_version(
+        self, key: str, value: bytes, expected_version: int, lease: int = 0
+    ) -> KeyValue:
+        """CAS put: succeeds only if the key's version matches (0 = absent).
+
+        Raises CasFailed otherwise. Default implementation via txn().
+        """
+        ok, _ = self.txn(
+            [Compare(key, expected_version)], [Op(key, value, lease)], []
+        )
+        if not ok:
+            raise CasFailed(key)
+        kv = self.get(key)
+        assert kv is not None
+        return kv
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+    def delete_if_version(self, key: str, expected_version: int) -> bool:
+        ok, _ = self.txn([Compare(key, expected_version)], [Op(key)], [])
+        return ok
+
+    @abc.abstractmethod
+    def txn(
+        self,
+        compares: Iterable[Compare],
+        on_success: Iterable[Op],
+        on_failure: Iterable[Op] = (),
+    ) -> tuple[bool, list[KeyValue]]:
+        """Atomic multi-key conditional mutation (etcd txn semantics)."""
+
+    # -- watch ------------------------------------------------------------
+
+    @abc.abstractmethod
+    def watch(
+        self,
+        prefix: str,
+        callback: WatchCallback,
+        start_rev: Optional[int] = None,
+    ) -> WatchHandle:
+        """Subscribe to changes under a prefix.
+
+        ``start_rev``: deliver events with mod_rev > start_rev that occurred
+        before subscription (replay), then stream. None = only new events.
+        """
+
+    # -- leases -----------------------------------------------------------
+
+    @abc.abstractmethod
+    def lease_grant(self, ttl_s: float) -> int: ...
+
+    @abc.abstractmethod
+    def lease_keepalive(self, lease_id: int) -> bool:
+        """Refresh; returns False if the lease no longer exists."""
+
+    @abc.abstractmethod
+    def lease_revoke(self, lease_id: int) -> None:
+        """Drop the lease and delete all attached keys."""
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @abc.abstractmethod
+    def close(self) -> None: ...

@@ -1,0 +1,228 @@
+"""Typed record tables over the KV store: KVTable + watch-fed TableView.
+
+Capability parity with the kv-utils KVTable/TableView surface the reference
+core consumes (registry/instances/vmodels tables built at
+ModelMesh.java:582-628, 783-791): JSON-serialized records with versioned CAS
+(conditionalSetAndGet idiom, e.g. ModelMesh.java:5200-5255), and a local
+cache view maintained by a prefix watch with add/update/delete listeners.
+
+The reference shards its registry watch over 128 fixed buckets
+(ModelMesh.java:169) as an etcd watch-fanout optimization; our store watch
+is a single prefix stream, so bucketing is unnecessary — key layout stays
+flat `<prefix>/<id>`.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import threading
+import time
+from dataclasses import asdict
+from typing import Callable, Generic, Iterator, Optional, Type, TypeVar
+
+from modelmesh_tpu.kv.store import (
+    CasFailed,
+    EventType,
+    KVStore,
+    WatchEvent,
+)
+
+R = TypeVar("R", bound="Record")
+
+
+class Record:
+    """Base for table records: JSON dataclass + KV version for CAS.
+
+    Subclasses are dataclasses; ``version`` is infrastructure state (the
+    KV per-key version used for conditional updates), not payload.
+    """
+
+    version: int = 0  # 0 = not persisted yet
+
+    def to_bytes(self) -> bytes:
+        d = asdict(self)  # type: ignore[arg-type]
+        d.pop("version", None)
+        return json.dumps(d, separators=(",", ":"), sort_keys=True).encode()
+
+    @classmethod
+    def from_bytes(cls: Type[R], data: bytes, version: int) -> R:
+        obj = cls(**json.loads(data.decode()))  # type: ignore[call-arg]
+        obj.version = version
+        return obj
+
+
+class TableEvent(enum.Enum):
+    ADDED = "added"
+    UPDATED = "updated"
+    DELETED = "deleted"
+
+
+# listener(event, key, record_or_None)
+TableListener = Callable[[TableEvent, str, Optional[Record]], None]
+
+
+class KVTable(Generic[R]):
+    """Direct (uncached) typed access to records under a prefix."""
+
+    def __init__(self, store: KVStore, prefix: str, record_cls: Type[R]):
+        if not prefix.endswith("/"):
+            prefix += "/"
+        self.store = store
+        self.prefix = prefix
+        self.record_cls = record_cls
+
+    def _key(self, id_: str) -> str:
+        return self.prefix + id_
+
+    def get(self, id_: str) -> Optional[R]:
+        kv = self.store.get(self._key(id_))
+        if kv is None:
+            return None
+        return self.record_cls.from_bytes(kv.value, kv.version)
+
+    def put(self, id_: str, record: R, lease: int = 0) -> R:
+        """Unconditional set; refreshes record.version."""
+        kv = self.store.put(self._key(id_), record.to_bytes(), lease)
+        record.version = kv.version
+        return record
+
+    def conditional_set(self, id_: str, record: R, lease: int = 0) -> R:
+        """CAS on record.version (0 = create). Raises CasFailed on conflict.
+
+        On success the record's version is refreshed in place — the
+        conditionalSetAndGet idiom the reference uses for every registry
+        update.
+        """
+        kv = self.store.put_if_version(
+            self._key(id_), record.to_bytes(), record.version, lease
+        )
+        record.version = kv.version
+        return record
+
+    def conditional_delete(self, id_: str, expected_version: int) -> bool:
+        return self.store.delete_if_version(self._key(id_), expected_version)
+
+    def delete(self, id_: str) -> bool:
+        return self.store.delete(self._key(id_))
+
+    def items(self) -> Iterator[tuple[str, R]]:
+        for kv in self.store.range(self.prefix):
+            yield kv.key[len(self.prefix):], self.record_cls.from_bytes(
+                kv.value, kv.version
+            )
+
+    def update_or_create(
+        self, id_: str, mutate: Callable[[Optional[R]], Optional[R]],
+        max_attempts: int = 20,
+    ) -> Optional[R]:
+        """Run a CAS retry loop: read, mutate, conditional-set.
+
+        ``mutate`` gets the current record (None if absent) and returns the
+        desired record (None = delete / no-op if also absent). Returns the
+        final stored record (None if deleted/no-op).
+        """
+        for _ in range(max_attempts):
+            current = self.get(id_)
+            desired = mutate(current)
+            if desired is None:
+                if current is None:
+                    return None
+                if self.conditional_delete(id_, current.version):
+                    return None
+                continue
+            desired.version = current.version if current is not None else 0
+            try:
+                return self.conditional_set(id_, desired)
+            except CasFailed:
+                continue
+        raise CasFailed(f"update_or_create({id_}): too many CAS conflicts")
+
+
+class TableView(Generic[R]):
+    """Local watch-maintained cache of a KVTable with change listeners.
+
+    Every placement decision in the reference reads these local views, not
+    the KV store directly (registry.getView(), instance table listener at
+    ModelMesh.java:1455-1568).
+    """
+
+    def __init__(self, table: KVTable[R]):
+        self.table = table
+        self._cache: dict[str, R] = {}
+        self._lock = threading.RLock()
+        self._listeners: list[TableListener] = []
+        self._ready = threading.Event()
+        # Subscribe from revision 0 so pre-existing records replay as events.
+        self._watch = table.store.watch(
+            table.prefix, self._on_events, start_rev=0
+        )
+        # Seed synchronously for immediate availability; watch replay will
+        # redeliver, which _apply treats idempotently by mod version.
+        with self._lock:
+            for id_, rec in table.items():
+                self._cache[id_] = rec
+        self._ready.set()
+
+    def add_listener(self, listener: TableListener) -> None:
+        self._listeners.append(listener)
+
+    def _on_events(self, events: list[WatchEvent]) -> None:
+        for ev in events:
+            id_ = ev.kv.key[len(self.table.prefix):]
+            with self._lock:
+                if ev.type is EventType.DELETE:
+                    existed = self._cache.pop(id_, None)
+                    event = TableEvent.DELETED if existed is not None else None
+                    rec = None
+                else:
+                    rec = self.table.record_cls.from_bytes(
+                        ev.kv.value, ev.kv.version
+                    )
+                    prev = self._cache.get(id_)
+                    if prev is not None and prev.version >= rec.version:
+                        event = None  # stale/duplicate replay
+                    else:
+                        self._cache[id_] = rec
+                        event = (
+                            TableEvent.ADDED if prev is None else TableEvent.UPDATED
+                        )
+            if event is not None:
+                for listener in self._listeners:
+                    listener(event, id_, rec)
+
+    # -- read API ----------------------------------------------------------
+
+    def get(self, id_: str) -> Optional[R]:
+        with self._lock:
+            return self._cache.get(id_)
+
+    def items(self) -> list[tuple[str, R]]:
+        with self._lock:
+            return list(self._cache.items())
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+    def __contains__(self, id_: str) -> bool:
+        return id_ in self._cache
+
+    def wait_ready(self, timeout: float = 10.0) -> None:
+        if not self._ready.wait(timeout):
+            raise TimeoutError("table view initialization timed out")
+
+    def wait_for(
+        self,
+        predicate: Callable[["TableView[R]"], bool],
+        timeout: float = 10.0,
+        poll_s: float = 0.01,
+    ) -> None:
+        """Test helper: block until predicate(self) is true."""
+        deadline = time.monotonic() + timeout
+        while not predicate(self):
+            if time.monotonic() > deadline:
+                raise TimeoutError("condition not reached")
+            time.sleep(poll_s)
+
+    def close(self) -> None:
+        self._watch.cancel()

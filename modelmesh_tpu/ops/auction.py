@@ -73,11 +73,13 @@ def _implied_load(
     )
 
 
-def gumbel_perturb(
-    scores: jax.Array, tau: float = 1.0, key_seed: int = _JITTER_KEY
-) -> jax.Array:
-    """Add Gumbel(0, tau) noise so top-k draws ~ softmax(scores / tau)."""
-    g = jax.random.gumbel(jax.random.PRNGKey(key_seed), scores.shape)
+def gumbel_perturb(scores: jax.Array, tau: float, seed: jax.Array) -> jax.Array:
+    """Add Gumbel(0, tau) noise so top-k draws ~ softmax(scores / tau).
+
+    ``seed`` is a *traced* int32 scalar — callers vary it per solve (janitor
+    pass counter) without triggering a recompile.
+    """
+    g = jax.random.gumbel(jax.random.PRNGKey(seed), scores.shape)
     return scores.astype(jnp.float32) + tau * g
 
 
@@ -95,19 +97,19 @@ def price_step(load, cap, price, eta_t):
     return jnp.clip(price + eta_t * step, 0.0, None)
 
 
-@partial(jax.jit, static_argnames=("iters", "eta", "price_scale", "tau", "seed"))
+@partial(jax.jit, static_argnames=("iters", "eta", "price_scale", "tau"))
 def auction(
     scores: jax.Array,      # [N, M] plan logits, higher is better (bf16 ok)
     sizes: jax.Array,       # f32[N]
     copies: jax.Array,      # i32[N]
     capacity: jax.Array,    # f32[M]
     feasible: jax.Array,    # bool[N, M]
+    seed: jax.Array | int = _JITTER_KEY,  # traced: varying it never retraces
     *,
     iters: int = 40,
     eta: float = 0.5,
     price_scale: float = 1.0,
     tau: float = 1.0,
-    seed: int = _JITTER_KEY,
 ) -> AuctionResult:
     """Gumbel-top-k sampling + annealed congestion-price repair.
 
@@ -117,6 +119,7 @@ def auction(
     1/(1 + 3t/T) anneal.
     """
     num_instances = capacity.shape[0]
+    seed = jnp.asarray(seed, jnp.uint32)
     scores_f32 = (
         gumbel_perturb(scores, tau, seed) if tau > 0 else scores.astype(jnp.float32)
     )

@@ -35,6 +35,10 @@ class CostWeights:
     balance: float = 0.35   # spread high-rate models away from busy instances
     lru_age: float = 0.25   # prefer instances whose cache is oldest (easy eviction)
     zone_spread: float = 0.15  # prefer spreading copies across zones/versions
+    # One-hot width for zone ids. Zone ids MUST be dense in [0, num_zones);
+    # ids >= num_zones would alias (wrap), corrupting the spread term — the
+    # strategy layer densifies zone names before building the problem.
+    num_zones: int = 8
 
 
 @jax.tree_util.register_dataclass
@@ -114,9 +118,9 @@ def assemble_cost(
     # Zone crowding: fraction of a model's current copies already in the
     # instance's zone (encourages copy spread like the reference's
     # location/zone placement terms).
-    num_zones = 8  # zones are folded mod 8; plenty for rack/zone spread
-    zone_ids = problem.zone % num_zones
-    zone_onehot = jax.nn.one_hot(zone_ids, num_zones, dtype=jnp.float32)  # [M, Z]
+    zone_onehot = jax.nn.one_hot(
+        problem.zone, w.num_zones, dtype=jnp.float32
+    )  # [M, Z]; out-of-range ids one-hot to all-zeros (no spread term)
     copies_per_zone = problem.loaded.astype(jnp.float32) @ zone_onehot    # [N, Z]
     denom = jnp.maximum(jnp.sum(copies_per_zone, axis=1, keepdims=True), 1.0)
     crowding = (copies_per_zone / denom) @ zone_onehot.T                  # [N, M]

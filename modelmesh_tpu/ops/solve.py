@@ -31,9 +31,8 @@ class SolveConfig(NamedTuple):
     eta: float = 0.5
     # Gumbel sampling temperature for integral rounding; 0 disables sampling.
     tau: float = 1.0
-    # Seed for the rounding draw. Callers should vary this per solve (e.g.
-    # janitor pass counter) so an unlucky collision isn't frozen forever.
-    seed: int = 0x5EED
+    # Placement-preference weights (static: part of the compiled program).
+    weights: costs_mod.CostWeights = costs_mod.CostWeights()
     dtype: jnp.dtype = jnp.bfloat16
 
 
@@ -49,9 +48,14 @@ class Placement(NamedTuple):
 
 @partial(jax.jit, static_argnames=("config",))
 def solve_placement(
-    problem: costs_mod.PlacementProblem, config: SolveConfig = SolveConfig()
+    problem: costs_mod.PlacementProblem,
+    config: SolveConfig = SolveConfig(),
+    seed: jax.Array | int = 0x5EED,
 ) -> Placement:
-    C = costs_mod.assemble_cost(problem, dtype=config.dtype)
+    """Solve one global placement. ``seed`` is traced — vary it per solve
+    (e.g. janitor pass counter) so an unlucky rounding draw isn't frozen
+    forever; changing it never recompiles."""
+    C = costs_mod.assemble_cost(problem, weights=config.weights, dtype=config.dtype)
     # Clamp copies to what rounding can actually place, BEFORE building the
     # transport marginals — otherwise the prior reserves phantom capacity.
     copies = jnp.minimum(problem.copies, auction_mod_MAX_COPIES)
@@ -67,10 +71,10 @@ def solve_placement(
         copies,
         free,
         problem.feasible,
+        seed,
         iters=config.auction_iters,
         eta=config.eta,
         tau=config.tau,
-        seed=config.seed,
     )
     return Placement(
         indices=res.indices,

@@ -58,8 +58,7 @@ def _cost_block(p: PlacementProblem, w: CostWeights, dtype) -> jax.Array:
     age = _norm_sharded(p.lru_age, INSTANCE_AXIS)
     rate = _norm_sharded(p.rates, MODEL_AXIS)
 
-    num_zones = 8
-    zone_onehot = jax.nn.one_hot(p.zone % num_zones, num_zones, dtype=jnp.float32)
+    zone_onehot = jax.nn.one_hot(p.zone, w.num_zones, dtype=jnp.float32)
     cpz = jax.lax.psum(
         p.loaded.astype(jnp.float32) @ zone_onehot, INSTANCE_AXIS
     )  # [n_blk, Z] full-width zone counts
@@ -147,7 +146,9 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int, eta: floa
     return idx, valid, load, price, overflow
 
 
-def _solve_kernel(p: PlacementProblem, config: SolveConfig, weights: CostWeights):
+def _solve_kernel(
+    p: PlacementProblem, seed: jax.Array, config: SolveConfig, weights: CostWeights
+):
     C = _cost_block(p, weights, config.dtype)
     copies = jnp.minimum(p.copies, MAX_COPIES)
     row_mass = p.sizes * copies.astype(jnp.float32)
@@ -155,8 +156,12 @@ def _solve_kernel(p: PlacementProblem, config: SolveConfig, weights: CostWeights
     f, g, row_err = _sharded_sinkhorn(
         C, row_mass, free, config.eps, config.sinkhorn_iters
     )
-    logits = (f[:, None] + g[None, :] - C.astype(jnp.float32)) / config.eps
-    logits = jnp.where(p.feasible, logits, _NEG_INF)
+    # Quantize to the cost dtype exactly like ops.sinkhorn.plan_logits does,
+    # so single-device and sharded rounding see identical scores.
+    logits = (
+        (f[:, None] + g[None, :] - C.astype(jnp.float32)) / config.eps
+    ).astype(config.dtype)
+    logits = jnp.where(p.feasible, logits.astype(jnp.float32), _NEG_INF)
     # Full-width rows for top-k (no-op when inst mesh axis is 1).
     logits_full = jax.lax.all_gather(logits, INSTANCE_AXIS, axis=1, tiled=True)
     if config.tau > 0:
@@ -164,7 +169,7 @@ def _solve_kernel(p: PlacementProblem, config: SolveConfig, weights: CostWeights
         # of logits + Gumbel samples ~ the soft plan, de-herding identical
         # rows).
         key = jax.random.fold_in(
-            jax.random.PRNGKey(config.seed), jax.lax.axis_index(MODEL_AXIS)
+            jax.random.PRNGKey(seed), jax.lax.axis_index(MODEL_AXIS)
         )
         noise = config.tau * jax.random.gumbel(key, logits_full.shape)
         logits_full = jnp.where(
@@ -186,24 +191,31 @@ def make_sharded_solver(
 ):
     """Build a jitted sharded solver bound to ``mesh``.
 
-    The returned callable takes a PlacementProblem whose model-axis length is
-    divisible by the ``mdl`` mesh axis and instance-axis length divisible by
-    ``inst``; outputs: indices/valid sharded on ``mdl``, load replicated.
+    The returned callable is ``solver(problem, seed=...)`` — seed is traced,
+    so varying it per solve never recompiles. The problem's model-axis
+    length must be divisible by the ``mdl`` mesh axis and instance-axis
+    length by ``inst``; outputs: indices/valid sharded on ``mdl``, load
+    replicated.
     """
-    in_specs = mesh_mod.problem_pspec()
+    in_specs = (mesh_mod.problem_pspec(), P())
     row = P(MODEL_AXIS)
     out_specs = Placement(
         indices=row, valid=row, load=P(), overflow=P(), row_err=P()
     )
     kernel = partial(_solve_kernel, config=config, weights=weights)
     shmapped = jax.shard_map(
-        lambda prob: kernel(prob),
+        lambda prob, seed: kernel(prob, seed),
         mesh=mesh,
-        in_specs=(in_specs,),
+        in_specs=in_specs,
         out_specs=out_specs,
         check_vma=False,
     )
-    return jax.jit(shmapped)
+    jitted = jax.jit(shmapped)
+
+    def solver(problem: PlacementProblem, seed=0x5EED):
+        return jitted(problem, jnp.asarray(seed, jnp.uint32))
+
+    return solver
 
 
 def shard_problem(problem: PlacementProblem, mesh: Mesh) -> PlacementProblem:

@@ -1,0 +1,163 @@
+"""Cluster-state records: the shared-registry schema.
+
+Capability parity with the reference's KV-persisted records:
+- ModelRecord    (MM/ModelRecord.java:61-126): per-model registry entry —
+  type/path/key, instance placements with load timestamps, load failures
+  with expiry, refCount/autoDelete for vmodel-managed models, lazily
+  persisted lastUsed.
+- InstanceRecord (MM/InstanceRecord.java:37-108): per-instance
+  advertisement — LRU age, capacity/used, loading threads, request rate,
+  shutdown flag, location/zone/labels.
+- VModelRecord   (MM/VModelRecord.java:17-45): virtual-model alias state —
+  owner, active/target concrete models, transition failure flag.
+
+All are JSON dataclasses with KV-version CAS via kv.table.Record. Time is
+epoch millis throughout (matching the cache timestamps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from modelmesh_tpu.kv.table import Record
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+# Load-failure bookkeeping windows (reference: ModelMesh.java:219-224).
+LOAD_FAILURE_EXPIRY_MS = 15 * 60 * 1000
+MAX_LOAD_FAILURES = 3
+MAX_LOAD_LOCATIONS = 5
+
+
+@dataclasses.dataclass
+class ModelRecord(Record):
+    model_type: str = ""
+    model_path: str = ""
+    model_key: str = ""          # opaque runtime credential/config blob
+    # instance_id -> load timestamp (ms); the authoritative placement map.
+    instance_ids: dict[str, int] = dataclasses.field(default_factory=dict)
+    # instance_id -> [failure_ts_ms, message]
+    load_failures: dict[str, list] = dataclasses.field(default_factory=dict)
+    ref_count: int = 0           # vmodel references
+    auto_delete: bool = False    # delete when ref_count drops to 0
+    last_used: int = 0           # lazily persisted (see should_persist_last_used)
+    last_unload_ms: int = 0
+    version: int = 0
+
+    # -- placements ---------------------------------------------------------
+
+    def add_instance(self, instance_id: str, ts: Optional[int] = None) -> None:
+        self.instance_ids[instance_id] = ts if ts is not None else now_ms()
+
+    def remove_instance(self, instance_id: str) -> bool:
+        return self.instance_ids.pop(instance_id, None) is not None
+
+    @property
+    def copy_count(self) -> int:
+        return len(self.instance_ids)
+
+    # -- failures -------------------------------------------------------------
+
+    def add_load_failure(self, instance_id: str, message: str,
+                         ts: Optional[int] = None) -> None:
+        self.load_failures[instance_id] = [
+            ts if ts is not None else now_ms(), message[:512]
+        ]
+
+    def expire_load_failures(
+        self, now: Optional[int] = None,
+        expiry_ms: int = LOAD_FAILURE_EXPIRY_MS,
+    ) -> bool:
+        """Drop stale failure entries; returns True if anything changed."""
+        now = now if now is not None else now_ms()
+        stale = [
+            iid for iid, (ts, _msg) in self.load_failures.items()
+            if now - ts > expiry_ms
+        ]
+        for iid in stale:
+            del self.load_failures[iid]
+        return bool(stale)
+
+    def active_failure_count(self, now: Optional[int] = None) -> int:
+        now = now if now is not None else now_ms()
+        return sum(
+            1 for ts, _ in self.load_failures.values()
+            if now - ts <= LOAD_FAILURE_EXPIRY_MS
+        )
+
+    def failed_on(self, instance_id: str, now: Optional[int] = None) -> bool:
+        entry = self.load_failures.get(instance_id)
+        if entry is None:
+            return False
+        now = now if now is not None else now_ms()
+        return now - entry[0] <= LOAD_FAILURE_EXPIRY_MS
+
+    def load_exhausted(self, now: Optional[int] = None) -> bool:
+        """Too many failures or too many attempted locations
+        (reference checkLoadFailureCount/checkLoadLocationCount,
+        ModelMesh.java:4590-4607)."""
+        return (
+            self.active_failure_count(now) >= MAX_LOAD_FAILURES
+            or len(self.load_failures) >= MAX_LOAD_LOCATIONS
+        )
+
+    # -- lastUsed laziness ---------------------------------------------------
+
+    # The reference persists lastUsed only when >6-7h stale or piggybacked on
+    # other updates (ModelRecord.java:96-105) to avoid write storms.
+    LAST_USED_PERSIST_STALENESS_MS = 6 * 3600 * 1000
+
+    def should_persist_last_used(self, observed_last_used: int) -> bool:
+        return (
+            observed_last_used - self.last_used
+            > self.LAST_USED_PERSIST_STALENESS_MS
+        )
+
+
+@dataclasses.dataclass
+class InstanceRecord(Record):
+    start_ts: int = 0
+    lru_ts: int = 0              # oldest cache-entry timestamp (0 = empty)
+    model_count: int = 0
+    capacity_units: int = 0
+    used_units: int = 0
+    loading_threads: int = 0
+    loading_in_progress: int = 0
+    req_per_minute: int = 0
+    shutting_down: bool = False
+    location: str = ""           # node/host for anti-affinity
+    zone: str = ""
+    labels: list[str] = dataclasses.field(default_factory=list)
+    instance_version: str = ""   # deployment version for upgrade tracking
+    version: int = 0
+
+    @property
+    def free_units(self) -> int:
+        return max(self.capacity_units - self.used_units, 0)
+
+    @property
+    def full_fraction(self) -> float:
+        return self.used_units / self.capacity_units if self.capacity_units else 1.0
+
+    def placement_sort_key(self) -> tuple:
+        """The reference's PLACEMENT_ORDER (ModelMesh.java:4646): prefer most
+        free space, break ties by oldest LRU (cheapest eviction)."""
+        return (-self.free_units, self.lru_ts if self.lru_ts else 0)
+
+
+@dataclasses.dataclass
+class VModelRecord(Record):
+    owner: str = ""
+    active_model: str = ""
+    target_model: str = ""
+    target_load_failed: bool = False
+    version: int = 0
+
+    @property
+    def in_transition(self) -> bool:
+        return bool(self.target_model) and self.target_model != self.active_model

@@ -1,0 +1,216 @@
+"""Tests for the KV coordination substrate (store, table, session, config).
+
+Mirrors the seams the reference tests lean on: versioned CAS loops, prefix
+watches feeding local views, ephemeral liveness keys, leader handover
+(SURVEY.md sections 4, 5.3).
+"""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from modelmesh_tpu.kv import (
+    CasFailed,
+    Compare,
+    DynamicConfig,
+    EventType,
+    InMemoryKV,
+    KVTable,
+    LeaderElection,
+    Op,
+    Record,
+    SessionNode,
+    TableEvent,
+    TableView,
+)
+
+
+@pytest.fixture()
+def kv():
+    store = InMemoryKV(sweep_interval_s=0.05)
+    yield store
+    store.close()
+
+
+class TestStore:
+    def test_put_get_versions(self, kv):
+        kv1 = kv.put("a", b"1")
+        assert (kv1.version, kv1.create_rev) == (1, kv1.mod_rev)
+        kv2 = kv.put("a", b"2")
+        assert kv2.version == 2
+        assert kv2.create_rev == kv1.create_rev
+        assert kv2.mod_rev > kv1.mod_rev
+
+    def test_range_sorted(self, kv):
+        for k in ["p/b", "p/a", "q/x", "p/c"]:
+            kv.put(k, b"v")
+        assert [x.key for x in kv.range("p/")] == ["p/a", "p/b", "p/c"]
+
+    def test_cas_put(self, kv):
+        kv.put_if_version("a", b"1", 0)  # create
+        with pytest.raises(CasFailed):
+            kv.put_if_version("a", b"x", 0)
+        kv.put_if_version("a", b"2", 1)
+        assert kv.get("a").value == b"2"
+
+    def test_txn_multi_key(self, kv):
+        kv.put("m/1", b"model")
+        ok, _ = kv.txn(
+            [Compare("m/1", 1), Compare("v/1", 0)],
+            [Op("v/1", b"vmodel"), Op("m/1", b"model2")],
+        )
+        assert ok and kv.get("v/1").value == b"vmodel"
+        ok, _ = kv.txn([Compare("m/1", 1)], [Op("m/1", b"nope")])
+        assert not ok
+        assert kv.get("m/1").value == b"model2"
+
+    def test_watch_stream_and_replay(self, kv):
+        got = []
+        kv.put("w/a", b"1")
+        kv.watch("w/", lambda evs: got.extend(evs), start_rev=0)
+        kv.put("w/b", b"2")
+        kv.delete("w/a")
+        kv.wait_idle()
+        types = [(e.type, e.kv.key) for e in got]
+        assert (EventType.PUT, "w/a") in types      # replayed
+        assert (EventType.PUT, "w/b") in types      # streamed
+        assert (EventType.DELETE, "w/a") in types
+
+    def test_lease_expiry_deletes_keys(self, kv):
+        lease = kv.lease_grant(0.15)
+        kv.put("eph/x", b"v", lease=lease)
+        assert kv.get("eph/x") is not None
+        time.sleep(0.4)
+        assert kv.get("eph/x") is None
+
+    def test_lease_keepalive_extends(self, kv):
+        lease = kv.lease_grant(0.2)
+        kv.put("eph/y", b"v", lease=lease)
+        for _ in range(4):
+            time.sleep(0.1)
+            assert kv.lease_keepalive(lease)
+        assert kv.get("eph/y") is not None
+        kv.lease_revoke(lease)
+        assert kv.get("eph/y") is None
+
+
+@dataclasses.dataclass
+class _Rec(Record):
+    name: str = ""
+    count: int = 0
+    version: int = 0
+
+
+class TestTable:
+    def test_roundtrip_and_cas(self, kv):
+        t = KVTable(kv, "registry", _Rec)
+        r = _Rec(name="m1", count=1)
+        t.conditional_set("m1", r)
+        assert r.version == 1
+        r2 = t.get("m1")
+        assert (r2.name, r2.count, r2.version) == ("m1", 1, 1)
+        # concurrent writer wins
+        other = t.get("m1")
+        other.count = 5
+        t.conditional_set("m1", other)
+        r2.count = 9
+        with pytest.raises(CasFailed):
+            t.conditional_set("m1", r2)
+
+    def test_update_or_create_retry_loop(self, kv):
+        t = KVTable(kv, "registry", _Rec)
+        n_threads, n_incr = 4, 25
+        t.conditional_set("ctr", _Rec(name="ctr", count=0))
+
+        def bump(cur):
+            cur.count += 1
+            return cur
+
+        def worker():
+            for _ in range(n_incr):
+                t.update_or_create("ctr", bump)
+
+        ths = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        assert t.get("ctr").count == n_threads * n_incr
+
+    def test_view_follows_changes(self, kv):
+        t = KVTable(kv, "registry", _Rec)
+        t.put("pre", _Rec(name="pre"))
+        view = TableView(t)
+        events = []
+        view.add_listener(lambda ev, id_, rec: events.append((ev, id_)))
+        assert view.get("pre").name == "pre"
+        t.put("m1", _Rec(name="m1"))
+        view.wait_for(lambda v: "m1" in v)
+        t.put("m1", _Rec(name="m1", count=2))
+        view.wait_for(lambda v: v.get("m1").count == 2)
+        t.delete("m1")
+        view.wait_for(lambda v: "m1" not in v)
+        assert (TableEvent.ADDED, "m1") in events
+        assert (TableEvent.UPDATED, "m1") in events
+        assert (TableEvent.DELETED, "m1") in events
+        view.close()
+
+
+class TestSession:
+    def test_session_node_lives_and_dies(self, kv):
+        node = SessionNode(kv, "instances/i1", b"rec", ttl_s=0.3)
+        node.start()
+        time.sleep(1.0)  # several TTLs: keepalive must sustain it
+        assert kv.get("instances/i1") is not None
+        node.close()
+        time.sleep(0.1)
+        assert kv.get("instances/i1") is None
+
+    def test_session_node_recovers_lost_lease(self, kv):
+        node = SessionNode(kv, "instances/i2", b"rec", ttl_s=0.3,
+                           keepalive_interval_s=0.1)
+        node.start()
+        # Simulate KV-side lease loss (e.g. etcd restart).
+        kv.lease_revoke(node._lease)
+        time.sleep(0.5)
+        assert kv.get("instances/i2") is not None
+        node.close()
+
+    def test_leader_election_handover(self, kv):
+        changes = {"a": [], "b": []}
+        ea = LeaderElection(kv, "leader", "a", changes["a"].append, ttl_s=0.3)
+        eb = LeaderElection(kv, "leader", "b", changes["b"].append, ttl_s=0.3)
+        ea.start()
+        time.sleep(0.1)
+        eb.start()
+        time.sleep(0.2)
+        assert ea.is_leader and not eb.is_leader
+        ea.close()  # leader departs -> b takes over
+        deadline = time.monotonic() + 3
+        while not eb.is_leader and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert eb.is_leader
+        assert changes["a"] == [True, False]
+        assert changes["b"][-1] is True
+        eb.close()
+
+
+class TestDynamicConfig:
+    def test_live_updates_and_typed_getters(self, kv):
+        kv.put("svc/config/scaleup_rpm_threshold", b"2000")
+        cfg = DynamicConfig(kv, "svc/config")
+        seen = []
+        cfg.add_listener(lambda k, v: seen.append((k, v)))
+        assert cfg.get_int("scaleup_rpm_threshold", 0) == 2000
+        assert cfg.get_bool("log_each_invocation", False) is False
+        kv.put("svc/config/log_each_invocation", b"true")
+        kv.wait_idle()
+        assert cfg.get_bool("log_each_invocation", False) is True
+        kv.delete("svc/config/log_each_invocation")
+        kv.wait_idle()
+        assert cfg.get_bool("log_each_invocation", False) is False
+        assert ("log_each_invocation", "true") in seen
+        assert ("log_each_invocation", None) in seen
+        cfg.close()

@@ -1,0 +1,235 @@
+"""Property + behavior tests for the weighted timestamp-LRU cache.
+
+Modeled on the reference's reliance on clhm semantics (SURVEY.md section 2.2):
+backdated inserts, quiet gets, forced timestamps, weighted eviction with
+listener-under-lock, descending iteration with cutoff.
+"""
+
+import random
+import threading
+
+import pytest
+
+from modelmesh_tpu.cache import WeightedLRUCache
+
+
+class TestBasics:
+    def test_put_get(self):
+        c = WeightedLRUCache(100)
+        assert c.put_if_absent("a", 1, 10, last_used=1000) is None
+        assert c.put_if_absent("a", 2, 10, last_used=2000) == 1
+        assert c.get("a") == 1
+        assert c.weight == 10
+        assert len(c) == 1
+
+    def test_eviction_by_lru_order(self):
+        evicted = []
+        c = WeightedLRUCache(30, eviction_listener=lambda k, v, ts: evicted.append((k, ts)))
+        c.put_if_absent("a", "A", 10, last_used=100)
+        c.put_if_absent("b", "B", 10, last_used=200)
+        c.put_if_absent("c", "C", 10, last_used=300)
+        c.put_if_absent("d", "D", 10, last_used=400)  # evicts a (oldest)
+        assert evicted == [("a", 100)]
+        assert "a" not in c and "d" in c
+
+    def test_new_entry_never_self_evicted(self):
+        evicted = []
+        c = WeightedLRUCache(30, eviction_listener=lambda k, v, ts: evicted.append(k))
+        c.put_if_absent("a", "A", 10, last_used=5000)
+        c.put_if_absent("b", "B", 25, last_used=1)  # older than a, but new
+        assert "b" in c
+        assert evicted == ["a"]
+
+    def test_oversized_entry_rejected(self):
+        c = WeightedLRUCache(30)
+        with pytest.raises(ValueError):
+            c.put_if_absent("big", "X", 31)
+
+    def test_backdated_insert_is_first_victim(self):
+        evicted = []
+        c = WeightedLRUCache(30, eviction_listener=lambda k, v, ts: evicted.append(k))
+        c.put_if_absent("fresh1", 1, 10, last_used=10_000)
+        c.put_if_absent("fresh2", 2, 10, last_used=11_000)
+        c.put_if_absent("old", 3, 10, last_used=500)  # backdated registration
+        c.put_if_absent("fresh3", 4, 10, last_used=12_000)
+        assert evicted == ["old"]
+
+
+class TestTimestamps:
+    def test_get_touches_quiet_get_does_not(self):
+        c = WeightedLRUCache(100)
+        c.put_if_absent("a", 1, 10, last_used=1000)
+        c.get_quietly("a")
+        assert c.last_used("a") == 1000
+        c.get("a", touch_ts=5000)
+        assert c.last_used("a") == 5000
+
+    def test_plain_get_never_moves_backwards(self):
+        c = WeightedLRUCache(100)
+        c.put_if_absent("a", 1, 10, last_used=9000)
+        c.get("a", touch_ts=100)
+        assert c.last_used("a") == 9000
+
+    def test_force_last_used_moves_backwards(self):
+        c = WeightedLRUCache(100)
+        c.put_if_absent("a", 1, 10, last_used=9000)
+        assert c.force_last_used("a", 100)
+        assert c.last_used("a") == 100
+        c.put_if_absent("b", 2, 95)  # evicts a (now oldest)
+        assert "a" not in c
+
+    def test_oldest_time_tracks_touches(self):
+        c = WeightedLRUCache(100)
+        c.put_if_absent("a", 1, 10, last_used=100)
+        c.put_if_absent("b", 2, 10, last_used=200)
+        assert c.oldest_time() == 100
+        c.get("a", touch_ts=300)
+        assert c.oldest_time() == 200
+
+    def test_oldest_time_empty(self):
+        assert WeightedLRUCache(10).oldest_time() is None
+
+
+class TestReplaceAndWeights:
+    def test_replace_quietly_cas(self):
+        c = WeightedLRUCache(100)
+        old, new = object(), object()
+        c.put_if_absent("a", old, 10, last_used=1000)
+        assert not c.replace_quietly("a", new, new)  # wrong expected
+        assert c.replace_quietly("a", old, new)
+        assert c.get_quietly("a") is new
+        assert c.last_used("a") == 1000  # quiet
+
+    def test_remove_if_value(self):
+        c = WeightedLRUCache(100)
+        v = object()
+        c.put_if_absent("a", v, 10)
+        assert not c.remove_if_value("a", object())
+        assert c.remove_if_value("a", v)
+        assert c.weight == 0
+
+    def test_update_weight_grow_evicts_others(self):
+        evicted = []
+        c = WeightedLRUCache(30, eviction_listener=lambda k, v, ts: evicted.append(k))
+        c.put_if_absent("a", 1, 10, last_used=100)
+        c.put_if_absent("b", 2, 10, last_used=200)
+        assert c.update_weight("b", 25) == 10  # sizing: grew after load
+        assert evicted == ["a"]
+        assert c.weight == 25
+
+    def test_update_weight_shrink(self):
+        c = WeightedLRUCache(30)
+        c.put_if_absent("a", 1, 20)
+        assert c.update_weight("a", 5) == 20
+        assert c.weight == 5
+
+
+class TestIteration:
+    def test_descending_and_cutoff(self):
+        c = WeightedLRUCache(1000)
+        for i, ts in enumerate([500, 100, 900, 300]):
+            c.put_if_absent(f"k{i}", i, 10, last_used=ts)
+        order = [k for k, _, _ in c.descending_items()]
+        assert order == ["k2", "k0", "k3", "k1"]
+        recent = [k for k, _, _ in c.items_used_since(300)]
+        assert recent == ["k2", "k0", "k3"]
+        asc = [k for k, _, _ in c.ascending_items()]
+        assert asc == order[::-1]
+
+
+class TestPropertyVsModel:
+    """Randomized ops vs a naive reference model."""
+
+    def test_random_ops_match_model(self):
+        rng = random.Random(1234)
+        cap = 200
+        evicted_real: list = []
+        c = WeightedLRUCache(cap, eviction_listener=lambda k, v, ts: evicted_real.append(k))
+        model: dict[str, tuple[int, int, int]] = {}  # key -> (val, weight, ts)
+        seq = [0]
+
+        def model_evict(exclude=None):
+            while sum(w for _, w, _ in model.values()) > cap and model:
+                # victim: smallest (ts, insertion seq) excluding `exclude`
+                cands = [
+                    (ts, s, k)
+                    for k, (_v, _w, (ts, s)) in model.items()
+                    if k != exclude
+                ]
+                if not cands:
+                    return
+                cands.sort()
+                _, _, victim = cands[0]
+                del model[victim]
+                evicted_model.append(victim)
+
+        evicted_model: list = []
+        t = 1000
+        for _ in range(3000):
+            t += rng.randint(0, 10)
+            op = rng.random()
+            key = f"k{rng.randint(0, 60)}"
+            if op < 0.45:
+                w = rng.randint(1, 40)
+                got = c.put_if_absent(key, key + "v", w, last_used=t)
+                if key not in model and got is None:
+                    seq[0] += 1
+                    model[key] = (key + "v", w, (t, seq[0]))
+                    model_evict(exclude=key)
+            elif op < 0.70:
+                c.get(key, touch_ts=t)
+                if key in model:
+                    v, w, (ts0, s0) = model[key]
+                    if t > ts0:
+                        model[key] = (v, w, (t, s0))
+            elif op < 0.80:
+                c.remove(key)
+                model.pop(key, None)
+            elif op < 0.90:
+                ts_new = rng.randint(0, t)
+                c.force_last_used(key, ts_new)
+                if key in model:
+                    v, w, (_, s0) = model[key]
+                    model[key] = (v, w, (ts_new, s0))
+            else:
+                w = rng.randint(1, 40)
+                c.update_weight(key, w)
+                if key in model:
+                    v, _, tss = model[key]
+                    model[key] = (v, w, tss)
+                    model_evict(exclude=key)
+
+            assert set(c.keys()) == set(model.keys()), "key sets diverged"
+            assert c.weight == sum(w for _, w, _ in model.values())
+            if model:
+                oldest_model = min((ts, s) for _, _, (ts, s) in model.values())[0]
+                assert c.oldest_time() == oldest_model
+
+    def test_concurrent_smoke(self):
+        c = WeightedLRUCache(500)
+        errs = []
+
+        def worker(wid):
+            try:
+                rng = random.Random(wid)
+                for i in range(400):
+                    k = f"k{rng.randint(0, 30)}"
+                    op = rng.random()
+                    if op < 0.5:
+                        c.put_if_absent(k, k, rng.randint(1, 30))
+                    elif op < 0.8:
+                        c.get(k)
+                    else:
+                        c.remove(k)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs
+        assert c.weight <= 500
+        # Accounting consistent with actual entries.
+        assert c.weight == sum(e.weight for e in c._entries.values())

@@ -150,3 +150,13 @@ class TestSolveEndToEnd:
         p2 = ops.random_problem(jax.random.PRNGKey(31), 64, 8)
         ops.solve_placement(p2)
         assert ops.solve_placement._cache_size() == n0
+
+    def test_seed_varies_without_retrace(self):
+        # The rounding seed is traced: different seeds = different draws,
+        # same compiled program (janitor passes must not recompile).
+        p = ops.random_problem(jax.random.PRNGKey(37), 256, 16)
+        a = ops.solve_placement(p, seed=1)
+        n0 = ops.solve_placement._cache_size()
+        b = ops.solve_placement(p, seed=2)
+        assert ops.solve_placement._cache_size() == n0
+        assert not np.array_equal(np.asarray(a.indices), np.asarray(b.indices))

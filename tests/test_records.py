@@ -1,0 +1,90 @@
+"""Record schema + CAS behavior tests."""
+
+import dataclasses
+
+import pytest
+
+from modelmesh_tpu.kv import CasFailed, InMemoryKV, KVTable
+from modelmesh_tpu.records import (
+    InstanceRecord,
+    ModelRecord,
+    VModelRecord,
+)
+
+
+@pytest.fixture()
+def kv():
+    store = InMemoryKV()
+    yield store
+    store.close()
+
+
+class TestModelRecord:
+    def test_roundtrip(self, kv):
+        t = KVTable(kv, "registry", ModelRecord)
+        mr = ModelRecord(model_type="classifier", model_path="s3://m/1")
+        mr.add_instance("i1", ts=1000)
+        mr.add_load_failure("i2", "OOM", ts=2000)
+        t.conditional_set("m1", mr)
+        back = t.get("m1")
+        assert back.instance_ids == {"i1": 1000}
+        assert back.load_failures == {"i2": [2000, "OOM"]}
+        assert back.copy_count == 1
+
+    def test_failure_expiry_and_exhaustion(self):
+        mr = ModelRecord()
+        now = 10_000_000
+        mr.add_load_failure("i1", "x", ts=now - 16 * 60 * 1000)  # stale
+        mr.add_load_failure("i2", "y", ts=now)
+        assert mr.active_failure_count(now) == 1
+        assert mr.expire_load_failures(now)
+        assert list(mr.load_failures) == ["i2"]
+        assert not mr.load_exhausted(now)
+        mr.add_load_failure("i3", "z", ts=now)
+        mr.add_load_failure("i4", "w", ts=now)
+        assert mr.load_exhausted(now)  # 3 active failures
+        assert mr.failed_on("i2", now) and not mr.failed_on("i9", now)
+
+    def test_lazy_last_used(self):
+        mr = ModelRecord(last_used=1_000)
+        assert not mr.should_persist_last_used(1_000 + 3600 * 1000)
+        assert mr.should_persist_last_used(1_000 + 7 * 3600 * 1000)
+
+    def test_cas_conflict_on_concurrent_placement(self, kv):
+        t = KVTable(kv, "registry", ModelRecord)
+        t.conditional_set("m", ModelRecord(model_type="t"))
+        a, b = t.get("m"), t.get("m")
+        a.add_instance("i1")
+        t.conditional_set("m", a)
+        b.add_instance("i2")
+        with pytest.raises(CasFailed):
+            t.conditional_set("m", b)
+        # retry loop resolves
+        merged = t.update_or_create(
+            "m", lambda cur: (cur.add_instance("i2"), cur)[1]
+        )
+        assert set(merged.instance_ids) == {"i1", "i2"}
+
+
+class TestInstanceRecord:
+    def test_placement_order(self):
+        # Most free space first; oldest LRU breaks ties.
+        a = InstanceRecord(capacity_units=100, used_units=20, lru_ts=500)
+        b = InstanceRecord(capacity_units=100, used_units=50, lru_ts=100)
+        c = InstanceRecord(capacity_units=100, used_units=50, lru_ts=50)
+        order = sorted([a, b, c], key=lambda r: r.placement_sort_key())
+        assert order == [a, c, b]
+
+    def test_free_and_full(self):
+        r = InstanceRecord(capacity_units=100, used_units=120)
+        assert r.free_units == 0
+        assert r.full_fraction == 1.2
+        assert InstanceRecord().full_fraction == 1.0
+
+
+class TestVModelRecord:
+    def test_transition_flag(self):
+        v = VModelRecord(active_model="m-v1", target_model="m-v1")
+        assert not v.in_transition
+        v.target_model = "m-v2"
+        assert v.in_transition

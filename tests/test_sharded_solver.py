@@ -45,6 +45,81 @@ class TestShardedSolver:
         demand = float(jnp.sum(problem.sizes * problem.copies))
         assert float(sol.overflow) < 0.05 * demand
 
+    def test_soft_pipeline_parity_with_single_device(self, problem):
+        # The hand-duplicated cost and Sinkhorn formulas in the sharded
+        # kernel must stay numerically in lockstep with ops.costs /
+        # ops.sinkhorn. (The integral rounding stage is NOT identity-
+        # comparable: its price feedback is chaotic under bf16 score ties,
+        # so 1-ULP differences legitimately yield different — equally good —
+        # plans; quality parity is asserted separately below.)
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from modelmesh_tpu.ops.costs import CostWeights
+        from modelmesh_tpu.ops.sinkhorn import sinkhorn
+        from modelmesh_tpu.parallel import sharded_solver as ss
+
+        C_single = np.asarray(ops.assemble_cost(problem, dtype=jnp.float32))
+        copies = jnp.minimum(problem.copies, ops.MAX_COPIES)
+        row_mass = problem.sizes * copies
+        free = jnp.maximum(problem.capacity - problem.reserved, 0.0)
+        sk = sinkhorn(ops.assemble_cost(problem), row_mass, free,
+                      eps=0.05, iters=10)
+
+        mesh = mesh_mod.make_mesh((4, 2))
+        pp = shard_problem(problem, mesh)
+
+        def kern(prob):
+            Cb = ss._cost_block(prob, CostWeights(), jnp.float32)
+            cps = jnp.minimum(prob.copies, ops.MAX_COPIES)
+            f, g, _ = ss._sharded_sinkhorn(
+                ss._cost_block(prob, CostWeights(), jnp.bfloat16),
+                prob.sizes * cps,
+                jnp.maximum(prob.capacity - prob.reserved, 0.0),
+                0.05,
+                10,
+            )
+            return Cb, f, g
+
+        C_sh, f_sh, g_sh = jax.jit(
+            jax.shard_map(
+                kern,
+                mesh=mesh,
+                in_specs=(mesh_mod.problem_pspec(),),
+                out_specs=(
+                    P(mesh_mod.MODEL_AXIS, mesh_mod.INSTANCE_AXIS),
+                    P(mesh_mod.MODEL_AXIS),
+                    P(mesh_mod.INSTANCE_AXIS),
+                ),
+                check_vma=False,
+            )
+        )(pp)
+        np.testing.assert_array_equal(C_single, np.asarray(C_sh))
+        np.testing.assert_allclose(np.asarray(sk.f), np.asarray(f_sh), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(sk.g), np.asarray(g_sh), atol=1e-5)
+
+    def test_quality_parity_with_single_device(self, problem):
+        # Integral plans differ (see above) but must be equally good:
+        # same total placed mass, comparable overflow.
+        single = ops.solve_placement(problem)
+        mesh = mesh_mod.make_mesh((4, 2))
+        sharded = make_sharded_solver(mesh)(shard_problem(problem, mesh))
+        total_s = float(np.asarray(single.load).sum())
+        total_d = float(np.asarray(sharded.load).sum())
+        np.testing.assert_allclose(total_s, total_d, rtol=1e-5)
+        demand = float(np.sum(np.asarray(problem.sizes) * np.asarray(
+            np.minimum(problem.copies, ops.MAX_COPIES))))
+        assert float(single.overflow) < 0.05 * demand
+        assert float(sharded.overflow) < 0.05 * demand
+
+    def test_seed_varies_without_retrace(self, problem):
+        mesh = mesh_mod.make_mesh((8, 1))
+        solver = make_sharded_solver(mesh)
+        p = shard_problem(problem, mesh)
+        a = solver(p, seed=1)
+        b = solver(p, seed=2)
+        assert not np.array_equal(np.asarray(a.indices), np.asarray(b.indices))
+
     def test_load_accounting_matches(self, problem):
         # The psum'd load must equal a host-side recount of the assignment.
         mesh = mesh_mod.make_mesh((8, 1))

@@ -1,0 +1,17 @@
+"""Placement strategies: the SPI, greedy oracle, and JAX global solver."""
+
+from modelmesh_tpu.placement.greedy import GreedyStrategy
+from modelmesh_tpu.placement.strategy import (
+    LOAD_HERE,
+    ClusterView,
+    PlacementRequest,
+    PlacementStrategy,
+)
+
+__all__ = [
+    "GreedyStrategy",
+    "LOAD_HERE",
+    "ClusterView",
+    "PlacementRequest",
+    "PlacementStrategy",
+]

@@ -1,0 +1,83 @@
+"""Greedy placement: functional re-derivation of the reference heuristics.
+
+Default strategy and the correctness oracle for the JAX strategy. Decision
+rules re-derived from (not copied out of) the reference:
+
+- Load placement (cache-miss LB, ModelMesh.java:4757-5004): rank live,
+  non-excluded instances by PLACEMENT_ORDER — most free capacity first,
+  then oldest cache LRU (cheapest eviction); shortlist everything "close"
+  to the best (within a free-space ratio and an LRU window); among the
+  shortlist prefer the least busy. If the requester itself is in the
+  shortlist, it loads locally (saves a hop).
+- Serve balancing (ForwardingLB, ModelMesh.java:4309-4393): among loaded,
+  live, non-excluded copies prefer the least busy instance; copies loaded
+  long ago are preferred to freshly-loading ones.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from modelmesh_tpu.cache.lru import now_ms
+from modelmesh_tpu.placement.strategy import (
+    LOAD_HERE,
+    ClusterView,
+    PlacementRequest,
+    PlacementStrategy,
+)
+from modelmesh_tpu.records import InstanceRecord, ModelRecord
+
+# Shortlist thresholds (tunable analogs of the reference's proximity rules).
+FREE_SPACE_SHORTLIST_RATIO = 0.75   # candidates with >= 75% of best free
+LRU_SHORTLIST_WINDOW_MS = 5 * 60_000
+# A copy loaded less than this ago may still be warming (reference uses
+# per-type load-time stats, TimeStats; a flat floor is the simple analog).
+RECENT_LOAD_PENALTY_MS = 10_000
+
+
+class GreedyStrategy(PlacementStrategy):
+    def choose_load_target(
+        self, req: PlacementRequest, view: ClusterView
+    ) -> Optional[str]:
+        candidates: list[tuple[str, InstanceRecord]] = [
+            (iid, rec)
+            for iid, rec in view.live()
+            if iid not in req.exclude and iid not in req.model.instance_ids
+        ]
+        if not candidates:
+            return None
+        with_room = [
+            (iid, rec) for iid, rec in candidates
+            if rec.free_units >= req.required_units
+        ]
+        pool = with_room or candidates  # full cluster: evict somewhere
+        best_free = max(rec.free_units for _, rec in pool)
+        oldest_lru = min(
+            (rec.lru_ts or 0) for _, rec in pool
+        )
+        shortlist = [
+            (iid, rec) for iid, rec in pool
+            if rec.free_units >= best_free * FREE_SPACE_SHORTLIST_RATIO
+            or (rec.lru_ts or 0) <= oldest_lru + LRU_SHORTLIST_WINDOW_MS
+        ] or pool
+        if any(iid == req.requesting_instance for iid, _ in shortlist):
+            return LOAD_HERE
+        # Least busy; stable tie-break on free space then id.
+        shortlist.sort(key=lambda p: (p[1].req_per_minute, -p[1].free_units, p[0]))
+        return shortlist[0][0]
+
+    def choose_serve_target(
+        self, model: ModelRecord, view: ClusterView, exclude: frozenset[str]
+    ) -> Optional[str]:
+        live = {iid: rec for iid, rec in view.live()}
+        now = now_ms()
+        candidates: list[tuple[tuple, str]] = []
+        for iid, load_ts in model.instance_ids.items():
+            if iid in exclude or iid not in live:
+                continue
+            warming = now - load_ts < RECENT_LOAD_PENALTY_MS
+            candidates.append(((warming, live[iid].req_per_minute, iid), iid))
+        if not candidates:
+            return None
+        candidates.sort()
+        return candidates[0][1]

@@ -1,0 +1,61 @@
+"""PlacementStrategy SPI: every placement decision behind one interface.
+
+The architectural departure from the reference (SURVEY.md section 7): the
+reference hardcodes its greedy heuristics inline (PLACEMENT_ORDER
+ModelMesh.java:4646, CacheMissForwardingLB :4757-5004, janitor scale-down
+:6197-6379, reaper proactive loads :6616-6747). Here those decisions are
+pluggable: ``greedy`` reproduces the reference behavior as the default and
+correctness oracle; ``jax`` (placement/jax_engine.py) solves the global
+assignment on TPU and serves plans from which per-request decisions read.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Optional, Sequence
+
+from modelmesh_tpu.records import InstanceRecord, ModelRecord
+
+# Sentinel: "load on the requesting instance itself" (the reference's
+# ABORT_REQUEST path meaning 'you take it', ModelMesh.java:4987-5004).
+LOAD_HERE = "<here>"
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementRequest:
+    model_id: str
+    model: ModelRecord
+    required_units: int
+    requesting_instance: str
+    exclude: frozenset[str] = frozenset()
+    last_used_ms: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterView:
+    """Snapshot of live instances (from the instances TableView)."""
+
+    instances: Sequence[tuple[str, InstanceRecord]]
+
+    def live(self) -> list[tuple[str, InstanceRecord]]:
+        return [(i, r) for i, r in self.instances if not r.shutting_down]
+
+
+class PlacementStrategy(abc.ABC):
+    @abc.abstractmethod
+    def choose_load_target(
+        self, req: PlacementRequest, view: ClusterView
+    ) -> Optional[str]:
+        """Pick the instance that should load a new copy.
+
+        Returns an instance id, LOAD_HERE (requester loads it), or None
+        (nowhere to place — caller surfaces NoCapacityError).
+        """
+
+    @abc.abstractmethod
+    def choose_serve_target(
+        self, model: ModelRecord, view: ClusterView,
+        exclude: frozenset[str],
+    ) -> Optional[str]:
+        """Pick a loaded copy to serve a request (cache-hit balancing)."""

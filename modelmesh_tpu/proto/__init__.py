@@ -1,0 +1,10 @@
+"""Generated protobuf modules (protoc --python_out; see protos/*.proto).
+
+gRPC stubs/servicers are hand-built in modelmesh_tpu.runtime.grpc_defs —
+the image has no grpc_tools plugin, and the generic method-map approach
+doubles as the raw-bytes passthrough machinery the data plane needs anyway.
+"""
+
+from modelmesh_tpu.proto import mesh_api_pb2, mesh_internal_pb2, mesh_runtime_pb2
+
+__all__ = ["mesh_api_pb2", "mesh_internal_pb2", "mesh_runtime_pb2"]

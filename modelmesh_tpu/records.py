@@ -39,8 +39,14 @@ class ModelRecord(Record):
     model_type: str = ""
     model_path: str = ""
     model_key: str = ""          # opaque runtime credential/config blob
-    # instance_id -> load timestamp (ms); the authoritative placement map.
+    # instance_id -> load-completion timestamp (ms): copies that are LOADED
+    # and servable. (The reference keeps one map with load-start timestamps
+    # and estimates completion via TimeStats, ModelMesh.java:4351; splitting
+    # the claim from the completion makes status reporting exact.)
     instance_ids: dict[str, int] = dataclasses.field(default_factory=dict)
+    # instance_id -> claim timestamp (ms): copies being loaded right now.
+    # Acts as the placement claim so concurrent placements don't double-load.
+    loading_instances: dict[str, int] = dataclasses.field(default_factory=dict)
     # instance_id -> [failure_ts_ms, message]
     load_failures: dict[str, list] = dataclasses.field(default_factory=dict)
     ref_count: int = 0           # vmodel references
@@ -51,15 +57,31 @@ class ModelRecord(Record):
 
     # -- placements ---------------------------------------------------------
 
-    def add_instance(self, instance_id: str, ts: Optional[int] = None) -> None:
+    def claim_loading(self, instance_id: str, ts: Optional[int] = None) -> None:
+        self.loading_instances[instance_id] = ts if ts is not None else now_ms()
+
+    def promote_loaded(self, instance_id: str, ts: Optional[int] = None) -> None:
+        self.loading_instances.pop(instance_id, None)
         self.instance_ids[instance_id] = ts if ts is not None else now_ms()
 
     def remove_instance(self, instance_id: str) -> bool:
-        return self.instance_ids.pop(instance_id, None) is not None
+        a = self.instance_ids.pop(instance_id, None) is not None
+        b = self.loading_instances.pop(instance_id, None) is not None
+        return a or b
+
+    def placed_on(self, instance_id: str) -> bool:
+        return (
+            instance_id in self.instance_ids
+            or instance_id in self.loading_instances
+        )
+
+    @property
+    def all_placements(self) -> set[str]:
+        return set(self.instance_ids) | set(self.loading_instances)
 
     @property
     def copy_count(self) -> int:
-        return len(self.instance_ids)
+        return len(self.instance_ids) + len(self.loading_instances)
 
     # -- failures -------------------------------------------------------------
 
@@ -130,6 +152,7 @@ class InstanceRecord(Record):
     loading_in_progress: int = 0
     req_per_minute: int = 0
     shutting_down: bool = False
+    endpoint: str = ""           # host:port of the instance's internal RPC
     location: str = ""           # node/host for anti-affinity
     zone: str = ""
     labels: list[str] = dataclasses.field(default_factory=list)

@@ -1,0 +1,19 @@
+"""Model-runtime SPI: loader interface, gRPC sidecar client, fake runtime."""
+
+from modelmesh_tpu.runtime.spi import (
+    CACHE_UNIT_BYTES,
+    LoadedModel,
+    LocalInstanceParams,
+    ModelInfo,
+    ModelLoader,
+    ModelLoadException,
+)
+
+__all__ = [
+    "CACHE_UNIT_BYTES",
+    "LoadedModel",
+    "LocalInstanceParams",
+    "ModelInfo",
+    "ModelLoader",
+    "ModelLoadException",
+]

@@ -1,0 +1,170 @@
+"""Fake model runtime: the test backbone (ExampleModelRuntime equivalent).
+
+A real gRPC server implementing the runtime SPI with simulated load times
+and sizes, plus an arbitrary-method inference endpoint that echoes a
+deterministic "prediction" for whichever model id arrives in metadata.
+Fault injection mirrors what the reference's example runtime supports
+(example/ExampleModelRuntime.java, SURVEY.md section 4): per-model load
+failure, load delay, NOT_FOUND-on-serve (the Triton refresh quirk), and a
+fast mode for cheap tests.
+
+Runnable in-process (tests) or as a subprocess:
+    python -m modelmesh_tpu.runtime.fake --port 8085
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from modelmesh_tpu.proto import mesh_runtime_pb2 as rpb
+from modelmesh_tpu.runtime import grpc_defs
+
+log = logging.getLogger(__name__)
+
+PREDICT_METHOD = "/mmtpu.example.Predictor/Predict"
+
+# Model-id prefixes triggering injected faults (tests construct ids).
+FAIL_LOAD_PREFIX = "fail-load-"
+SLOW_LOAD_PREFIX = "slow-load-"
+NOT_FOUND_SERVE_PREFIX = "vanish-"
+
+
+class FakeRuntimeServicer:
+    """Implements mmtpu.runtime.ModelRuntime."""
+
+    def __init__(
+        self,
+        capacity_bytes: int = 512 << 20,
+        default_size_bytes: int = 8 << 20,
+        load_delay_s: float = 0.0,
+        ready_delay_s: float = 0.0,
+        load_concurrency: int = 8,
+    ):
+        self.capacity_bytes = capacity_bytes
+        self.default_size_bytes = default_size_bytes
+        self.load_delay_s = load_delay_s
+        self._ready_at = time.monotonic() + ready_delay_s
+        self.load_concurrency = load_concurrency
+        self.loaded: dict[str, int] = {}  # model_id -> size
+        self.load_count = 0
+        self.unload_count = 0
+        self._lock = threading.Lock()
+
+    # -- SPI methods ----------------------------------------------------------
+
+    def RuntimeStatus(self, request, context):
+        status = (
+            rpb.RuntimeStatusResponse.READY
+            if time.monotonic() >= self._ready_at
+            else rpb.RuntimeStatusResponse.STARTING
+        )
+        return rpb.RuntimeStatusResponse(
+            status=status,
+            capacity_bytes=self.capacity_bytes,
+            load_concurrency=self.load_concurrency,
+            load_timeout_ms=30_000,
+            default_model_size_bytes=self.default_size_bytes,
+            runtime_version="fake-0.1",
+        )
+
+    def LoadModel(self, request, context):
+        mid = request.model_id
+        if mid.startswith(FAIL_LOAD_PREFIX):
+            context.abort(grpc.StatusCode.INTERNAL, f"injected load failure: {mid}")
+        delay = self.load_delay_s
+        if mid.startswith(SLOW_LOAD_PREFIX):
+            delay = max(delay, 2.0)
+        if delay:
+            time.sleep(delay)
+        size = self._size_for(mid)
+        with self._lock:
+            self.loaded[mid] = size
+            self.load_count += 1
+        return rpb.LoadModelResponse(size_bytes=size)
+
+    def UnloadModel(self, request, context):
+        with self._lock:
+            self.loaded.pop(request.model_id, None)
+            self.unload_count += 1
+        return rpb.UnloadModelResponse()
+
+    def PredictModelSize(self, request, context):
+        return rpb.ModelSizeResponse(size_bytes=self._size_for(request.model_id))
+
+    def ModelSize(self, request, context):
+        size = self.loaded.get(request.model_id, 0)
+        return rpb.ModelSizeResponse(size_bytes=size)
+
+    def _size_for(self, model_id: str) -> int:
+        # Deterministic per-id size: default +/- up to 50%.
+        h = hash(model_id) % 1000
+        return int(self.default_size_bytes * (0.5 + h / 1000.0))
+
+    # -- inference ----------------------------------------------------------
+
+    def predict(self, method: str, request: bytes, context) -> bytes:
+        md = dict(context.invocation_metadata())
+        mid = md.get(grpc_defs.MODEL_ID_HEADER, "")
+        if not mid:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "missing mm-model-id header"
+            )
+        with self._lock:
+            present = mid in self.loaded
+        if not present or mid.startswith(NOT_FOUND_SERVE_PREFIX):
+            # The Triton/MLServer quirk: runtime lost the model
+            # (reference handling at SidecarModelMesh.java:304-322, 961-988).
+            context.abort(grpc.StatusCode.NOT_FOUND, f"model {mid} not loaded")
+        # Deterministic "prediction": classify payload by hash.
+        label = (len(request) + sum(request[:16])) % 10
+        return f"{mid}:category_{label}".encode()
+
+
+def start_fake_runtime(
+    port: int = 0,
+    servicer: Optional[FakeRuntimeServicer] = None,
+    max_workers: int = 16,
+) -> tuple[grpc.Server, int, FakeRuntimeServicer]:
+    """Start on localhost; returns (server, bound_port, servicer)."""
+    servicer = servicer or FakeRuntimeServicer()
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    grpc_defs.add_servicer(
+        server, servicer, grpc_defs.RUNTIME_SERVICE, grpc_defs.RUNTIME_METHODS
+    )
+    server.add_generic_rpc_handlers(
+        (grpc_defs.RawFallbackHandler(servicer.predict),)
+    )
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound, servicer
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=8085)
+    parser.add_argument("--capacity-mb", type=int, default=512)
+    parser.add_argument("--load-delay-s", type=float, default=0.0)
+    parser.add_argument("--ready-delay-s", type=float, default=0.0)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    server, port, _ = start_fake_runtime(
+        args.port,
+        FakeRuntimeServicer(
+            capacity_bytes=args.capacity_mb << 20,
+            load_delay_s=args.load_delay_s,
+            ready_delay_s=args.ready_delay_s,
+        ),
+    )
+    log.info("fake runtime on :%d", port)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

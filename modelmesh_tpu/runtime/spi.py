@@ -1,0 +1,90 @@
+"""Model-loading SPI: what the serving core calls to manage model copies.
+
+Parity with the reference's per-type loading interface
+(MM/ModelLoader.java:36-98: predictSize/modelSize/loadRuntime/unloadModel)
+and the startup parameter block (MM/LocalInstanceParameters.java:26-124).
+Sizes here are plain bytes; the cache's accounting unit (CACHE_UNIT_BYTES)
+is applied by the serving layer.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Generic, Optional, TypeVar
+
+# Cache accounting unit (reference: 8 KiB, ModelLoader.java:37).
+CACHE_UNIT_BYTES = 8 * 1024
+
+T = TypeVar("T")  # runtime handle type for a loaded model
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelInfo:
+    model_type: str
+    model_path: str = ""
+    model_key: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalInstanceParams:
+    """Instance runtime parameters, produced by loader startup.
+
+    Defaults match the reference envelope (BASELINE.md): 8 loading threads,
+    240 s load timeout.
+    """
+
+    capacity_bytes: int
+    load_concurrency: int = 8
+    load_timeout_ms: int = 240_000
+    default_model_size_bytes: int = 1 << 20
+    limit_model_concurrency: bool = False
+
+    @property
+    def capacity_units(self) -> int:
+        return max(self.capacity_bytes // CACHE_UNIT_BYTES, 1)
+
+
+class ModelLoadException(Exception):
+    def __init__(self, message: str, timeout: bool = False):
+        super().__init__(message)
+        self.timeout = timeout
+
+
+class ModelLoader(abc.ABC, Generic[T]):
+    """Per-instance loading SPI. All methods may block; the serving core
+    runs them on its loading pool with timeouts."""
+
+    @abc.abstractmethod
+    def startup(self) -> LocalInstanceParams:
+        """Block until the runtime is ready; return instance parameters
+        (reference: SidecarModelMesh.startup() polling runtimeStatus,
+        SidecarModelMesh.java:157-232)."""
+
+    @abc.abstractmethod
+    def load(self, model_id: str, info: ModelInfo) -> "LoadedModel[T]":
+        """Load; raise ModelLoadException on failure."""
+
+    def predict_size(self, model_id: str, info: ModelInfo) -> int:
+        """Estimated bytes before loading. 0 = unknown."""
+        return 0
+
+    def model_size(self, model_id: str, handle: T) -> int:
+        """Measured bytes of a loaded model. 0 = unknown."""
+        return 0
+
+    def unload(self, model_id: str) -> None:
+        """Release a loaded model. Must be idempotent."""
+
+    @property
+    def requires_unload(self) -> bool:
+        """True if capacity isn't freed until unload completes (drives the
+        unload-buffer accounting, ModelCacheUnloadBufManager)."""
+        return True
+
+
+@dataclasses.dataclass
+class LoadedModel(Generic[T]):
+    handle: T
+    size_bytes: int = 0            # 0 = needs post-load sizing
+    max_concurrency: int = 0       # 0 = unlimited

@@ -1,0 +1,372 @@
+"""gRPC surfaces of a serving instance.
+
+Three handlers on one server (reference: ModelMeshApi.java single server
+with management service + arbitrary-method fallback; internal thrift service
+replaced by the MeshInternal gRPC service):
+
+- mmtpu.api.ModelMesh        — management (register/status/vmodels)
+- mmtpu.internal.MeshInternal — instance-to-instance forwarding
+- raw fallback handler        — ANY other unary method is inference: model id
+  from mm-model-id / mm-vmodel-id metadata, payload passed through opaque
+  (zero-copy equivalent of ModelMeshApi.startCall :649-819)
+
+Also provides the client side: ``grpc_peer_call`` used as the instance's
+peer transport, with mesh errors mapped onto gRPC status + a detail header.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from modelmesh_tpu.proto import mesh_api_pb2 as apb
+from modelmesh_tpu.proto import mesh_internal_pb2 as ipb
+from modelmesh_tpu.runtime import grpc_defs
+from modelmesh_tpu.runtime.spi import ModelInfo
+from modelmesh_tpu.serving.errors import (
+    ApplierError,
+    ModelLoadException,
+    ModelNotFoundError,
+    ModelNotHereError,
+    NoCapacityError,
+    ServiceUnavailableError,
+)
+from modelmesh_tpu.serving.instance import (
+    InvokeResult,
+    ModelMeshInstance,
+    RoutingContext,
+)
+
+log = logging.getLogger(__name__)
+
+ERROR_HEADER = "mm-error"
+_ERR_NOT_HERE = "model-not-here"
+_ERR_NO_CAPACITY = "no-capacity"
+
+_STATUS_MAP = {
+    "NOT_FOUND": apb.NOT_FOUND,
+    "NOT_LOADED": apb.NOT_LOADED,
+    "LOADING": apb.LOADING,
+    "LOADED": apb.LOADED,
+    "LOADING_FAILED": apb.LOADING_FAILED,
+}
+
+
+def _ctx_to_proto(ctx: RoutingContext) -> ipb.RoutingContext:
+    return ipb.RoutingContext(
+        hop=ctx.hop,
+        exclude_serve=sorted(ctx.exclude_serve),
+        exclude_load=sorted(ctx.exclude_load),
+        visited=sorted(ctx.visited),
+        dest_instance=ctx.dest_instance,
+        chain_load_count=ctx.chain_load_count,
+        known_size_bytes=ctx.known_size_bytes,
+        last_used_ms=ctx.last_used_ms,
+    )
+
+
+def _ctx_from_proto(p: ipb.RoutingContext) -> RoutingContext:
+    return RoutingContext(
+        hop=p.hop,
+        exclude_serve=set(p.exclude_serve),
+        exclude_load=set(p.exclude_load),
+        visited=set(p.visited),
+        dest_instance=p.dest_instance,
+        chain_load_count=p.chain_load_count,
+        known_size_bytes=p.known_size_bytes,
+        last_used_ms=p.last_used_ms,
+    )
+
+
+class MeshApiServicer:
+    """mmtpu.api.ModelMesh implementation."""
+
+    def __init__(self, instance: ModelMeshInstance, vmodels=None):
+        self.instance = instance
+        self.vmodels = vmodels  # VModelManager, optional
+
+    def _status_info(self, model_id: str) -> apb.ModelStatusInfo:
+        status, mr = self.instance.get_status(model_id)
+        errors = []
+        if mr is not None:
+            errors = [msg for _, msg in mr.load_failures.values()]
+        return apb.ModelStatusInfo(
+            status=_STATUS_MAP.get(status, apb.UNKNOWN),
+            errors=errors,
+            model_id=model_id,
+            copy_count=mr.copy_count if mr else 0,
+        )
+
+    @staticmethod
+    def _require_id(id_: str, context, what: str = "model_id") -> None:
+        if not id_ or "/" in id_:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"{what} must be non-empty and must not contain '/'",
+            )
+
+    def RegisterModel(self, request, context):
+        self._require_id(request.model_id, context)
+        info = ModelInfo(
+            model_type=request.info.model_type,
+            model_path=request.info.model_path,
+            model_key=request.info.model_key,
+        )
+        try:
+            self.instance.register_model(
+                request.model_id, info,
+                load_now=request.load_now, sync=request.sync,
+            )
+        except Exception as e:  # noqa: BLE001 — map to status
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return self._status_info(request.model_id)
+
+    def UnregisterModel(self, request, context):
+        self._require_id(request.model_id, context)
+        self.instance.unregister_model(request.model_id)
+        return apb.UnregisterModelResponse()
+
+    def GetModelStatus(self, request, context):
+        self._require_id(request.model_id, context)
+        return self._status_info(request.model_id)
+
+    def EnsureLoaded(self, request, context):
+        self._require_id(request.model_id, context)
+        try:
+            self.instance.ensure_loaded(
+                request.model_id,
+                last_used_ms=request.last_used_ms,
+                sync=request.sync,
+            )
+        except ModelNotFoundError:
+            return apb.ModelStatusInfo(
+                status=apb.NOT_FOUND, model_id=request.model_id
+            )
+        except (ModelLoadException, NoCapacityError) as e:
+            return apb.ModelStatusInfo(
+                status=apb.LOADING_FAILED, model_id=request.model_id,
+                errors=[str(e)],
+            )
+        return self._status_info(request.model_id)
+
+    # -- vmodels (delegated; UNIMPLEMENTED until manager attached) ---------
+
+    def SetVModel(self, request, context):
+        if self.vmodels is None:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED, "vmodels not enabled")
+        return self.vmodels.set_vmodel(request, context, self._status_info)
+
+    def DeleteVModel(self, request, context):
+        if self.vmodels is None:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED, "vmodels not enabled")
+        return self.vmodels.delete_vmodel(request, context)
+
+    def GetVModelStatus(self, request, context):
+        if self.vmodels is None:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED, "vmodels not enabled")
+        return self.vmodels.get_vmodel_status(request, context, self._status_info)
+
+
+class MeshInternalServicer:
+    """mmtpu.internal.MeshInternal implementation."""
+
+    def __init__(self, instance: ModelMeshInstance):
+        self.instance = instance
+
+    def Forward(self, request, context):
+        ctx = _ctx_from_proto(request.ctx)
+        headers = list(request.headers.items())
+        try:
+            result = self.instance.invoke_model(
+                request.model_id,
+                request.method_name or None,
+                request.payload,
+                headers,
+                ctx,
+            )
+        except ModelNotHereError:
+            context.set_trailing_metadata(((ERROR_HEADER, _ERR_NOT_HERE),))
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"model {request.model_id} not here",
+            )
+        except NoCapacityError as e:
+            context.set_trailing_metadata(((ERROR_HEADER, _ERR_NO_CAPACITY),))
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except ModelNotFoundError:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"model {request.model_id}"
+            )
+        except ServiceUnavailableError as e:
+            # Propagates as UNAVAILABLE so the previous hop excludes this
+            # instance and retries elsewhere (same mapping as the external
+            # fallback surface).
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        except ModelLoadException as e:
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        except ApplierError as e:
+            context.abort(grpc.StatusCode.UNKNOWN, str(e))
+        return ipb.ForwardResponse(
+            payload=result.payload,
+            served_by=result.served_by,
+            model_status=_STATUS_MAP.get(result.status, apb.UNKNOWN),
+        )
+
+
+class InferenceFallback:
+    """Arbitrary-method inference entry: metadata id -> invoke_model."""
+
+    def __init__(self, instance: ModelMeshInstance, vmodels=None):
+        self.instance = instance
+        self.vmodels = vmodels
+
+    def __call__(self, method: str, request: bytes, context) -> bytes:
+        md = dict(context.invocation_metadata())
+        model_id = md.get(grpc_defs.MODEL_ID_HEADER, "")
+        vmodel_id = md.get(grpc_defs.VMODEL_ID_HEADER, "")
+        if vmodel_id and not model_id:
+            if self.vmodels is None:
+                context.abort(
+                    grpc.StatusCode.UNIMPLEMENTED, "vmodels not enabled"
+                )
+            model_id = self.vmodels.resolve(vmodel_id, context)
+        if not model_id:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"missing {grpc_defs.MODEL_ID_HEADER} metadata",
+            )
+        headers = [
+            (k, v) for k, v in md.items()
+            if not k.startswith("grpc-") and isinstance(v, str)
+        ]
+        try:
+            result = self.instance.invoke_model(
+                model_id, method, request, headers
+            )
+            return result.payload
+        except ModelNotFoundError:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"model {model_id}")
+        except NoCapacityError as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except (ModelLoadException, ModelNotHereError) as e:
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        except ApplierError as e:
+            code = getattr(grpc.StatusCode, e.grpc_code, grpc.StatusCode.UNKNOWN)
+            context.abort(code, str(e))
+        except ServiceUnavailableError as e:
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+
+class MeshServer:
+    """One gRPC server exposing all three surfaces for an instance."""
+
+    def __init__(
+        self,
+        instance: ModelMeshInstance,
+        port: int = 0,
+        vmodels=None,
+        max_workers: int = 24,
+        bind_host: str = "0.0.0.0",
+        advertise_host: str = "127.0.0.1",
+    ):
+        """``bind_host`` is the listen address (0.0.0.0 for cross-host
+        deployments); ``advertise_host`` is what peers dial — production
+        config passes the pod IP / hostname."""
+        self.instance = instance
+        self._advertise_host = advertise_host
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers))
+        grpc_defs.add_servicer(
+            self.server, MeshApiServicer(instance, vmodels),
+            grpc_defs.API_SERVICE, grpc_defs.API_METHODS,
+        )
+        grpc_defs.add_servicer(
+            self.server, MeshInternalServicer(instance),
+            grpc_defs.INTERNAL_SERVICE, grpc_defs.INTERNAL_METHODS,
+        )
+        self.server.add_generic_rpc_handlers(
+            (grpc_defs.RawFallbackHandler(InferenceFallback(instance, vmodels)),)
+        )
+        self.port = self.server.add_insecure_port(f"{bind_host}:{port}")
+        self.server.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self._advertise_host}:{self.port}"
+
+    def stop(self, grace: float = 0.5) -> None:
+        self.server.stop(grace)
+
+
+# -- client side --------------------------------------------------------------
+
+class PeerChannels:
+    """Channel cache for instance-to-instance calls."""
+
+    def __init__(self):
+        self._channels: dict[str, grpc.Channel] = {}
+        self._lock = threading.Lock()
+
+    def get(self, endpoint: str) -> grpc.Channel:
+        with self._lock:
+            ch = self._channels.get(endpoint)
+            if ch is None:
+                ch = grpc.insecure_channel(endpoint)
+                self._channels[endpoint] = ch
+            return ch
+
+    def close(self) -> None:
+        with self._lock:
+            for ch in self._channels.values():
+                ch.close()
+            self._channels.clear()
+
+
+def make_grpc_peer_call(channels: Optional[PeerChannels] = None,
+                        timeout_s: float = 30.0):
+    """Build the instance's peer transport over gRPC."""
+    channels = channels or PeerChannels()
+
+    def peer_call(
+        endpoint: str, model_id: str, method: Optional[str], payload: bytes,
+        headers: list[tuple[str, str]], ctx: RoutingContext,
+    ) -> InvokeResult:
+        stub = grpc_defs.make_stub(
+            channels.get(endpoint), grpc_defs.INTERNAL_SERVICE,
+            grpc_defs.INTERNAL_METHODS,
+        )
+        req = ipb.ForwardRequest(
+            model_id=model_id,
+            method_name=method or "",
+            payload=payload,
+            headers=dict(headers),
+            ctx=_ctx_to_proto(ctx),
+        )
+        try:
+            resp = stub.Forward(req, timeout=timeout_s)
+        except grpc.RpcError as e:
+            detail = ""
+            for k, v in (e.trailing_metadata() or ()):
+                if k == ERROR_HEADER:
+                    detail = v
+            if detail == _ERR_NOT_HERE:
+                raise ModelNotHereError(ctx.dest_instance, model_id) from e
+            if detail == _ERR_NO_CAPACITY:
+                raise NoCapacityError(e.details() or "") from e
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                raise ModelNotFoundError(model_id) from e
+            if e.code() in (
+                grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED
+            ):
+                raise ServiceUnavailableError(endpoint) from e
+            raise ApplierError(e.code().name, e.details() or "") from e
+        status_name = {v: k for k, v in _STATUS_MAP.items()}.get(
+            resp.model_status, "UNKNOWN"
+        )
+        return InvokeResult(resp.payload, resp.served_by, status_name)
+
+    peer_call.channels = channels  # for cleanup
+    return peer_call

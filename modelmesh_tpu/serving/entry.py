@@ -1,0 +1,260 @@
+"""Model-copy lifecycle: cache entries, the loading pool, unload accounting.
+
+Parity targets in the reference core:
+- CacheEntry state machine NEW -> QUEUED -> WAITING -> LOADING -> SIZING ->
+  ACTIVE | FAILED | REMOVED (ModelMesh.java:1838-1848, CacheEntry :1632)
+- priority loading queue with limited concurrency (loadingPool :504,
+  CacheEntry.run :2145)
+- load timeout with diagnostic capture (scheduleTimeoutForLoad :2308-2336)
+- unload-buffer accounting: space freed by eviction is unusable until the
+  runtime confirms the unload, and loads block (bounded) waiting for it
+  (ModelCacheUnloadBufManager.java; waitForSpaceToLoad :2271-2305)
+- per-entry invocation gating for latency-based autoscaling
+  (MaxConcCacheEntry :2641-2797)
+"""
+
+from __future__ import annotations
+
+import enum
+import heapq
+import logging
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+from modelmesh_tpu.cache.lru import now_ms
+from modelmesh_tpu.runtime.spi import (
+    CACHE_UNIT_BYTES,
+    LoadedModel,
+    ModelInfo,
+    ModelLoader,
+    ModelLoadException,
+)
+
+log = logging.getLogger(__name__)
+
+# Initial nominal weight before prediction/sizing (units).
+INSERTION_WEIGHT_UNITS = 8
+# Max time a queued load waits for unloads to free space (reference: 3 min).
+DEFAULT_SPACE_WAIT_S = 180.0
+
+
+class EntryState(enum.Enum):
+    NEW = "new"
+    QUEUED = "queued"
+    WAITING = "waiting"      # waiting for unload space
+    LOADING = "loading"
+    SIZING = "sizing"
+    ACTIVE = "active"
+    FAILED = "failed"
+    REMOVED = "removed"
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in (EntryState.ACTIVE, EntryState.FAILED, EntryState.REMOVED)
+
+    @property
+    def is_loading(self) -> bool:
+        return self in (
+            EntryState.QUEUED, EntryState.WAITING,
+            EntryState.LOADING, EntryState.SIZING,
+        )
+
+
+class CacheEntry:
+    """One local copy of a model. Thread-safe via its own lock; completion
+    is observed through ``wait_active``."""
+
+    def __init__(
+        self,
+        model_id: str,
+        info: ModelInfo,
+        weight_units: int = INSERTION_WEIGHT_UNITS,
+        last_used: Optional[int] = None,
+    ):
+        self.model_id = model_id
+        self.info = info
+        self.weight_units = weight_units
+        self.last_used = last_used if last_used is not None else now_ms()
+        self.state = EntryState.NEW
+        self.error: Optional[str] = None
+        self.loaded: Optional[LoadedModel] = None
+        self.load_started_ms: Optional[int] = None
+        self.load_completed_ms: Optional[int] = None
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._sem: Optional[threading.Semaphore] = None
+        self.inflight = 0
+        self.total_invocations = 0
+
+    # -- state ------------------------------------------------------------
+
+    def _transition(self, new: EntryState) -> None:
+        self.state = new
+        if new.is_terminal:
+            self._done.set()
+
+    def try_transition(self, new: EntryState) -> bool:
+        """Advance to a non-terminal loading state unless already terminal
+        (e.g. REMOVED by a concurrent eviction). Loader threads must use this
+        so eviction-during-load is never clobbered."""
+        with self._lock:
+            if self.state.is_terminal:
+                return False
+            self.state = new
+            return True
+
+    def complete_load(self, loaded: LoadedModel) -> bool:
+        """Finalize to ACTIVE unless removed meanwhile. Returns False if the
+        entry was removed — caller must release the runtime copy."""
+        with self._lock:
+            if self.state.is_terminal:
+                return False
+            self.loaded = loaded
+            self.load_completed_ms = now_ms()
+            if loaded.max_concurrency:
+                self._sem = threading.Semaphore(loaded.max_concurrency)
+            self._transition(EntryState.ACTIVE)
+            return True
+
+    def fail(self, message: str) -> None:
+        with self._lock:
+            if self.state.is_terminal:
+                return
+            self.error = message
+            self._transition(EntryState.FAILED)
+
+    def remove(self) -> None:
+        with self._lock:
+            self._transition(EntryState.REMOVED)
+
+    def wait_active(self, timeout_s: float) -> bool:
+        """True if ACTIVE within the timeout; False on timeout. Raises
+        ModelLoadException if the entry FAILED."""
+        if not self._done.wait(timeout_s):
+            return False
+        if self.state is EntryState.FAILED:
+            raise ModelLoadException(self.error or "load failed")
+        return self.state is EntryState.ACTIVE
+
+    # -- invocation gating ---------------------------------------------------
+
+    def before_invoke(self, timeout_s: Optional[float] = None) -> bool:
+        with self._lock:
+            sem = self._sem
+        if sem is not None and not sem.acquire(timeout=timeout_s or 30.0):
+            return False
+        with self._lock:
+            self.inflight += 1
+            self.total_invocations += 1
+        return True
+
+    def after_invoke(self) -> None:
+        with self._lock:
+            self.inflight -= 1
+            sem = self._sem
+        if sem is not None:
+            sem.release()
+
+
+class PrioritizedLoadingPool:
+    """Fixed-thread pool draining a priority queue of load tasks.
+
+    Priority: loads with a waiting request run before preemptive/chained
+    loads; ties broken by most-recently-used (reference priority queue at
+    ModelMesh.java:504, 2108-2116).
+    """
+
+    def __init__(self, concurrency: int = 8, name: str = "loader"):
+        self._heap: list[tuple[tuple, int, Callable[[], None]]] = []
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._shutdown = False
+        self._threads = [
+            threading.Thread(target=self._run, name=f"{name}-{i}", daemon=True)
+            for i in range(concurrency)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def submit(
+        self, task: Callable[[], None], *, urgent: bool, last_used: int
+    ) -> None:
+        key = (0 if urgent else 1, -last_used)
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("loading pool is shut down")
+            self._seq += 1
+            heapq.heappush(self._heap, (key, self._seq, task))
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._heap and not self._shutdown:
+                    self._cv.wait()
+                if self._shutdown and not self._heap:
+                    return
+                _, _, task = heapq.heappop(self._heap)
+            try:
+                task()
+            except Exception:
+                log.error("load task crashed:\n%s", traceback.format_exc())
+
+    def shutdown(self, drain: bool = False) -> None:
+        with self._cv:
+            self._shutdown = True
+            if not drain:
+                self._heap.clear()
+            self._cv.notify_all()
+
+
+class UnloadTracker:
+    """Accounting for in-flight unloads: evicted space isn't reusable until
+    the runtime confirms release. Loads block on ``wait_for_space``.
+
+    The reference implements this as a buffer entry inside the cache sharing
+    the eviction lock; here the cache reports its own weight and we track
+    the pending-unload units beside it — same invariant:
+        cache_weight + pending_unload_units <= capacity_units.
+    """
+
+    def __init__(self, capacity_units: int):
+        self.capacity_units = capacity_units
+        self._pending_units = 0
+        self._cv = threading.Condition()
+
+    @property
+    def pending_units(self) -> int:
+        return self._pending_units
+
+    def unload_started(self, units: int) -> None:
+        with self._cv:
+            self._pending_units += units
+
+    def unload_finished(self, units: int) -> None:
+        with self._cv:
+            self._pending_units = max(0, self._pending_units - units)
+            self._cv.notify_all()
+
+    def wait_for_space(
+        self, cache_weight_fn: Callable[[], int], need_units: int,
+        timeout_s: float = DEFAULT_SPACE_WAIT_S,
+    ) -> bool:
+        """Block until need_units fit beside cache weight + pending unloads."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while (
+                cache_weight_fn() + self._pending_units + need_units
+                > self.capacity_units
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(min(remaining, 1.0))
+            return True
+
+
+def bytes_to_units(size_bytes: int) -> int:
+    return max(1, (size_bytes + CACHE_UNIT_BYTES - 1) // CACHE_UNIT_BYTES)

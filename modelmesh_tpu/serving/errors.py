@@ -1,0 +1,48 @@
+"""Mesh routing/serving exceptions.
+
+Mirrors the reference's exception protocol (modelmesh.thrift:42-84):
+ModelNotHere drives retry-at-another-copy, ModelLoadException carries the
+timeout flag, ApplierException wraps a downstream gRPC status.
+"""
+
+from __future__ import annotations
+
+from modelmesh_tpu.runtime.spi import ModelLoadException  # re-export
+
+__all__ = [
+    "ModelLoadException",
+    "ModelNotFoundError",
+    "ModelNotHereError",
+    "NoCapacityError",
+    "ApplierError",
+    "ServiceUnavailableError",
+]
+
+
+class ModelNotFoundError(Exception):
+    """Model id is not in the registry."""
+
+
+class ModelNotHereError(Exception):
+    """The addressed instance doesn't (any longer) have the model copy."""
+
+    def __init__(self, instance_id: str, model_id: str):
+        super().__init__(f"model {model_id} not present on {instance_id}")
+        self.instance_id = instance_id
+        self.model_id = model_id
+
+
+class NoCapacityError(Exception):
+    """No instance can accept the load (cluster full / churn guard)."""
+
+
+class ApplierError(Exception):
+    """Downstream runtime returned a gRPC error for an inference call."""
+
+    def __init__(self, grpc_code: str, message: str):
+        super().__init__(f"{grpc_code}: {message}")
+        self.grpc_code = grpc_code
+
+
+class ServiceUnavailableError(Exception):
+    """Peer instance unreachable."""

@@ -1,0 +1,837 @@
+"""ModelMeshInstance: the serving-instance core.
+
+The equivalent of the reference's ModelMesh.java central class, decomposed:
+this module owns local model lifecycle + request routing; background tasks
+live in serving/tasks.py, the gRPC surfaces in serving/api.py, vmodels in
+serving/vmodels.py.
+
+Responsibilities (reference call stacks in SURVEY.md section 3):
+- initialize: loader startup -> capacity; KV tables + views; instance
+  session node; leader election                      (initialize :524)
+- registerModel/unregisterModel/getStatus/ensureLoaded (:3074-3247)
+- invoke_model: the routing uber-method — local fast path, cache-hit
+  forwarding with exclusion lists, cache-miss placement + local load
+  (invokeModel :3421-4001)
+- load lifecycle: CAS registry placement, priority queue, space wait,
+  sizing, failure bookkeeping                         (loadLocal :5028,
+  CacheEntry.run :2145)
+- eviction -> unload accounting + deregistration      (onEviction :2867)
+- instance-record publishing with change suppression  (publishInstanceRecord
+  :5391)
+- shutdown migration: deregister, trigger copies elsewhere, drain
+  (preShutdown :6959)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid
+from typing import Callable, Optional
+
+from modelmesh_tpu.cache.lru import WeightedLRUCache, now_ms
+from modelmesh_tpu.kv.session import LeaderElection, SessionNode
+from modelmesh_tpu.kv.store import CasFailed, KVStore
+from modelmesh_tpu.kv.table import KVTable, TableView
+from modelmesh_tpu.placement.greedy import GreedyStrategy
+from modelmesh_tpu.placement.strategy import (
+    LOAD_HERE,
+    ClusterView,
+    PlacementRequest,
+    PlacementStrategy,
+)
+from modelmesh_tpu.records import InstanceRecord, ModelRecord
+from modelmesh_tpu.runtime.spi import (
+    ModelInfo,
+    ModelLoader,
+    ModelLoadException,
+)
+from modelmesh_tpu.serving.entry import (
+    CacheEntry,
+    EntryState,
+    PrioritizedLoadingPool,
+    UnloadTracker,
+    bytes_to_units,
+)
+from modelmesh_tpu.serving.errors import (
+    ModelNotFoundError,
+    ModelNotHereError,
+    NoCapacityError,
+    ServiceUnavailableError,
+)
+from modelmesh_tpu.serving.rate import RateTracker
+
+log = logging.getLogger(__name__)
+
+MAX_ITERATIONS = 8          # routing loop bound (reference :283)
+# Reject churn: when full, don't evict entries younger than this for a new
+# load (reference minChurnAgeMs, :3872-3884).
+DEFAULT_MIN_CHURN_AGE_MS = 60_000
+# Backdate applied to explicit registrations so fresh-but-unused models are
+# first victims (reference backdates 1h/6h, ModelMesh.java:3097-3147).
+REGISTRATION_BACKDATE_MS = 3_600_000
+
+
+class RoutingContext:
+    """Per-request routing state (proto mesh_internal.RoutingContext)."""
+
+    __slots__ = (
+        "hop", "exclude_serve", "exclude_load", "visited",
+        "dest_instance", "chain_load_count", "known_size_bytes",
+        "last_used_ms",
+    )
+
+    EXTERNAL = 0
+    INTERNAL = 1
+    HIT_ONLY = 2
+    LOAD_LOCAL_ONLY = 3
+
+    def __init__(
+        self,
+        hop: int = EXTERNAL,
+        exclude_serve: Optional[set[str]] = None,
+        exclude_load: Optional[set[str]] = None,
+        visited: Optional[set[str]] = None,
+        dest_instance: str = "",
+        chain_load_count: int = 0,
+        known_size_bytes: int = 0,
+        last_used_ms: int = 0,
+    ):
+        self.hop = hop
+        self.exclude_serve = exclude_serve or set()
+        self.exclude_load = exclude_load or set()
+        self.visited = visited or set()
+        self.dest_instance = dest_instance
+        self.chain_load_count = chain_load_count
+        self.known_size_bytes = known_size_bytes
+        self.last_used_ms = last_used_ms
+
+
+class InvokeResult:
+    __slots__ = ("payload", "served_by", "status")
+
+    def __init__(self, payload: bytes, served_by: str, status: str):
+        self.payload = payload
+        self.served_by = served_by
+        self.status = status
+
+
+# peer_call(instance_record.endpoint, model_id, method, payload, headers, ctx)
+PeerCall = Callable[..., InvokeResult]
+
+
+class InstanceConfig:
+    def __init__(
+        self,
+        instance_id: Optional[str] = None,
+        kv_prefix: str = "mm",
+        endpoint: str = "",
+        zone: str = "",
+        location: str = "",
+        labels: Optional[list[str]] = None,
+        instance_version: str = "",
+        load_timeout_s: Optional[float] = None,
+        space_wait_s: float = 30.0,
+        min_churn_age_ms: int = DEFAULT_MIN_CHURN_AGE_MS,
+        publish_interval_s: float = 8.0,
+    ):
+        self.instance_id = instance_id or f"i-{uuid.uuid4().hex[:8]}"
+        self.kv_prefix = kv_prefix.rstrip("/")
+        self.endpoint = endpoint
+        self.zone = zone
+        self.location = location
+        self.labels = labels or []
+        self.instance_version = instance_version
+        self.load_timeout_s = load_timeout_s
+        self.space_wait_s = space_wait_s
+        self.min_churn_age_ms = min_churn_age_ms
+        self.publish_interval_s = publish_interval_s
+
+
+class ModelMeshInstance:
+    def __init__(
+        self,
+        store: KVStore,
+        loader: ModelLoader,
+        config: Optional[InstanceConfig] = None,
+        strategy: Optional[PlacementStrategy] = None,
+        peer_call: Optional[PeerCall] = None,
+        runtime_call: Optional[Callable[..., bytes]] = None,
+    ):
+        """``peer_call(endpoint, model_id, method, payload, headers, ctx)``
+        forwards to a peer (gRPC in production, direct-call in tests).
+        ``runtime_call(entry, method, payload, headers)`` executes inference
+        against the local runtime (defaults to SidecarRuntime.call_model when
+        the loader is a SidecarRuntime)."""
+        self.config = config or InstanceConfig()
+        self.instance_id = self.config.instance_id
+        self.store = store
+        self.loader = loader
+        self.strategy = strategy or GreedyStrategy()
+        self._peer_call = peer_call
+        self._runtime_call = runtime_call or self._default_runtime_call
+        self.shutting_down = False
+        self.is_leader = False
+
+        params = loader.startup()
+        self.params = params
+        self.load_timeout_s = (
+            self.config.load_timeout_s
+            if self.config.load_timeout_s is not None
+            else params.load_timeout_ms / 1000.0
+        )
+
+        self.cache: WeightedLRUCache[str, CacheEntry] = WeightedLRUCache(
+            params.capacity_units, eviction_listener=self._on_eviction
+        )
+        self.unload_tracker = UnloadTracker(params.capacity_units)
+        self.loading_pool = PrioritizedLoadingPool(params.load_concurrency)
+        self.rate = RateTracker()
+
+        prefix = self.config.kv_prefix
+        self.registry: KVTable[ModelRecord] = KVTable(
+            store, f"{prefix}/registry", ModelRecord
+        )
+        self.registry_view: TableView[ModelRecord] = TableView(self.registry)
+        self.instances: KVTable[InstanceRecord] = KVTable(
+            store, f"{prefix}/instances", InstanceRecord
+        )
+        self.instances_view: TableView[InstanceRecord] = TableView(self.instances)
+
+        self._session = SessionNode(
+            store,
+            f"{prefix}/instances/{self.instance_id}",
+            self._build_instance_record().to_bytes(),
+            ttl_s=10.0,
+        )
+        self._session.start()
+        self._election = LeaderElection(
+            store, f"{prefix}/leader", self.instance_id, self._on_leader_change
+        )
+        self._election.start()
+        self._publish_lock = threading.Lock()
+        self._last_published: Optional[InstanceRecord] = None
+        log.info(
+            "instance %s up: %d units capacity, %d load threads",
+            self.instance_id, params.capacity_units, params.load_concurrency,
+        )
+
+    # ------------------------------------------------------------------ #
+    # cluster views                                                      #
+    # ------------------------------------------------------------------ #
+
+    def cluster_view(self) -> ClusterView:
+        return ClusterView(instances=self.instances_view.items())
+
+    def _on_leader_change(self, is_leader: bool) -> None:
+        self.is_leader = is_leader
+        log.info("instance %s leader=%s", self.instance_id, is_leader)
+
+    # ------------------------------------------------------------------ #
+    # instance record publishing                                         #
+    # ------------------------------------------------------------------ #
+
+    def _build_instance_record(self) -> InstanceRecord:
+        cache = getattr(self, "cache", None)
+        return InstanceRecord(
+            start_ts=now_ms(),
+            lru_ts=(cache.oldest_time() or 0) if cache else 0,
+            model_count=len(cache) if cache else 0,
+            capacity_units=self.params.capacity_units if hasattr(self, "params") else 0,
+            used_units=(cache.weight if cache else 0)
+            + (self.unload_tracker.pending_units if hasattr(self, "unload_tracker") else 0),
+            loading_in_progress=0,
+            req_per_minute=self.rate.rpm() if hasattr(self, "rate") else 0,
+            shutting_down=self.shutting_down,
+            endpoint=self.config.endpoint,
+            location=self.config.location,
+            zone=self.config.zone,
+            labels=list(self.config.labels),
+            instance_version=self.config.instance_version,
+        )
+
+    def publish_instance_record(self, force: bool = False) -> None:
+        """Refresh our advertisement; suppress no-op updates (reference
+        change-suppression, ModelMesh.java:5440-5468)."""
+        with self._publish_lock:
+            rec = self._build_instance_record()
+            prev = self._last_published
+            if not force and prev is not None:
+                same = (
+                    prev.model_count == rec.model_count
+                    and abs(prev.used_units - rec.used_units) < 8
+                    and prev.shutting_down == rec.shutting_down
+                    and abs(prev.req_per_minute - rec.req_per_minute)
+                    < max(10, prev.req_per_minute // 10)
+                )
+                if same:
+                    return
+            rec.start_ts = prev.start_ts if prev else rec.start_ts
+            self._session.update(rec.to_bytes())
+            self._last_published = rec
+
+    # ------------------------------------------------------------------ #
+    # management API                                                     #
+    # ------------------------------------------------------------------ #
+
+    def register_model(
+        self, model_id: str, info: ModelInfo, load_now: bool = False,
+        sync: bool = False,
+    ) -> ModelRecord:
+        def create(cur: Optional[ModelRecord]) -> ModelRecord:
+            if cur is not None:
+                # Idempotent re-register with same info keeps the record.
+                cur.model_type = info.model_type
+                cur.model_path = info.model_path
+                cur.model_key = info.model_key
+                return cur
+            mr = ModelRecord(
+                model_type=info.model_type,
+                model_path=info.model_path,
+                model_key=info.model_key,
+                last_used=now_ms() - REGISTRATION_BACKDATE_MS,
+            )
+            return mr
+
+        mr = self.registry.update_or_create(model_id, create)
+        if load_now:
+            self.ensure_loaded(model_id, sync=sync)
+            mr = self.registry.get(model_id) or mr
+        return mr
+
+    def unregister_model(self, model_id: str) -> bool:
+        mr = self.registry.get(model_id)
+        if mr is None:
+            return False
+        # Evict local copy first, then remove the registration.
+        self._remove_local(model_id)
+        for iid in list(mr.instance_ids):
+            if iid != self.instance_id:
+                # Peers notice via registry watch (janitor reconcile removes
+                # their copies); proactive unload RPC is a later refinement.
+                pass
+        return self.registry.delete(model_id)
+
+    def get_status(self, model_id: str) -> tuple[str, ModelRecord | None]:
+        """-> (status, record): status in NOT_FOUND/NOT_LOADED/LOADING/
+        LOADED/LOADING_FAILED."""
+        ce = self.cache.get_quietly(model_id)
+        mr = self.registry_view.get(model_id) or self.registry.get(model_id)
+        if mr is None:
+            return "NOT_FOUND", None
+        if ce is not None and ce.state is EntryState.ACTIVE:
+            return "LOADED", mr
+        if ce is not None and ce.state.is_loading:
+            return "LOADING", mr
+        if mr.instance_ids:
+            return "LOADED", mr
+        if mr.loading_instances:
+            return "LOADING", mr
+        if mr.load_exhausted():
+            return "LOADING_FAILED", mr
+        return "NOT_LOADED", mr
+
+    def ensure_loaded(
+        self, model_id: str, last_used_ms: int = 0, sync: bool = False,
+        exclude: Optional[set[str]] = None, chain: int = 0,
+    ) -> str:
+        """Place/load a copy somewhere (no inference). Returns final status."""
+        ctx = RoutingContext(
+            hop=RoutingContext.INTERNAL,
+            exclude_load=set(exclude or ()),
+            last_used_ms=last_used_ms or now_ms(),
+            chain_load_count=chain,
+        )
+        result = self.invoke_model(model_id, None, b"", [], ctx, sync=sync)
+        return result.status
+
+    # ------------------------------------------------------------------ #
+    # the routing uber-method                                            #
+    # ------------------------------------------------------------------ #
+
+    def invoke_model(
+        self,
+        model_id: str,
+        method: Optional[str],
+        payload: bytes,
+        headers: list[tuple[str, str]],
+        ctx: Optional[RoutingContext] = None,
+        sync: bool = True,
+    ) -> InvokeResult:
+        ctx = ctx or RoutingContext()
+        ctx.visited.add(self.instance_id)
+
+        if ctx.hop == RoutingContext.HIT_ONLY:
+            ce = self.cache.get(model_id)
+            if ce is None or ce.state in (EntryState.FAILED, EntryState.REMOVED):
+                raise ModelNotHereError(self.instance_id, model_id)
+            return self._invoke_local(ce, method, payload, headers, sync=sync)
+
+        last_exc: Optional[Exception] = None
+        # A pure placement op (method None) with ourselves excluded must not
+        # be satisfied by our own copy — the caller wants a copy elsewhere
+        # (ensureLoaded-with-exclusions, reference ModelMesh.java:3348).
+        skip_local = method is None and self.instance_id in ctx.exclude_load
+        for _ in range(MAX_ITERATIONS):
+            # 1. local fast path
+            ce = None if skip_local else self.cache.get(model_id)
+            if ce is not None and ce.state not in (
+                EntryState.FAILED, EntryState.REMOVED
+            ):
+                try:
+                    return self._invoke_local(ce, method, payload, headers, sync=sync)
+                except ModelNotHereError as e:
+                    last_exc = e  # runtime lost it; cleanup already done
+                except ModelLoadException as e:
+                    last_exc = e
+                    ctx.exclude_load.add(self.instance_id)
+
+            mr = self.registry_view.get(model_id) or self.registry.get(model_id)
+            if mr is None:
+                raise ModelNotFoundError(model_id)
+
+            if ctx.hop == RoutingContext.LOAD_LOCAL_ONLY:
+                ce = self._load_local(model_id, mr, ctx)
+                if ce is None:
+                    raise NoCapacityError(self.instance_id)
+                return self._invoke_local(ce, method, payload, headers, sync=sync)
+
+            # 2. cache-hit loop: forward to a loaded copy
+            exclude = (
+                ctx.exclude_serve | ctx.visited | {self.instance_id}
+            )
+            target = self.strategy.choose_serve_target(
+                mr, self.cluster_view(), frozenset(exclude)
+            )
+            if target is not None:
+                try:
+                    return self._forward(
+                        target, model_id, method, payload, headers, ctx,
+                        hop=RoutingContext.INTERNAL,
+                    )
+                except (ModelNotHereError, ServiceUnavailableError) as e:
+                    ctx.exclude_serve.add(target)
+                    last_exc = e
+                    continue
+
+            # 3. cache-miss loop: place a new copy.
+            if mr.load_exhausted():
+                raise ModelLoadException(
+                    f"{model_id}: load failed on "
+                    f"{sorted(mr.load_failures)}: "
+                    f"{[m for _, m in mr.load_failures.values()][:2]}"
+                )
+            # Hard exclusions forbid loading there at all; visited peers are
+            # additionally excluded from *forward* targets (loop prevention)
+            # but do not forbid loading on ourselves.
+            hard_exclude = (
+                ctx.exclude_load | mr.all_placements | set(mr.load_failures)
+            )
+            strategy_exclude = hard_exclude | (ctx.visited - {self.instance_id})
+            if not ctx.known_size_bytes:
+                ctx.known_size_bytes = self._predict_size_bytes(model_id, mr)
+            req = PlacementRequest(
+                model_id=model_id,
+                model=mr,
+                required_units=bytes_to_units(ctx.known_size_bytes),
+                requesting_instance=self.instance_id,
+                exclude=frozenset(strategy_exclude),
+                last_used_ms=ctx.last_used_ms or now_ms(),
+            )
+            target = self.strategy.choose_load_target(req, self.cluster_view())
+            if target in (LOAD_HERE, self.instance_id):
+                ce = self._load_local(model_id, mr, ctx)
+                if ce is not None:
+                    return self._invoke_local(ce, method, payload, headers, sync=sync)
+                ctx.exclude_load.add(self.instance_id)
+                last_exc = last_exc or NoCapacityError(self.instance_id)
+                continue
+            if target is None:
+                raise NoCapacityError(
+                    f"no instance can load {model_id} "
+                    f"(excluded: {sorted(strategy_exclude)})"
+                )
+            try:
+                return self._forward(
+                    target, model_id, method, payload, headers, ctx,
+                    hop=RoutingContext.LOAD_LOCAL_ONLY,
+                )
+            except (
+                ModelNotHereError, NoCapacityError, ServiceUnavailableError
+            ) as e:
+                ctx.exclude_load.add(target)
+                last_exc = e
+                continue
+
+        raise last_exc or ModelLoadException(
+            f"{model_id}: routing iterations exhausted"
+        )
+
+    # ------------------------------------------------------------------ #
+    # local invocation                                                   #
+    # ------------------------------------------------------------------ #
+
+    def _invoke_local(
+        self, ce: CacheEntry, method: Optional[str], payload: bytes,
+        headers: list[tuple[str, str]], sync: bool = True,
+    ) -> InvokeResult:
+        if not sync and ce.state.is_loading:
+            return InvokeResult(b"", self.instance_id, "LOADING")
+        if not ce.wait_active(self.load_timeout_s * 1.5):
+            raise ModelLoadException(
+                f"{ce.model_id}: timed out waiting for load", timeout=True
+            )
+        if ce.state is not EntryState.ACTIVE:
+            raise ModelNotHereError(self.instance_id, ce.model_id)
+        if method is None:
+            # ensure-loaded op: presence is the result
+            self._maybe_chain_load(ce)
+            return InvokeResult(b"", self.instance_id, "LOADED")
+        if not ce.before_invoke():
+            raise ModelLoadException(f"{ce.model_id}: concurrency gate timeout")
+        try:
+            out = self._runtime_call(ce, method, payload, headers)
+            self.rate.record()
+            self.cache.get(ce.model_id)  # LRU touch
+            return InvokeResult(out, self.instance_id, "LOADED")
+        except ModelNotHereError:
+            # Runtime claims NOT_FOUND for a model we think is loaded — the
+            # Triton refresh quirk: purge and let the caller retry elsewhere
+            # (reference cleanup-unload, SidecarModelMesh.java:961-988).
+            self._remove_local(ce.model_id)
+            raise
+        finally:
+            ce.after_invoke()
+
+    def _default_runtime_call(
+        self, ce: CacheEntry, method: str, payload: bytes,
+        headers: list[tuple[str, str]],
+    ) -> bytes:
+        import grpc
+
+        from modelmesh_tpu.serving.errors import ApplierError
+
+        call_model = getattr(self.loader, "call_model", None)
+        if call_model is None:
+            raise NotImplementedError(
+                "loader has no call_model; pass runtime_call to the instance"
+            )
+        try:
+            return call_model(ce.model_id, method, payload, headers)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                raise ModelNotHereError(self.instance_id, ce.model_id) from e
+            raise ApplierError(e.code().name, e.details() or "") from e
+
+    def _maybe_chain_load(self, ce: CacheEntry) -> None:
+        """Chained copy loads: each target triggers the next copy with itself
+        appended to the exclusions (reference triggerChainedLoadIfNecessary,
+        ModelMesh.java:4560-4585). Handled by tasks layer via ensure_loaded;
+        kept as a hook here."""
+
+    # ------------------------------------------------------------------ #
+    # local load lifecycle                                               #
+    # ------------------------------------------------------------------ #
+
+    def _predict_size_bytes(self, model_id: str, mr: ModelRecord) -> int:
+        predicted = self.loader.predict_size(
+            model_id, ModelInfo(mr.model_type, mr.model_path, mr.model_key)
+        )
+        return predicted or self.params.default_model_size_bytes
+
+    def _local_load_allowed(self, required_units: int) -> bool:
+        """Churn guard: when full, don't evict recently-used entries
+        (reference :3872-3884)."""
+        if self.shutting_down:
+            return False
+        free = self.cache.capacity - self.cache.weight
+        if free >= required_units:
+            return True
+        oldest = self.cache.oldest_time()
+        return oldest is not None and (
+            now_ms() - oldest >= self.config.min_churn_age_ms
+        )
+
+    def _load_local(
+        self, model_id: str, mr: ModelRecord, ctx: RoutingContext
+    ) -> Optional[CacheEntry]:
+        """Insert a cache entry and enqueue the load. Returns the (possibly
+        pre-existing) entry, or None if loading here isn't allowed."""
+        existing = self.cache.get_quietly(model_id)
+        if existing is not None and existing.state not in (
+            EntryState.FAILED, EntryState.REMOVED
+        ):
+            return existing
+
+        info = ModelInfo(mr.model_type, mr.model_path, mr.model_key)
+        if not ctx.known_size_bytes:
+            ctx.known_size_bytes = self._predict_size_bytes(model_id, mr)
+        units = bytes_to_units(ctx.known_size_bytes)
+        if not self._local_load_allowed(units):
+            return None
+        if units > self.cache.capacity:
+            self._record_load_failure(
+                model_id, f"model size {units}u exceeds instance capacity"
+            )
+            return None
+
+        last_used = ctx.last_used_ms or now_ms()
+        ce = CacheEntry(model_id, info, weight_units=units, last_used=last_used)
+        prev = self.cache.put_if_absent(model_id, ce, units, last_used=last_used)
+        if prev is not None:
+            return prev
+
+        # CAS our loading claim into the registry (reference loadLocal
+        # conflict analysis, ModelMesh.java:5199-5255); promoted to a loaded
+        # placement when the load completes.
+        try:
+            def place(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
+                if cur is None:
+                    return None  # unregistered concurrently
+                cur.claim_loading(self.instance_id, now_ms())
+                return cur
+
+            if self.registry.update_or_create(model_id, place) is None:
+                self.cache.remove_if_value(model_id, ce)
+                ce.remove()
+                raise ModelNotFoundError(model_id)
+        except CasFailed:
+            self.cache.remove_if_value(model_id, ce)
+            ce.remove()
+            raise
+
+        ce.state = EntryState.QUEUED
+        urgent = ctx.hop != RoutingContext.INTERNAL
+        self.loading_pool.submit(
+            lambda: self._run_load(ce), urgent=urgent, last_used=last_used
+        )
+        return ce
+
+    def _run_load(self, ce: CacheEntry) -> None:
+        """Loading-pool task. All state advances go through the entry's
+        guarded transitions so a concurrent eviction (-> REMOVED) is never
+        clobbered; if the entry is removed after the runtime load happened,
+        the runtime copy is released here."""
+        model_id = ce.model_id
+        try:
+            if self.loader.requires_unload:
+                if not ce.try_transition(EntryState.WAITING):
+                    return
+                if not self._wait_space(ce):
+                    raise ModelLoadException(
+                        f"{model_id}: timed out waiting for unload space",
+                        timeout=True,
+                    )
+            if not ce.try_transition(EntryState.LOADING):
+                return
+            ce.load_started_ms = now_ms()
+            loaded = self.loader.load(model_id, ce.info)
+            size_bytes = loaded.size_bytes
+            if not size_bytes and ce.try_transition(EntryState.SIZING):
+                size_bytes = self.loader.model_size(model_id, loaded.handle)
+            if size_bytes:
+                new_units = bytes_to_units(size_bytes)
+                if new_units != ce.weight_units:
+                    if self.cache.update_weight(model_id, new_units) is not None:
+                        ce.weight_units = new_units
+                    loaded = type(loaded)(
+                        handle=loaded.handle,
+                        size_bytes=size_bytes,
+                        max_concurrency=loaded.max_concurrency,
+                    )
+            if not ce.complete_load(loaded):
+                # Removed (evicted/unregistered) while we were loading.
+                self.loader.unload(model_id)
+                return
+            self._promote_loaded(model_id)
+            self.publish_instance_record()
+        except ModelLoadException as e:
+            self._load_failed(ce, str(e))
+        except Exception as e:  # noqa: BLE001 — any load error is a failure
+            self._load_failed(ce, f"{type(e).__name__}: {e}")
+
+    def _promote_loaded(self, model_id: str) -> None:
+        def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
+            if cur is None:
+                return None
+            cur.promote_loaded(self.instance_id, now_ms())
+            return cur
+
+        try:
+            self.registry.update_or_create(model_id, mutate)
+        except CasFailed:
+            log.warning("promote-loaded CAS gave up for %s", model_id)
+
+    def _wait_space(self, ce: CacheEntry) -> bool:
+        # The entry's weight is already inserted in the cache; what we wait
+        # for is pending unloads to drain so that total (cache + pending)
+        # fits capacity.
+        return self.unload_tracker.wait_for_space(
+            lambda: self.cache.weight, 0, timeout_s=self.config.space_wait_s
+        )
+
+    def _load_failed(self, ce: CacheEntry, message: str) -> None:
+        log.warning("load of %s failed: %s", ce.model_id, message)
+        ce.fail(message)
+        self.cache.remove_if_value(ce.model_id, ce)
+        self._record_load_failure(ce.model_id, message)
+        self.publish_instance_record()
+
+    def _record_load_failure(self, model_id: str, message: str) -> None:
+        def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
+            if cur is None:
+                return None
+            cur.remove_instance(self.instance_id)
+            cur.add_load_failure(self.instance_id, message)
+            return cur
+
+        try:
+            self.registry.update_or_create(model_id, mutate)
+        except CasFailed:
+            log.warning("failure-record CAS gave up for %s", model_id)
+
+    # ------------------------------------------------------------------ #
+    # eviction / removal                                                 #
+    # ------------------------------------------------------------------ #
+
+    def _on_eviction(self, model_id: str, ce: CacheEntry, last_used: int) -> None:
+        """Cache evicted an entry (capacity pressure). Called under the
+        eviction lock — NO blocking work here: KV deregistration and the
+        runtime unload run on a separate thread so the inference hot path
+        (which takes the same lock) never stalls on KV round trips."""
+        log.info("evicting %s (last used %d)", model_id, last_used)
+        was_active = ce.state is EntryState.ACTIVE
+        ce.remove()
+        units = ce.weight_units
+        do_unload = was_active and self.loader.requires_unload
+        if do_unload:
+            self.unload_tracker.unload_started(units)
+
+        def post_evict():
+            try:
+                self._deregister(model_id, record_unload_time=True)
+            finally:
+                if do_unload:
+                    try:
+                        self.loader.unload(model_id)
+                    finally:
+                        self.unload_tracker.unload_finished(units)
+                        self.publish_instance_record()
+
+        threading.Thread(
+            target=post_evict, name=f"evict-{model_id}", daemon=True
+        ).start()
+
+    def _remove_local(self, model_id: str) -> bool:
+        ce = self.cache.get_quietly(model_id)
+        if ce is None:
+            return False
+        if not self.cache.remove_if_value(model_id, ce):
+            return False
+        was_active = ce.state is EntryState.ACTIVE
+        ce.remove()
+        self._deregister(model_id)
+        if was_active and self.loader.requires_unload:
+            self._async_unload(model_id, ce.weight_units)
+        return True
+
+    def _async_unload(self, model_id: str, units: int) -> None:
+        self.unload_tracker.unload_started(units)
+
+        def do_unload():
+            try:
+                self.loader.unload(model_id)
+            finally:
+                self.unload_tracker.unload_finished(units)
+                self.publish_instance_record()
+
+        threading.Thread(
+            target=do_unload, name=f"unload-{model_id}", daemon=True
+        ).start()
+
+    def _deregister(self, model_id: str, record_unload_time: bool = False) -> None:
+        def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
+            if cur is None:
+                return None
+            cur.remove_instance(self.instance_id)
+            if record_unload_time:
+                cur.last_unload_ms = now_ms()
+            return cur
+
+        try:
+            self.registry.update_or_create(model_id, mutate)
+        except CasFailed:
+            log.warning("deregister CAS gave up for %s", model_id)
+
+    # ------------------------------------------------------------------ #
+    # forwarding                                                         #
+    # ------------------------------------------------------------------ #
+
+    def _forward(
+        self, target: str, model_id: str, method: Optional[str],
+        payload: bytes, headers: list[tuple[str, str]],
+        ctx: RoutingContext, hop: int,
+    ) -> InvokeResult:
+        rec = self.instances_view.get(target)
+        if rec is None:
+            raise ServiceUnavailableError(target)
+        if self._peer_call is None:
+            raise ServiceUnavailableError(
+                f"no peer transport configured (target {target})"
+            )
+        fwd_ctx = RoutingContext(
+            hop=hop,
+            exclude_serve=set(ctx.exclude_serve),
+            exclude_load=set(ctx.exclude_load),
+            visited=set(ctx.visited),
+            dest_instance=target,
+            chain_load_count=ctx.chain_load_count,
+            known_size_bytes=ctx.known_size_bytes,
+            last_used_ms=ctx.last_used_ms,
+        )
+        return self._peer_call(
+            rec.endpoint or target, model_id, method, payload, headers, fwd_ctx
+        )
+
+    # ------------------------------------------------------------------ #
+    # shutdown                                                           #
+    # ------------------------------------------------------------------ #
+
+    def pre_shutdown(self, deadline_s: float = 30.0) -> None:
+        """Migration: stop accepting placements, trigger copies elsewhere
+        for recently-used models, deregister everything (reference
+        preShutdown, ModelMesh.java:6959-7143)."""
+        import time as _time
+
+        self.shutting_down = True
+        self.publish_instance_record(force=True)
+        deadline = _time.monotonic() + deadline_s
+        recent_cutoff = now_ms() - 3_600_000
+        items = list(self.cache.descending_items())  # MRU -> LRU
+        for model_id, ce, last_used in items:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                break
+            if last_used >= recent_cutoff and not self.shutdown_skip_migration:
+                try:
+                    self.ensure_loaded(
+                        model_id, last_used_ms=last_used, sync=True,
+                        exclude={self.instance_id},
+                    )
+                except Exception as e:  # noqa: BLE001 — best-effort migration
+                    log.warning("migration of %s failed: %s", model_id, e)
+            self._remove_local(model_id)
+        for model_id, _, _ in list(self.cache.descending_items()):
+            self._remove_local(model_id)
+
+    shutdown_skip_migration = False
+
+    def shutdown(self) -> None:
+        self.loading_pool.shutdown()
+        self._election.close()
+        self._session.close()
+        self.registry_view.close()
+        self.instances_view.close()
+        close = getattr(self.loader, "close", None)
+        if close:
+            close()

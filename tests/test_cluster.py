@@ -1,0 +1,136 @@
+"""Multi-instance cluster tests: forwarding, placement, scale-out, failover.
+
+The cluster tier of the reference's test strategy (ModelMeshClusterTest,
+ModelMeshTearDownTest — SURVEY.md section 4) on the in-process harness.
+"""
+
+import time
+
+import grpc
+import pytest
+
+from modelmesh_tpu.runtime import ModelInfo, grpc_defs
+from modelmesh_tpu.runtime.fake import PREDICT_METHOD
+from tests.cluster_util import Cluster
+
+INFO = ModelInfo(model_type="example", model_path="mem://x")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n=3)
+    yield c
+    c.close()
+
+
+def client_call(pod, model_id: str, payload: bytes = b"req") -> bytes:
+    """External inference call through a pod's public gRPC endpoint."""
+    ch = grpc.insecure_channel(pod.server.endpoint)
+    try:
+        call = grpc_defs.raw_method(ch, PREDICT_METHOD)
+        return call(
+            payload,
+            metadata=[(grpc_defs.MODEL_ID_HEADER, model_id)],
+            timeout=20,
+        )
+    finally:
+        ch.close()
+
+
+class TestClusterBasics:
+    def test_fleet_visible(self, cluster):
+        for pod in cluster.pods:
+            assert len(pod.instance.instances_view) == 3
+        leaders = [p.instance.is_leader for p in cluster.pods]
+        assert sum(leaders) == 1
+
+    def test_register_anywhere_invoke_anywhere(self, cluster):
+        cluster[0].instance.register_model("m-c1", INFO)
+        out = client_call(cluster[2], "m-c1")
+        assert out.startswith(b"m-c1:category_")
+        # Exactly one copy somewhere.
+        mr = cluster[0].instance.registry.get("m-c1")
+        assert len(mr.instance_ids) == 1
+
+    def test_forwarding_to_loaded_copy(self, cluster):
+        # Load on pod 0 explicitly, call pod 1: must forward, not reload.
+        cluster[0].instance.register_model("m-fwd", INFO)
+        ctx = None
+        res = cluster[0].instance.invoke_model(
+            "m-fwd", PREDICT_METHOD, b"warm", []
+        )
+        assert res.served_by == "i-0"
+        loads_before = [p.runtime.load_count for p in cluster.pods]
+        out = client_call(cluster[1], "m-fwd")
+        assert out.startswith(b"m-fwd:")
+        loads_after = [p.runtime.load_count for p in cluster.pods]
+        assert loads_after == loads_before, "forward must not trigger a load"
+
+    def test_ensure_loaded_second_copy(self, cluster):
+        inst0 = cluster[0].instance
+        inst0.register_model("m-2copy", INFO, load_now=True, sync=True)
+        holder = cluster.pod_with_copy("m-2copy")
+        inst0.ensure_loaded(
+            "m-2copy", sync=True, exclude={holder.iid}
+        )
+        mr = inst0.registry.get("m-2copy")
+        assert len(mr.instance_ids) == 2
+
+    def test_management_api_over_grpc(self, cluster):
+        from modelmesh_tpu.proto import mesh_api_pb2 as apb
+
+        ch = grpc.insecure_channel(cluster[1].server.endpoint)
+        stub = grpc_defs.make_stub(
+            ch, grpc_defs.API_SERVICE, grpc_defs.API_METHODS
+        )
+        info = apb.ModelInfo(model_type="example", model_path="mem://g")
+        st = stub.RegisterModel(
+            apb.RegisterModelRequest(
+                model_id="m-api", info=info, load_now=True, sync=True
+            )
+        )
+        assert st.status == apb.LOADED
+        st2 = stub.GetModelStatus(apb.GetModelStatusRequest(model_id="m-api"))
+        assert st2.status == apb.LOADED and st2.copy_count == 1
+        stub.UnregisterModel(apb.UnregisterModelRequest(model_id="m-api"))
+        st3 = stub.GetModelStatus(apb.GetModelStatusRequest(model_id="m-api"))
+        assert st3.status == apb.NOT_FOUND
+        ch.close()
+
+
+class TestFailover:
+    def test_crash_failover(self):
+        c = Cluster(n=3)
+        try:
+            c[0].instance.register_model("m-ha", INFO)
+            # Force the copy onto pod 0.
+            c[0].instance.invoke_model("m-ha", PREDICT_METHOD, b"x", [])
+            assert c.pod_with_copy("m-ha").iid == "i-0"
+            c[0].stop(hard=True)  # crash: lease revoked, server gone
+            # Fleet notices the death.
+            c[1].instance.instances_view.wait_for(
+                lambda v: "i-0" not in v, timeout=10
+            )
+            # Request must be re-placed and served by a survivor.
+            out = client_call(c[1], "m-ha")
+            assert out.startswith(b"m-ha:")
+            mr = c[1].instance.registry.get("m-ha")
+            live = set(mr.instance_ids) - {"i-0"}
+            assert live, "copy must exist on a survivor"
+        finally:
+            c.close()
+
+    def test_graceful_shutdown_migrates(self):
+        c = Cluster(n=2)
+        try:
+            c[0].instance.register_model("m-mig2", INFO)
+            c[0].instance.invoke_model("m-mig2", PREDICT_METHOD, b"x", [])
+            holder = c.pod_with_copy("m-mig2")
+            other = c[1] if holder is c[0] else c[0]
+            holder.instance.pre_shutdown(deadline_s=10)
+            mr = other.instance.registry.get("m-mig2")
+            assert holder.iid not in mr.instance_ids
+            assert other.iid in mr.instance_ids, "copy must migrate"
+            assert other.instance.cache.get_quietly("m-mig2") is not None
+        finally:
+            c.close()

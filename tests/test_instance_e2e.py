@@ -1,0 +1,178 @@
+"""End-to-end single-instance slice: register -> load -> invoke -> evict.
+
+The equivalent of the reference's SingleInstanceModelMeshTest tier
+(SURVEY.md section 4): one instance, real in-process gRPC runtime, shared KV.
+"""
+
+import time
+
+import pytest
+
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.records import ModelRecord
+from modelmesh_tpu.runtime import ModelInfo
+from modelmesh_tpu.runtime.fake import (
+    FAIL_LOAD_PREFIX,
+    PREDICT_METHOD,
+    FakeRuntimeServicer,
+    start_fake_runtime,
+)
+from modelmesh_tpu.runtime.sidecar import SidecarRuntime
+from modelmesh_tpu.serving.entry import EntryState
+from modelmesh_tpu.serving.errors import (
+    ModelLoadException,
+    ModelNotFoundError,
+)
+from modelmesh_tpu.serving.instance import (
+    InstanceConfig,
+    ModelMeshInstance,
+    RoutingContext,
+)
+
+INFO = ModelInfo(model_type="example", model_path="mem://m")
+
+
+@pytest.fixture()
+def mesh():
+    store = InMemoryKV(sweep_interval_s=0.05)
+    server, port, servicer = start_fake_runtime(
+        servicer=FakeRuntimeServicer(capacity_bytes=64 << 20)
+    )
+    loader = SidecarRuntime(f"127.0.0.1:{port}", startup_timeout_s=10)
+    inst = ModelMeshInstance(
+        store,
+        loader,
+        InstanceConfig(instance_id="i-test", load_timeout_s=10,
+                       space_wait_s=2.0, min_churn_age_ms=0),
+    )
+    yield inst, servicer, store
+    inst.shutdown()
+    server.stop(0)
+    store.close()
+
+
+class TestLifecycle:
+    def test_register_status_not_loaded(self, mesh):
+        inst, _, _ = mesh
+        inst.register_model("m-reg", INFO)
+        status, mr = inst.get_status("m-reg")
+        assert status == "NOT_LOADED"
+        assert mr.model_type == "example"
+        # Registration is backdated so it evicts first (reference behavior).
+        assert mr.last_used < time.time() * 1000 - 3_000_000
+
+    def test_unknown_model_not_found(self, mesh):
+        inst, _, _ = mesh
+        assert inst.get_status("nope")[0] == "NOT_FOUND"
+        with pytest.raises(ModelNotFoundError):
+            inst.invoke_model("nope", PREDICT_METHOD, b"x", [])
+
+    def test_register_load_now_sync(self, mesh):
+        inst, servicer, _ = mesh
+        inst.register_model("m-sync", INFO, load_now=True, sync=True)
+        assert inst.get_status("m-sync")[0] == "LOADED"
+        assert "m-sync" in servicer.loaded
+        mr = inst.registry.get("m-sync")
+        assert "i-test" in mr.instance_ids
+
+    def test_invoke_loads_on_demand_and_serves(self, mesh):
+        inst, servicer, _ = mesh
+        inst.register_model("m-demand", INFO)
+        out = inst.invoke_model("m-demand", PREDICT_METHOD, b"payload", [])
+        assert out.payload.startswith(b"m-demand:category_")
+        assert out.served_by == "i-test"
+        # Second invoke hits the warm copy.
+        loads = servicer.load_count
+        out2 = inst.invoke_model("m-demand", PREDICT_METHOD, b"payload2", [])
+        assert out2.payload.startswith(b"m-demand:")
+        assert servicer.load_count == loads
+
+    def test_unregister_removes_copy(self, mesh):
+        inst, servicer, _ = mesh
+        inst.register_model("m-gone", INFO, load_now=True, sync=True)
+        assert "m-gone" in servicer.loaded
+        assert inst.unregister_model("m-gone")
+        deadline = time.monotonic() + 5
+        while "m-gone" in servicer.loaded and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert "m-gone" not in servicer.loaded
+        assert inst.get_status("m-gone")[0] == "NOT_FOUND"
+
+    def test_load_failure_recorded(self, mesh):
+        inst, _, _ = mesh
+        mid = FAIL_LOAD_PREFIX + "boom"
+        inst.register_model(mid, INFO)
+        with pytest.raises((ModelLoadException, Exception)):
+            inst.invoke_model(mid, PREDICT_METHOD, b"x", [])
+        mr = inst.registry.get(mid)
+        assert "i-test" in mr.load_failures
+        assert "i-test" not in mr.instance_ids
+        assert inst.cache.get_quietly(mid) is None
+
+    def test_hit_only_hop_semantics(self, mesh):
+        inst, _, _ = mesh
+        from modelmesh_tpu.serving.errors import ModelNotHereError
+
+        inst.register_model("m-hit", INFO, load_now=True, sync=True)
+        ctx = RoutingContext(hop=RoutingContext.HIT_ONLY)
+        out = inst.invoke_model("m-hit", PREDICT_METHOD, b"z", [], ctx)
+        assert out.status == "LOADED"
+        ctx2 = RoutingContext(hop=RoutingContext.HIT_ONLY)
+        with pytest.raises(ModelNotHereError):
+            inst.invoke_model("m-not-here", PREDICT_METHOD, b"z", [], ctx2)
+
+
+class TestEviction:
+    def test_capacity_pressure_evicts_lru(self, mesh):
+        inst, servicer, _ = mesh
+        # Fake sizes ~4-12 MB; capacity 64 MB -> a dozen models max.
+        ids = [f"m-ev-{i}" for i in range(12)]
+        for mid in ids:
+            inst.register_model(mid, INFO)
+            inst.invoke_model(mid, PREDICT_METHOD, b"x", [])
+            time.sleep(0.01)  # distinct LRU timestamps
+        assert inst.cache.weight <= inst.cache.capacity
+        evicted = [m for m in ids if inst.cache.get_quietly(m) is None]
+        assert evicted, "expected at least one eviction at this capacity"
+        # Evicted models were deregistered in the registry.
+        deadline = time.monotonic() + 5
+        for mid in evicted:
+            while time.monotonic() < deadline:
+                mr = inst.registry.get(mid)
+                if "i-test" not in mr.instance_ids:
+                    break
+                time.sleep(0.05)
+            assert "i-test" not in inst.registry.get(mid).instance_ids
+        # And eventually unloaded from the runtime.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and any(
+            m in servicer.loaded for m in evicted
+        ):
+            time.sleep(0.05)
+        assert not any(m in servicer.loaded for m in evicted)
+        # The most recently used copies survived.
+        assert inst.cache.get_quietly(ids[-1]) is not None
+
+
+class TestInstancePublishing:
+    def test_instance_record_published(self, mesh):
+        inst, _, store = mesh
+        inst.register_model("m-pub", INFO, load_now=True, sync=True)
+        inst.publish_instance_record(force=True)
+        inst.instances_view.wait_for(
+            lambda v: v.get("i-test") is not None
+            and v.get("i-test").model_count >= 1
+        )
+        rec = inst.instances_view.get("i-test")
+        assert rec.capacity_units == inst.params.capacity_units
+        assert rec.used_units > 0
+
+    def test_shutdown_migration_deregisters(self, mesh):
+        inst, servicer, _ = mesh
+        inst.register_model("m-mig", INFO, load_now=True, sync=True)
+        inst.shutdown_skip_migration = True  # single instance: nowhere to go
+        inst.pre_shutdown(deadline_s=5)
+        assert inst.cache.get_quietly("m-mig") is None
+        mr = inst.registry.get("m-mig")
+        assert "i-test" not in mr.instance_ids
+        assert inst.shutting_down

@@ -23,7 +23,7 @@ class TestModelRecord:
     def test_roundtrip(self, kv):
         t = KVTable(kv, "registry", ModelRecord)
         mr = ModelRecord(model_type="classifier", model_path="s3://m/1")
-        mr.add_instance("i1", ts=1000)
+        mr.promote_loaded("i1", ts=1000)
         mr.add_load_failure("i2", "OOM", ts=2000)
         t.conditional_set("m1", mr)
         back = t.get("m1")
@@ -54,14 +54,14 @@ class TestModelRecord:
         t = KVTable(kv, "registry", ModelRecord)
         t.conditional_set("m", ModelRecord(model_type="t"))
         a, b = t.get("m"), t.get("m")
-        a.add_instance("i1")
+        a.promote_loaded("i1")
         t.conditional_set("m", a)
-        b.add_instance("i2")
+        b.promote_loaded("i2")
         with pytest.raises(CasFailed):
             t.conditional_set("m", b)
         # retry loop resolves
         merged = t.update_or_create(
-            "m", lambda cur: (cur.add_instance("i2"), cur)[1]
+            "m", lambda cur: (cur.promote_loaded("i2"), cur)[1]
         )
         assert set(merged.instance_ids) == {"i1", "i2"}
 

@@ -1,0 +1,127 @@
+"""Runtime SPI tests: fake runtime server + sidecar client over real gRPC."""
+
+import time
+
+import grpc
+import pytest
+
+from modelmesh_tpu.runtime import ModelInfo, ModelLoadException
+from modelmesh_tpu.runtime.fake import (
+    FAIL_LOAD_PREFIX,
+    NOT_FOUND_SERVE_PREFIX,
+    PREDICT_METHOD,
+    FakeRuntimeServicer,
+    start_fake_runtime,
+)
+from modelmesh_tpu.runtime.sidecar import SidecarRuntime
+
+INFO = ModelInfo(model_type="example")
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    server, port, servicer = start_fake_runtime()
+    sidecar = SidecarRuntime(f"127.0.0.1:{port}", startup_timeout_s=10)
+    yield sidecar, servicer
+    sidecar.close()
+    server.stop(0)
+
+
+class TestStartupHandshake:
+    def test_startup_params(self, runtime):
+        sidecar, _ = runtime
+        params = sidecar.startup()
+        assert params.capacity_bytes == 512 << 20
+        assert params.load_concurrency == 8
+        assert params.capacity_units == (512 << 20) // 8192
+
+    def test_startup_waits_for_ready(self):
+        server, port, _ = start_fake_runtime(
+            servicer=FakeRuntimeServicer(ready_delay_s=0.6)
+        )
+        sidecar = SidecarRuntime(
+            f"127.0.0.1:{port}", startup_timeout_s=5, poll_interval_s=0.1
+        )
+        t0 = time.monotonic()
+        sidecar.startup()
+        assert time.monotonic() - t0 >= 0.5
+        sidecar.close()
+        server.stop(0)
+
+    def test_startup_timeout(self):
+        server, port, _ = start_fake_runtime(
+            servicer=FakeRuntimeServicer(ready_delay_s=60)
+        )
+        sidecar = SidecarRuntime(
+            f"127.0.0.1:{port}", startup_timeout_s=0.4, poll_interval_s=0.1
+        )
+        with pytest.raises(ModelLoadException) as exc:
+            sidecar.startup()
+        assert exc.value.timeout
+        sidecar.close()
+        server.stop(0)
+
+
+class TestLoadUnload:
+    def test_load_size_unload(self, runtime):
+        sidecar, servicer = runtime
+        loaded = sidecar.load("model-a", INFO)
+        assert loaded.size_bytes > 0
+        assert "model-a" in servicer.loaded
+        assert sidecar.model_size("model-a", loaded.handle) == loaded.size_bytes
+        sidecar.unload("model-a")
+        assert "model-a" not in servicer.loaded
+
+    def test_predict_size(self, runtime):
+        sidecar, _ = runtime
+        assert sidecar.predict_size("some-model", INFO) > 0
+
+    def test_load_failure_injected(self, runtime):
+        sidecar, servicer = runtime
+        with pytest.raises(ModelLoadException):
+            sidecar.load(FAIL_LOAD_PREFIX + "x", INFO)
+        assert FAIL_LOAD_PREFIX + "x" not in servicer.loaded
+
+    def test_refcounted_load_unload_pairing(self, runtime):
+        sidecar, servicer = runtime
+        sidecar.load("model-rc", INFO)
+        loads_before = servicer.load_count
+        sidecar.load("model-rc", INFO)       # second load: refcount only
+        assert servicer.load_count == loads_before
+        sidecar.unload("model-rc")            # pairs with second load
+        assert "model-rc" in servicer.loaded  # still loaded in runtime
+        sidecar.unload("model-rc")            # final: actually unloads
+        assert "model-rc" not in servicer.loaded
+
+
+class TestInference:
+    def test_call_model_roundtrip(self, runtime):
+        sidecar, _ = runtime
+        sidecar.load("model-b", INFO)
+        out = sidecar.call_model("model-b", PREDICT_METHOD, b"hello tensor")
+        assert out.startswith(b"model-b:category_")
+        sidecar.unload("model-b")
+
+    def test_missing_header_rejected(self, runtime):
+        sidecar, _ = runtime
+        from modelmesh_tpu.runtime import grpc_defs
+
+        call = grpc_defs.raw_method(sidecar._channel, PREDICT_METHOD)
+        with pytest.raises(grpc.RpcError) as exc:
+            call(b"x")
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_not_loaded_is_not_found(self, runtime):
+        sidecar, _ = runtime
+        with pytest.raises(grpc.RpcError) as exc:
+            sidecar.call_model("never-loaded", PREDICT_METHOD, b"x")
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def test_vanish_quirk_not_found(self, runtime):
+        sidecar, _ = runtime
+        mid = NOT_FOUND_SERVE_PREFIX + "m"
+        sidecar.load(mid, INFO)
+        with pytest.raises(grpc.RpcError) as exc:
+            sidecar.call_model(mid, PREDICT_METHOD, b"x")
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+        sidecar.unload(mid)

@@ -1,0 +1,219 @@
+"""JAX global placement strategy: per-request decisions from a TPU-solved plan.
+
+The north-star architecture (BASELINE.json): cluster state (registry +
+instance advertisements + rates) is assembled into a PlacementProblem,
+solved as one batched Sinkhorn/auction assignment on the accelerator
+(ops/solve.py single-chip, parallel/sharded_solver.py multi-chip), and the
+resulting plan serves `choose_load_target` lookups until the next refresh.
+
+Plans are ADVISORY (SURVEY.md section 7, hard part #4): per-instance local
+guards (churn age, unload accounting, capacity) remain authoritative, and
+any miss — model not in the plan, planned instances all excluded, plan
+older than its TTL — falls back to the greedy oracle strategy. This mirrors
+how the reference lets the placement heuristics be overridden per-decision
+but never bypasses local admission control.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from modelmesh_tpu.cache.lru import now_ms
+from modelmesh_tpu.placement.greedy import GreedyStrategy
+from modelmesh_tpu.placement.strategy import (
+    LOAD_HERE,
+    ClusterView,
+    PlacementRequest,
+    PlacementStrategy,
+)
+from modelmesh_tpu.records import InstanceRecord, ModelRecord
+
+log = logging.getLogger(__name__)
+
+
+def build_problem(
+    models: Sequence[tuple[str, ModelRecord]],
+    instances: Sequence[tuple[str, InstanceRecord]],
+    rpm_fn: Optional[Callable[[str], int]] = None,
+    default_size_units: int = 128,
+    max_copies: int = 8,
+):
+    """Assemble a PlacementProblem from registry/instance snapshots.
+
+    Returns (problem, model_ids, instance_ids) — the id lists map array rows
+    and columns back to the mesh. Zone names are densified to ids.
+    """
+    import jax.numpy as jnp
+
+    from modelmesh_tpu.ops.costs import PlacementProblem
+
+    model_ids = [mid for mid, _ in models]
+    instance_ids = [iid for iid, _ in instances]
+    n, m = len(model_ids), len(instance_ids)
+    inst_index = {iid: j for j, iid in enumerate(instance_ids)}
+    zones = sorted({rec.zone for _, rec in instances})
+    zone_id = {z: i for i, z in enumerate(zones)}
+
+    now = now_ms()
+    sizes = np.empty(n, np.float32)
+    copies = np.empty(n, np.int32)
+    rates = np.empty(n, np.float32)
+    loaded = np.zeros((n, m), bool)
+    for i, (mid, mr) in enumerate(models):
+        sizes[i] = mr.size_units or default_size_units
+        copies[i] = min(max(mr.copy_count, 1), max_copies)
+        rpm = rpm_fn(mid) if rpm_fn is not None else 0
+        if rpm > 0:
+            rates[i] = rpm
+        else:
+            # Recency proxy: rpm_fn is typically the refresher's *local*
+            # rate view, which reads 0 for models served on other instances
+            # — fall back rather than ranking remote-hot models as cold.
+            age_min = max(0.0, (now - mr.last_used) / 60_000.0)
+            rates[i] = 1000.0 / (1.0 + age_min)
+        for iid in mr.instance_ids:
+            j = inst_index.get(iid)
+            if j is not None:
+                loaded[i, j] = True
+
+    capacity = np.empty(m, np.float32)
+    reserved = np.empty(m, np.float32)
+    lru_age = np.empty(m, np.float32)
+    busy = np.empty(m, np.float32)
+    zone = np.empty(m, np.int32)
+    feasible_cols = np.empty(m, bool)
+    for j, (iid, rec) in enumerate(instances):
+        capacity[j] = max(rec.capacity_units, 1)
+        managed = float(sizes[loaded[:, j]].sum())
+        # reserved = advertised usage not attributable to planned models.
+        reserved[j] = max(0.0, rec.used_units - managed)
+        lru_age[j] = max(0.0, (now - rec.lru_ts) / 1000.0) if rec.lru_ts else 0.0
+        busy[j] = rec.req_per_minute
+        zone[j] = zone_id[rec.zone]
+        feasible_cols[j] = not rec.shutting_down
+    feasible = np.broadcast_to(feasible_cols, (n, m)).copy()
+
+    problem = PlacementProblem(
+        sizes=jnp.asarray(sizes),
+        copies=jnp.asarray(copies),
+        rates=jnp.asarray(rates),
+        loaded=jnp.asarray(loaded),
+        feasible=jnp.asarray(feasible),
+        capacity=jnp.asarray(capacity),
+        reserved=jnp.asarray(reserved),
+        lru_age=jnp.asarray(lru_age),
+        busyness=jnp.asarray(busy),
+        zone=jnp.asarray(zone),
+    )
+    return problem, model_ids, instance_ids
+
+
+class GlobalPlan:
+    """Solved assignment: model -> ordered preferred instances."""
+
+    def __init__(
+        self, placements: dict[str, list[str]], solved_at_ms: int,
+        solve_ms: float,
+    ):
+        self.placements = placements
+        self.solved_at_ms = solved_at_ms
+        self.solve_ms = solve_ms
+
+    def age_ms(self) -> int:
+        return now_ms() - self.solved_at_ms
+
+
+def solve_plan(
+    models: Sequence[tuple[str, ModelRecord]],
+    instances: Sequence[tuple[str, InstanceRecord]],
+    rpm_fn: Optional[Callable[[str], int]] = None,
+    seed: int = 0,
+) -> GlobalPlan:
+    """One global solve -> GlobalPlan (blocking; runs on the JAX device)."""
+    import jax
+
+    from modelmesh_tpu.ops.solve import solve_placement
+
+    if not models or not instances:
+        return GlobalPlan({}, now_ms(), 0.0)
+    t0 = time.perf_counter()
+    problem, model_ids, instance_ids = build_problem(models, instances, rpm_fn)
+    sol = jax.block_until_ready(solve_placement(problem, seed=seed))
+    idx = np.asarray(sol.indices)
+    valid = np.asarray(sol.valid)
+    placements = {
+        model_ids[i]: [instance_ids[j] for j in idx[i][valid[i]]]
+        for i in range(len(model_ids))
+    }
+    solve_ms = (time.perf_counter() - t0) * 1e3
+    return GlobalPlan(placements, now_ms(), solve_ms)
+
+
+class JaxPlacementStrategy(PlacementStrategy):
+    """Plan-serving strategy with greedy fallback.
+
+    ``refresher`` mode: call ``refresh(models, instances, rpm_fn)``
+    periodically (the reaper/janitor cadence, or a dedicated thread via
+    ``start_auto_refresh``). Decisions read the latest plan lock-free.
+    """
+
+    def __init__(
+        self,
+        plan_ttl_ms: int = 60_000,
+        fallback: Optional[PlacementStrategy] = None,
+    ):
+        self.plan_ttl_ms = plan_ttl_ms
+        self.fallback = fallback or GreedyStrategy()
+        self._plan: Optional[GlobalPlan] = None
+        self._seed = 0
+        self._refresh_lock = threading.Lock()
+
+    @property
+    def plan(self) -> Optional[GlobalPlan]:
+        return self._plan
+
+    def refresh(
+        self,
+        models: Sequence[tuple[str, ModelRecord]],
+        instances: Sequence[tuple[str, InstanceRecord]],
+        rpm_fn: Optional[Callable[[str], int]] = None,
+    ) -> GlobalPlan:
+        with self._refresh_lock:
+            self._seed += 1
+            plan = solve_plan(models, instances, rpm_fn, seed=self._seed)
+            self._plan = plan
+            log.info(
+                "placement plan refreshed: %d models x %d instances in %.1f ms",
+                len(plan.placements), len(instances), plan.solve_ms,
+            )
+            return plan
+
+    # -- SPI ----------------------------------------------------------------
+
+    def choose_load_target(
+        self, req: PlacementRequest, view: ClusterView
+    ) -> Optional[str]:
+        plan = self._plan
+        if plan is not None and plan.age_ms() <= self.plan_ttl_ms:
+            desired = plan.placements.get(req.model_id)
+            if desired:
+                live = {iid for iid, rec in view.live()}
+                for iid in desired:
+                    if iid in req.exclude or iid not in live:
+                        continue
+                    if iid in req.model.instance_ids:
+                        continue  # already loaded there
+                    return LOAD_HERE if iid == req.requesting_instance else iid
+        return self.fallback.choose_load_target(req, view)
+
+    def choose_serve_target(
+        self, model: ModelRecord, view: ClusterView, exclude: frozenset[str]
+    ) -> Optional[str]:
+        # Serve balancing stays local/greedy: it needs fresh busyness, not a
+        # global solve.
+        return self.fallback.choose_serve_target(model, view, exclude)

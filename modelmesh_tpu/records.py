@@ -51,6 +51,9 @@ class ModelRecord(Record):
     load_failures: dict[str, list] = dataclasses.field(default_factory=dict)
     ref_count: int = 0           # vmodel references
     auto_delete: bool = False    # delete when ref_count drops to 0
+    size_units: int = 0          # measured size (cache units); 0 = unknown.
+                                 # Piggybacked on load completion; feeds the
+                                 # global solver's cost matrix.
     last_used: int = 0           # lazily persisted (see should_persist_last_used)
     last_unload_ms: int = 0
     version: int = 0

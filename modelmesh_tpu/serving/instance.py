@@ -187,6 +187,8 @@ class ModelMeshInstance:
         self.unload_tracker = UnloadTracker(params.capacity_units)
         self.loading_pool = PrioritizedLoadingPool(params.load_concurrency)
         self.rate = RateTracker()
+        self._model_rates: dict[str, RateTracker] = {}
+        self._model_rates_lock = threading.Lock()
 
         prefix = self.config.kv_prefix
         self.registry: KVTable[ModelRecord] = KVTable(
@@ -222,6 +224,22 @@ class ModelMeshInstance:
 
     def cluster_view(self) -> ClusterView:
         return ClusterView(instances=self.instances_view.items())
+
+    def _model_rate(self, model_id: str) -> RateTracker:
+        with self._model_rates_lock:
+            rt = self._model_rates.get(model_id)
+            if rt is None:
+                rt = self._model_rates[model_id] = RateTracker()
+            return rt
+
+    def model_rpm(self, model_id: str, window_minutes: int = 5) -> int:
+        with self._model_rates_lock:
+            rt = self._model_rates.get(model_id)
+        return rt.rpm(window_minutes) if rt else 0
+
+    def _drop_model_rate(self, model_id: str) -> None:
+        with self._model_rates_lock:
+            self._model_rates.pop(model_id, None)
 
     def _on_leader_change(self, is_leader: bool) -> None:
         self.is_leader = is_leader
@@ -492,6 +510,7 @@ class ModelMeshInstance:
         try:
             out = self._runtime_call(ce, method, payload, headers)
             self.rate.record()
+            self._model_rate(ce.model_id).record()
             self.cache.get(ce.model_id)  # LRU touch
             return InvokeResult(out, self.instance_id, "LOADED")
         except ModelNotHereError:
@@ -643,18 +662,20 @@ class ModelMeshInstance:
                 # Removed (evicted/unregistered) while we were loading.
                 self.loader.unload(model_id)
                 return
-            self._promote_loaded(model_id)
+            self._promote_loaded(model_id, size_units=ce.weight_units)
             self.publish_instance_record()
         except ModelLoadException as e:
             self._load_failed(ce, str(e))
         except Exception as e:  # noqa: BLE001 — any load error is a failure
             self._load_failed(ce, f"{type(e).__name__}: {e}")
 
-    def _promote_loaded(self, model_id: str) -> None:
+    def _promote_loaded(self, model_id: str, size_units: int = 0) -> None:
         def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
             if cur is None:
                 return None
             cur.promote_loaded(self.instance_id, now_ms())
+            if size_units:
+                cur.size_units = size_units
             return cur
 
         try:
@@ -707,6 +728,8 @@ class ModelMeshInstance:
         if do_unload:
             self.unload_tracker.unload_started(units)
 
+        self._drop_model_rate(model_id)
+
         def post_evict():
             try:
                 self._deregister(model_id, record_unload_time=True)
@@ -730,6 +753,7 @@ class ModelMeshInstance:
             return False
         was_active = ce.state is EntryState.ACTIVE
         ce.remove()
+        self._drop_model_rate(model_id)
         self._deregister(model_id)
         if was_active and self.loader.requires_unload:
             self._async_unload(model_id, ce.weight_units)

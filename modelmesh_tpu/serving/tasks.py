@@ -1,0 +1,334 @@
+"""Background tasks: publisher, rate-tracking scaler, janitor, leader reaper.
+
+The autonomous layer of every instance (reference scheduled tasks,
+ModelMesh.java:1151-1172; behaviors in SURVEY.md section 3.5):
+
+- publisher: refresh our InstanceRecord advertisement periodically (40 s in
+  the reference; configurable here).
+- rate task (10 s): per-model scale-up — the 1->2 "used again" pattern and
+  the RPM-threshold N>2 rule (rateTrackingTask :5619-5806, default
+  threshold 2000 RPM per copy, :240).
+- janitor (6 min): local cache <-> registry reconciliation in both
+  directions, failure-record expiry, lazy lastUsed persistence, and
+  cluster-full scale-down of surplus copies (:5876-6379).
+- reaper (7 min, leader only): prune registrations pointing at instances
+  gone >10 min (:6524-6608), drop stale loading claims, and proactive
+  loading of recently-used-but-unloaded models into free space
+  (:6616-6747). The reaper consults the PlacementStrategy, so the JAX
+  global plan slots in here.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from modelmesh_tpu.cache.lru import now_ms
+from modelmesh_tpu.kv.store import CasFailed
+from modelmesh_tpu.records import ModelRecord
+from modelmesh_tpu.serving.entry import EntryState
+from modelmesh_tpu.serving.instance import ModelMeshInstance
+
+log = logging.getLogger(__name__)
+
+DEFAULT_SCALE_UP_RPM = 2000          # per copy (reference :240)
+SECOND_COPY_MIN_AGE_MS = 7 * 60_000  # "used again" window (reference :249)
+SECOND_COPY_MAX_AGE_MS = 40 * 60_000
+ASSUME_INSTANCE_GONE_MS = 10 * 60_000   # reaper prune grace (reference :270)
+STALE_LOADING_CLAIM_MS = 20 * 60_000    # loading claim with no progress
+CLUSTER_FULL_FRACTION = 0.95            # scale-down trigger (reference :6197)
+PROACTIVE_RESERVE_FRACTION = 0.125      # keep 12.5% free (reference :6616)
+
+
+class TaskConfig:
+    def __init__(
+        self,
+        publish_interval_s: float = 40.0,
+        rate_interval_s: float = 10.0,
+        janitor_interval_s: float = 360.0,
+        reaper_interval_s: float = 420.0,
+        scale_up_rpm: int = DEFAULT_SCALE_UP_RPM,
+        second_copy_min_age_ms: int = SECOND_COPY_MIN_AGE_MS,
+        second_copy_max_age_ms: int = SECOND_COPY_MAX_AGE_MS,
+        assume_gone_ms: int = ASSUME_INSTANCE_GONE_MS,
+        max_copies: int = 8,
+    ):
+        self.publish_interval_s = publish_interval_s
+        self.rate_interval_s = rate_interval_s
+        self.janitor_interval_s = janitor_interval_s
+        self.reaper_interval_s = reaper_interval_s
+        self.scale_up_rpm = scale_up_rpm
+        self.second_copy_min_age_ms = second_copy_min_age_ms
+        self.second_copy_max_age_ms = second_copy_max_age_ms
+        self.assume_gone_ms = assume_gone_ms
+        self.max_copies = max_copies
+
+
+class BackgroundTasks:
+    def __init__(
+        self, instance: ModelMeshInstance, config: Optional[TaskConfig] = None
+    ):
+        self.instance = instance
+        self.config = config or TaskConfig()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # model_id -> previous-use timestamp at last rate tick (drives the
+        # 1->2 "used, idle, used again" heuristic).
+        self._prev_use: dict[str, int] = {}
+        self._last_rate_tick = now_ms()
+        # leader state: instance_id -> first time we noticed it missing.
+        self._missing_since: dict[str, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        specs = [
+            ("publisher", self.config.publish_interval_s, self._publish_tick),
+            ("rate", self.config.rate_interval_s, self._rate_tick),
+            ("janitor", self.config.janitor_interval_s, self._janitor_tick),
+            ("reaper", self.config.reaper_interval_s, self._reaper_tick),
+        ]
+        for name, interval, fn in specs:
+            t = threading.Thread(
+                target=self._loop, args=(name, interval, fn),
+                name=f"task-{name}-{self.instance.instance_id}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self, name: str, interval: float, fn) -> None:
+        while not self._stop.wait(interval):
+            if self.instance.shutting_down:
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — tasks must not die
+                log.exception("task %s failed", name)
+
+    # -- publisher ---------------------------------------------------------
+
+    def _publish_tick(self) -> None:
+        self.instance.publish_instance_record()
+
+    # -- rate task: scale UP ----------------------------------------------
+
+    def _rate_tick(self) -> None:
+        inst = self.instance
+        cfg = self.config
+        tick_start = now_ms()
+        cutoff = self._last_rate_tick
+        self._last_rate_tick = tick_start
+        for model_id, ce, last_used in inst.cache.items_used_since(cutoff):
+            if ce.state is not EntryState.ACTIVE:
+                continue
+            mr = inst.registry_view.get(model_id)
+            if mr is None:
+                continue
+            copies = mr.copy_count
+            prev = self._prev_use.get(model_id, 0)
+            self._prev_use[model_id] = last_used
+            if copies >= cfg.max_copies:
+                continue
+            if copies <= 1:
+                # 1 -> 2: the model was used a while ago AND is in use now —
+                # recurring traffic deserves redundancy.
+                age = last_used - prev
+                if prev and cfg.second_copy_min_age_ms <= age <= cfg.second_copy_max_age_ms:
+                    self._add_copy(model_id, mr)
+                continue
+            # Local per-copy rate vs the per-copy threshold: each instance
+            # sees only its own copy's traffic, so if the copy it serves is
+            # at threshold, the model needs another copy (reference
+            # rateTrackingTask compares local rpm to scaleUpRpms,
+            # ModelMesh.java:5762).
+            rpm = inst.model_rpm(model_id)
+            if rpm >= cfg.scale_up_rpm:
+                self._add_copy(model_id, mr)
+
+    def _add_copy(self, model_id: str, mr: ModelRecord) -> None:
+        try:
+            self.instance.ensure_loaded(
+                model_id, sync=False, exclude=set(mr.all_placements)
+            )
+            log.info("scale-up: requested extra copy of %s", model_id)
+        except Exception as e:  # noqa: BLE001 — advisory
+            log.debug("scale-up of %s skipped: %s", model_id, e)
+
+    # -- janitor: reconcile + scale DOWN ----------------------------------
+
+    def _janitor_tick(self) -> None:
+        inst = self.instance
+        now = now_ms()
+        # (a) registry -> local: drop local copies of unregistered models;
+        #     repair records that lost our placement entry.
+        for model_id in inst.cache.keys():
+            ce = inst.cache.get_quietly(model_id)
+            if ce is None:
+                continue
+            mr = inst.registry.get(model_id)
+            if mr is None:
+                log.info("janitor: %s unregistered; removing local copy", model_id)
+                inst._remove_local(model_id)
+                continue
+            changed = False
+            if (
+                ce.state is EntryState.ACTIVE
+                and inst.instance_id not in mr.instance_ids
+            ):
+                mr.promote_loaded(inst.instance_id, ce.load_completed_ms or now)
+                changed = True
+            if mr.expire_load_failures(now):
+                changed = True
+            # Lazy lastUsed persistence (reference ModelRecord.java:96-105).
+            local_last_used = inst.cache.last_used(model_id) or 0
+            if mr.should_persist_last_used(local_last_used):
+                mr.last_used = local_last_used
+                changed = True
+            if changed:
+                try:
+                    inst.registry.conditional_set(model_id, mr)
+                except CasFailed:
+                    pass
+        # (b) local -> registry: records claiming we hold a copy we don't.
+        for model_id, mr in inst.registry.items():
+            if mr.placed_on(inst.instance_id) and model_id not in inst.cache:
+                def fix(cur):
+                    if cur is None:
+                        return None
+                    cur.remove_instance(inst.instance_id)
+                    return cur
+                try:
+                    inst.registry.update_or_create(model_id, fix)
+                except CasFailed:
+                    pass
+        # (c) scale-down when the cluster is nearly full.
+        self._maybe_scale_down()
+
+    def _cluster_fullness(self) -> float:
+        views = self.instance.instances_view.items()
+        cap = sum(r.capacity_units for _, r in views) or 1
+        used = sum(r.used_units for _, r in views)
+        return used / cap
+
+    def _maybe_scale_down(self) -> None:
+        inst = self.instance
+        cfg = self.config
+        if self._cluster_fullness() < CLUSTER_FULL_FRACTION:
+            return
+        for model_id in inst.cache.keys():
+            mr = inst.registry_view.get(model_id)
+            if mr is None or mr.copy_count < 2:
+                continue
+            rpm = inst.model_rpm(model_id)
+            # Our copy is surplus if OUR traffic is well under the per-copy
+            # threshold (reference: < 2/3 of it, :6197-6379) — local rate vs
+            # per-copy threshold, symmetric with scale-up.
+            if rpm < cfg.scale_up_rpm * 2 // 3:
+                # Lowest-id holder keeps the copy; others shed it so only
+                # one instance drops per pass.
+                holders = sorted(mr.instance_ids)
+                if holders and holders[-1] == inst.instance_id:
+                    log.info("scale-down: dropping surplus copy of %s", model_id)
+                    inst._remove_local(model_id)
+
+    # -- reaper (leader only) ---------------------------------------------
+
+    def _reaper_tick(self) -> None:
+        inst = self.instance
+        if not inst.is_leader:
+            self._missing_since.clear()
+            return
+        # When the instance runs the JAX global strategy, the reaper is its
+        # refresh cadence: solve one global plan from current state; the
+        # routing layer serves decisions from it until the next pass.
+        refresh = getattr(inst.strategy, "refresh", None)
+        if refresh is not None:
+            try:
+                refresh(
+                    list(inst.registry.items()),
+                    inst.instances_view.items(),
+                    inst.model_rpm,
+                )
+            except Exception:  # noqa: BLE001 — plan is advisory
+                log.exception("global plan refresh failed")
+        now = now_ms()
+        live = {iid for iid, _ in inst.instances_view.items()}
+        # Track how long each referenced instance has been missing.
+        referenced: set[str] = set()
+        records = list(inst.registry.items())
+        for _, mr in records:
+            referenced |= mr.all_placements
+        for iid in referenced - live:
+            self._missing_since.setdefault(iid, now)
+        for iid in list(self._missing_since):
+            if iid in live:
+                del self._missing_since[iid]
+        gone = {
+            iid for iid, since in self._missing_since.items()
+            if now - since >= self.config.assume_gone_ms
+        }
+        # (a) prune placements on gone instances + stale loading claims.
+        for model_id, mr in records:
+            stale_claims = [
+                iid for iid, ts in mr.loading_instances.items()
+                if iid in gone or (
+                    iid not in live and now - ts > STALE_LOADING_CLAIM_MS
+                )
+            ]
+            dead = [iid for iid in mr.instance_ids if iid in gone]
+            if not stale_claims and not dead:
+                continue
+
+            def prune(cur):
+                if cur is None:
+                    return None
+                for iid in stale_claims + dead:
+                    cur.remove_instance(iid)
+                return cur
+
+            try:
+                inst.registry.update_or_create(model_id, prune)
+                log.info(
+                    "reaper: pruned %s from %s", stale_claims + dead, model_id
+                )
+            except CasFailed:
+                pass
+        # (b) proactive loading: restore the most-recently-used unloaded
+        #     models into free cluster space, above a reserve.
+        self._proactive_load(records)
+
+    def _proactive_load(self, records) -> None:
+        inst = self.instance
+        views = inst.instances_view.items()
+        cap = sum(r.capacity_units for _, r in views) or 1
+        used = sum(r.used_units for _, r in views)
+        budget_units = int((cap - used) - cap * PROACTIVE_RESERVE_FRACTION) // 2
+        if budget_units <= 0:
+            return
+        unloaded = [
+            (mr.last_used, model_id, mr)
+            for model_id, mr in records
+            if not mr.instance_ids and not mr.loading_instances
+            and not mr.load_exhausted()
+        ]
+        unloaded.sort(reverse=True, key=lambda t: t[0])
+        default_units = 128
+        loads = 0
+        for last_used, model_id, mr in unloaded:
+            if loads >= 8:  # bounded per pass
+                break
+            cost = mr.size_units or default_units
+            if cost > budget_units:
+                continue  # next candidate might be smaller
+            try:
+                inst.ensure_loaded(model_id, last_used_ms=last_used, sync=False)
+                budget_units -= cost
+                loads += 1
+                log.info("reaper: proactive load of %s (%du)", model_id, cost)
+            except Exception as e:  # noqa: BLE001 — advisory
+                log.debug("proactive load of %s skipped: %s", model_id, e)
+                break

@@ -1,0 +1,336 @@
+"""Virtual models: stable aliases over concrete model versions.
+
+Capability parity with the reference's VModelManager (VModelManager.java,
+SURVEY.md section 2.1): a vmodel maps a stable id to an ``active`` concrete
+model; updating the vmodel to a new ``target`` starts a managed transition —
+the target is loaded up to the active's copy count before promotion, so the
+alias never points at a cold model. Concrete models are ref-counted and can
+be auto-deleted when the last vmodel reference moves away (:749-767).
+Failed transitions are parked (``target_load_failed``) and retried by the
+leader's transition sweep (:666-683). Per-request resolution with a
+retry-on-concurrent-transition loop mirrors resolveVModelId (:569).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+import grpc
+
+from modelmesh_tpu.cache.lru import now_ms
+from modelmesh_tpu.kv.store import CasFailed, KVStore
+from modelmesh_tpu.kv.table import KVTable, TableView
+from modelmesh_tpu.proto import mesh_api_pb2 as apb
+from modelmesh_tpu.records import ModelRecord, VModelRecord
+from modelmesh_tpu.runtime.spi import ModelInfo
+from modelmesh_tpu.serving.instance import ModelMeshInstance
+
+log = logging.getLogger(__name__)
+
+
+class VModelManager:
+    def __init__(
+        self,
+        instance: ModelMeshInstance,
+        sweep_interval_s: float = 30.0,
+    ):
+        self.instance = instance
+        store: KVStore = instance.store
+        prefix = instance.config.kv_prefix
+        self.table: KVTable[VModelRecord] = KVTable(
+            store, f"{prefix}/vmodels", VModelRecord
+        )
+        self.view: TableView[VModelRecord] = TableView(self.table)
+        self._stop = threading.Event()
+        self._kick = threading.Event()
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, args=(sweep_interval_s,),
+            name=f"vmodel-sweep-{instance.instance_id}", daemon=True,
+        )
+        self._sweeper.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        self.view.close()
+
+    # ------------------------------------------------------------------ #
+    # management ops                                                     #
+    # ------------------------------------------------------------------ #
+
+    def set_vmodel(self, request, context, status_fn) -> apb.VModelStatusInfo:
+        vmid = request.vmodel_id
+        target = request.target_model_id
+        if not vmid or not target:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "vmodel_id and target_model_id are required",
+            )
+        info = ModelInfo(
+            model_type=request.info.model_type,
+            model_path=request.info.model_path,
+            model_key=request.info.model_key,
+        )
+        # Register the target concrete model; the vmodel reference is added
+        # only if the record mutation actually starts referencing it (an
+        # idempotent re-set must not leak a ref).
+        self.instance.register_model(target, info)
+
+        existing = self.table.get(vmid)
+        if existing is None and request.update_only:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"vmodel {vmid} does not exist"
+            )
+        if (
+            existing is not None
+            and existing.owner
+            and request.owner
+            and existing.owner != request.owner
+        ):
+            context.abort(
+                grpc.StatusCode.ALREADY_EXISTS,
+                f"vmodel {vmid} is owned by {existing.owner}",
+            )
+
+        # Written fresh on every mutate attempt so CAS retries don't
+        # accumulate stale outcomes.
+        outcome: dict = {}
+
+        def mutate(cur: Optional[VModelRecord]) -> VModelRecord:
+            outcome.clear()
+            if cur is None:
+                outcome["added_ref"] = True
+                return VModelRecord(
+                    owner=request.owner, active_model=target, target_model=target
+                )
+            if cur.target_model != target:
+                if cur.target_model != cur.active_model and not request.force:
+                    # A different transition is already running.
+                    raise _TransitionBusy(cur.target_model)
+                outcome["added_ref"] = True
+                if cur.target_model != cur.active_model:
+                    outcome["superseded"] = cur.target_model
+                cur.target_model = target
+                cur.target_load_failed = False
+            return cur
+
+        try:
+            vr = self.table.update_or_create(vmid, mutate)
+        except _TransitionBusy as e:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"vmodel {vmid} transition to {e.args[0]} in progress "
+                f"(use force to supersede)",
+            )
+        if outcome.get("added_ref"):
+            self._bump_ref(target, +1, auto_delete=request.auto_delete_target)
+        superseded = outcome.get("superseded")
+        if superseded and superseded != target:
+            self._bump_ref(superseded, -1)  # superseded mid-transition
+
+        if request.load_now or vr.in_transition:
+            if request.sync:
+                self._advance_transition(vmid)
+            else:
+                self._kick.set()
+        if request.load_now and not vr.in_transition:
+            try:
+                self.instance.ensure_loaded(target, sync=request.sync)
+            except Exception as e:  # noqa: BLE001 — best effort
+                log.debug("vmodel %s initial load: %s", vmid, e)
+        return self._status(vmid, status_fn)
+
+    def delete_vmodel(self, request, context) -> apb.DeleteVModelResponse:
+        vmid = request.vmodel_id
+        # CAS-retry: a concurrent promotion bumps the record version between
+        # read and delete; silently not deleting (while returning success)
+        # would leak the alias and its refs.
+        for _ in range(10):
+            vr = self.table.get(vmid)
+            if vr is None:
+                return apb.DeleteVModelResponse()
+            if vr.owner and request.owner and vr.owner != request.owner:
+                context.abort(
+                    grpc.StatusCode.ALREADY_EXISTS,
+                    f"vmodel {vmid} is owned by {vr.owner}",
+                )
+            if self.table.conditional_delete(vmid, vr.version):
+                refs = {vr.active_model, vr.target_model} - {""}
+                for mid in refs:
+                    self._bump_ref(mid, -1)
+                return apb.DeleteVModelResponse()
+        context.abort(
+            grpc.StatusCode.ABORTED,
+            f"vmodel {vmid} delete kept conflicting; retry",
+        )
+
+    def get_vmodel_status(self, request, context, status_fn) -> apb.VModelStatusInfo:
+        return self._status(request.vmodel_id, status_fn, abort_ctx=context)
+
+    def _status(
+        self, vmid: str, status_fn, abort_ctx=None
+    ) -> apb.VModelStatusInfo:
+        # Authoritative read: the watch-fed view may lag a just-completed
+        # synchronous transition; status RPCs are rare enough to pay the
+        # direct KV read.
+        vr = self.table.get(vmid) or self.view.get(vmid)
+        if vr is None:
+            if abort_ctx is not None:
+                abort_ctx.abort(
+                    grpc.StatusCode.NOT_FOUND, f"vmodel {vmid} not found"
+                )
+            return apb.VModelStatusInfo()
+        if not vr.in_transition:
+            transition = apb.VModelStatusInfo.NONE
+        elif vr.target_load_failed:
+            transition = apb.VModelStatusInfo.FAILED
+        else:
+            transition = apb.VModelStatusInfo.IN_PROGRESS
+        return apb.VModelStatusInfo(
+            active_model_id=vr.active_model,
+            target_model_id=vr.target_model,
+            transition=transition,
+            active_status=status_fn(vr.active_model),
+            owner=vr.owner,
+        )
+
+    # ------------------------------------------------------------------ #
+    # per-request resolution                                             #
+    # ------------------------------------------------------------------ #
+
+    def resolve(self, vmodel_id: str, context=None) -> str:
+        """vmodel id -> active concrete id, tolerating concurrent
+        transitions (retry loop, reference resolveVModelId :569)."""
+        for _ in range(3):
+            vr = self.view.get(vmodel_id) or self.table.get(vmodel_id)
+            if vr is None:
+                break
+            active = vr.active_model
+            if self.instance.registry_view.get(active) is not None or (
+                self.instance.registry.get(active) is not None
+            ):
+                return active
+            # Active model vanished mid-promotion; re-read.
+        if context is not None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"vmodel {vmodel_id} not found"
+            )
+        raise KeyError(vmodel_id)
+
+    # ------------------------------------------------------------------ #
+    # transitions                                                        #
+    # ------------------------------------------------------------------ #
+
+    def _sweep_loop(self, interval: float) -> None:
+        while True:
+            kicked = self._kick.wait(interval)
+            self._kick.clear()
+            if self._stop.is_set():
+                return
+            # The leader sweeps ALL transitions (including parked/stuck ones
+            # left by dead initiators); a non-leader only advances ones it
+            # was just kicked for (its own async SetVModel calls).
+            if not (kicked or self.instance.is_leader):
+                continue
+            try:
+                for vmid, vr in self.view.items():
+                    if vr.in_transition and not (
+                        vr.target_load_failed and not self.instance.is_leader
+                    ):
+                        self._advance_transition(vmid)
+            except Exception:  # noqa: BLE001
+                log.exception("vmodel sweep failed")
+
+    def _advance_transition(self, vmid: str) -> None:
+        vr = self.table.get(vmid)
+        if vr is None or not vr.in_transition:
+            return
+        target = vr.target_model
+        old = vr.active_model
+        old_mr = self.instance.registry.get(old)
+        want_copies = max(1, old_mr.copy_count if old_mr else 1)
+        try:
+            # Load the target up to the active's scale before promotion.
+            tgt = self.instance.registry.get(target)
+            have = len(tgt.instance_ids) if tgt else 0
+            while have < want_copies:
+                exclude = set(tgt.all_placements) if tgt else set()
+                self.instance.ensure_loaded(target, sync=True, exclude=exclude)
+                new_tgt = self.instance.registry.get(target)
+                new_have = len(new_tgt.instance_ids) if new_tgt else 0
+                if new_have <= have:
+                    break  # no progress (cluster can't fit more copies)
+                tgt, have = new_tgt, new_have
+            if have < 1:
+                raise RuntimeError(f"no copies of target {target} loaded")
+        except Exception as e:  # noqa: BLE001 — park the transition
+            log.warning("vmodel %s transition failed: %s", vmid, e)
+
+            def park(cur):
+                if cur is None or cur.target_model != target:
+                    return cur
+                cur.target_load_failed = True
+                return cur
+
+            try:
+                self.table.update_or_create(vmid, park)
+            except CasFailed:
+                pass
+            return
+
+        # Only the racer whose CAS actually flips active -> target releases
+        # the old model's reference; a concurrent promoter that finds the
+        # flip already done must not double-decrement.
+        outcome: dict = {}
+
+        def promote(cur: Optional[VModelRecord]) -> Optional[VModelRecord]:
+            outcome.clear()
+            if cur is None or cur.target_model != target:
+                return cur  # superseded
+            if cur.active_model == target:
+                return cur  # already promoted by a concurrent sweeper
+            outcome["flipped_from"] = cur.active_model
+            cur.active_model = target
+            cur.target_load_failed = False
+            return cur
+
+        try:
+            self.table.update_or_create(vmid, promote)
+        except CasFailed:
+            return
+        flipped_from = outcome.get("flipped_from")
+        if flipped_from and flipped_from != target:
+            self._bump_ref(flipped_from, -1)
+        if flipped_from is not None:
+            log.info("vmodel %s promoted %s -> %s", vmid, flipped_from, target)
+
+    # ------------------------------------------------------------------ #
+    # concrete-model ref counting                                        #
+    # ------------------------------------------------------------------ #
+
+    def _bump_ref(self, model_id: str, delta: int, auto_delete: bool = False) -> None:
+        deleted = []
+
+        def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
+            if cur is None:
+                return None
+            cur.ref_count = max(0, cur.ref_count + delta)
+            if delta > 0 and auto_delete:
+                cur.auto_delete = True
+            if cur.ref_count == 0 and cur.auto_delete:
+                deleted.append(model_id)
+                return None  # delete the registration
+            return cur
+
+        try:
+            self.instance.registry.update_or_create(model_id, mutate)
+        except CasFailed:
+            log.warning("ref-count CAS gave up for %s", model_id)
+        if deleted:
+            log.info("auto-deleted unreferenced model %s", model_id)
+
+
+class _TransitionBusy(Exception):
+    pass

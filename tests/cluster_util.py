@@ -16,6 +16,7 @@ from modelmesh_tpu.runtime.fake import FakeRuntimeServicer, start_fake_runtime
 from modelmesh_tpu.runtime.sidecar import SidecarRuntime
 from modelmesh_tpu.serving.api import MeshServer, PeerChannels, make_grpc_peer_call
 from modelmesh_tpu.serving.instance import InstanceConfig, ModelMeshInstance
+from modelmesh_tpu.serving.vmodels import VModelManager
 
 
 @dataclasses.dataclass
@@ -25,6 +26,7 @@ class Pod:
     runtime_server: object
     runtime: FakeRuntimeServicer
     loader: SidecarRuntime
+    vmodels: VModelManager
 
     @property
     def iid(self) -> str:
@@ -33,6 +35,7 @@ class Pod:
     def stop(self, hard: bool = False) -> None:
         """hard=True simulates a crash: server vanishes, session lease dies."""
         self.server.stop(0 if hard else 0.5)
+        self.vmodels.close()
         if hard:
             # Crash: revoke the lease instead of graceful shutdown.
             self.instance._session.close()
@@ -72,10 +75,13 @@ class Cluster:
                 ),
                 peer_call=peer_call,
             )
-            server = MeshServer(inst)
+            vmodels = VModelManager(inst, sweep_interval_s=0.3)
+            server = MeshServer(inst, vmodels=vmodels)
             inst.config.endpoint = server.endpoint
             inst.publish_instance_record(force=True)
-            self.pods.append(Pod(inst, server, rt_server, servicer, loader))
+            self.pods.append(
+                Pod(inst, server, rt_server, servicer, loader, vmodels)
+            )
         # Wait until every instance sees the whole fleet.
         for pod in self.pods:
             pod.instance.instances_view.wait_for(

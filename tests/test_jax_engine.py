@@ -1,0 +1,150 @@
+"""JAX placement strategy: problem building, plan serving, greedy fallback,
+and a live cluster running with the global strategy end-to-end."""
+
+import time
+
+import numpy as np
+import pytest
+
+from modelmesh_tpu.placement.jax_engine import (
+    JaxPlacementStrategy,
+    build_problem,
+    solve_plan,
+)
+from modelmesh_tpu.placement.strategy import (
+    LOAD_HERE,
+    ClusterView,
+    PlacementRequest,
+)
+from modelmesh_tpu.records import InstanceRecord, ModelRecord
+
+
+def _models(n, loaded_on=None, size=64):
+    out = []
+    for i in range(n):
+        mr = ModelRecord(model_type="t", size_units=size, last_used=1000)
+        if loaded_on:
+            mr.promote_loaded(loaded_on[i % len(loaded_on)], 1000)
+        out.append((f"m{i}", mr))
+    return out
+
+
+def _instances(m, cap=10_000, zone_cycle=("a", "b")):
+    return [
+        (
+            f"i{j}",
+            InstanceRecord(
+                capacity_units=cap, used_units=cap // 10,
+                zone=zone_cycle[j % len(zone_cycle)], lru_ts=1_000,
+            ),
+        )
+        for j in range(m)
+    ]
+
+
+class TestBuildProblem:
+    def test_shapes_and_mappings(self):
+        models = _models(6, loaded_on=["i1"])
+        instances = _instances(3)
+        problem, mids, iids = build_problem(models, instances)
+        assert problem.loaded.shape == (6, 3)
+        assert mids == [f"m{i}" for i in range(6)]
+        # Everything was marked loaded on i1 (column 1).
+        assert bool(np.asarray(problem.loaded)[:, 1].all())
+        assert not np.asarray(problem.loaded)[:, 0].any()
+        # reserved excludes managed (loaded) mass.
+        managed_col1 = float(np.asarray(problem.sizes).sum())
+        assert np.asarray(problem.reserved)[1] == pytest.approx(
+            max(0, 1000 - managed_col1), abs=1.0
+        )
+
+    def test_shutting_down_instances_infeasible(self):
+        models = _models(4)
+        instances = _instances(3)
+        instances[2][1].shutting_down = True
+        problem, _, _ = build_problem(models, instances)
+        feas = np.asarray(problem.feasible)
+        assert not feas[:, 2].any()
+        assert feas[:, :2].all()
+
+
+class TestPlanServing:
+    def test_plan_respected_then_fallback_on_ttl(self):
+        models = _models(8)
+        instances = _instances(4)
+        strat = JaxPlacementStrategy(plan_ttl_ms=60_000)
+        plan = strat.refresh(models, instances)
+        assert len(plan.placements) == 8
+        view = ClusterView(instances=instances)
+        mid, mr = models[0]
+        desired = plan.placements[mid][0]
+        req = PlacementRequest(
+            model_id=mid, model=mr, required_units=64,
+            requesting_instance="i-other",
+        )
+        assert strat.choose_load_target(req, view) == desired
+        # Requester being the planned target maps to LOAD_HERE.
+        req2 = PlacementRequest(
+            model_id=mid, model=mr, required_units=64,
+            requesting_instance=desired,
+        )
+        assert strat.choose_load_target(req2, view) == LOAD_HERE
+        # Expired plan falls back to greedy (still returns something valid).
+        strat.plan_ttl_ms = 0
+        time.sleep(0.002)
+        out = strat.choose_load_target(req, view)
+        assert out is not None
+
+    def test_excluded_planned_instances_skipped(self):
+        models = _models(4)
+        instances = _instances(4)
+        strat = JaxPlacementStrategy()
+        plan = strat.refresh(models, instances)
+        mid, mr = models[1]
+        desired = plan.placements[mid]
+        req = PlacementRequest(
+            model_id=mid, model=mr, required_units=64,
+            requesting_instance="iX",
+            exclude=frozenset(desired),
+        )
+        out = strat.choose_load_target(req, ClusterView(instances=instances))
+        assert out not in desired  # fallback found something else
+
+    def test_empty_inputs(self):
+        plan = solve_plan([], [])
+        assert plan.placements == {}
+
+
+class TestClusterWithJaxStrategy:
+    def test_end_to_end_with_global_plan(self):
+        from modelmesh_tpu.runtime import ModelInfo
+        from modelmesh_tpu.runtime.fake import PREDICT_METHOD
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=2)
+        try:
+            # Swap in the JAX strategy live (plan empty -> greedy fallback).
+            strategies = []
+            for pod in c.pods:
+                s = JaxPlacementStrategy()
+                pod.instance.strategy = s
+                strategies.append(s)
+            inst = c[0].instance
+            info = ModelInfo(model_type="example")
+            for k in range(4):
+                inst.register_model(f"mj{k}", info)
+                inst.invoke_model(f"mj{k}", PREDICT_METHOD, b"x", [])
+            # Refresh plans from real cluster state and serve from them.
+            for pod, s in zip(c.pods, strategies):
+                s.refresh(
+                    list(pod.instance.registry.items()),
+                    pod.instance.instances_view.items(),
+                    pod.instance.model_rpm,
+                )
+            assert strategies[0].plan is not None
+            assert len(strategies[0].plan.placements) == 4
+            inst.register_model("mj-new", info)
+            out = inst.invoke_model("mj-new", PREDICT_METHOD, b"y", [])
+            assert out.payload.startswith(b"mj-new:")
+        finally:
+            c.close()

@@ -1,0 +1,117 @@
+"""Autoscaling/janitor/reaper behavior tests (accelerated intervals)."""
+
+import time
+
+import pytest
+
+from modelmesh_tpu.runtime import ModelInfo
+from modelmesh_tpu.runtime.fake import PREDICT_METHOD
+from modelmesh_tpu.serving.tasks import BackgroundTasks, TaskConfig
+from tests.cluster_util import Cluster
+
+INFO = ModelInfo(model_type="example", model_path="mem://t")
+
+
+def _wait(pred, timeout=10.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(step)
+    return True
+
+
+@pytest.fixture()
+def cluster_with_tasks():
+    c = Cluster(n=3)
+    cfg = TaskConfig(
+        publish_interval_s=0.2,
+        rate_interval_s=0.2,
+        janitor_interval_s=0.4,
+        reaper_interval_s=0.4,
+        scale_up_rpm=1,
+        second_copy_min_age_ms=0,
+        second_copy_max_age_ms=10**9,
+        assume_gone_ms=200,
+    )
+    tasks = [BackgroundTasks(p.instance, cfg) for p in c.pods]
+    for t in tasks:
+        t.start()
+    yield c
+    for t in tasks:
+        t.stop()
+    c.close()
+
+
+class TestScaleUp:
+    def test_recurring_use_gets_second_copy(self, cluster_with_tasks):
+        c = cluster_with_tasks
+        inst = c[0].instance
+        inst.register_model("m-hot", INFO)
+        # Repeated use across rate ticks triggers the 1->2 pattern.
+        for _ in range(6):
+            inst.invoke_model("m-hot", PREDICT_METHOD, b"x", [])
+            time.sleep(0.25)
+        assert _wait(
+            lambda: len(inst.registry.get("m-hot").instance_ids) >= 2
+        ), f"copies: {inst.registry.get('m-hot').instance_ids}"
+
+
+class TestJanitor:
+    def test_removes_local_copy_of_unregistered_model(self, cluster_with_tasks):
+        c = cluster_with_tasks
+        inst = c[0].instance
+        inst.register_model("m-jan", INFO)
+        inst.invoke_model("m-jan", PREDICT_METHOD, b"x", [])
+        holder = c.pod_with_copy("m-jan").instance
+        # Simulate an out-of-band deregistration (bypasses unregister_model).
+        inst.registry.delete("m-jan")
+        assert _wait(lambda: holder.cache.get_quietly("m-jan") is None)
+
+    def test_repairs_lost_placement_entry(self, cluster_with_tasks):
+        c = cluster_with_tasks
+        inst = c[0].instance
+        inst.register_model("m-rep", INFO, load_now=True, sync=True)
+        holder = c.pod_with_copy("m-rep").instance
+        # Simulate a lost placement entry (e.g. overzealous prune).
+        def strip(cur):
+            cur.remove_instance(holder.instance_id)
+            return cur
+        inst.registry.update_or_create("m-rep", strip)
+        assert _wait(
+            lambda: holder.instance_id
+            in inst.registry.get("m-rep").instance_ids
+        )
+
+
+class TestReaper:
+    def test_prunes_gone_instance_placements(self, cluster_with_tasks):
+        c = cluster_with_tasks
+        inst = c[0].instance
+        inst.register_model("m-ghost", INFO)
+
+        def haunt(cur):
+            cur.promote_loaded("i-ghost", 12345)
+            return cur
+
+        inst.registry.update_or_create("m-ghost", haunt)
+        assert _wait(
+            lambda: "i-ghost" not in inst.registry.get("m-ghost").instance_ids,
+            timeout=15,
+        )
+
+    def test_proactive_load_of_recently_used_model(self, cluster_with_tasks):
+        c = cluster_with_tasks
+        inst = c[0].instance
+        # Registered with recent lastUsed but no copies anywhere.
+        inst.register_model("m-warm", INFO)
+
+        def touch(cur):
+            cur.last_used = int(time.time() * 1000)
+            return cur
+
+        inst.registry.update_or_create("m-warm", touch)
+        assert _wait(
+            lambda: len(inst.registry.get("m-warm").instance_ids) >= 1,
+            timeout=15,
+        )

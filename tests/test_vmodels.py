@@ -1,0 +1,130 @@
+"""VModel tests: aliasing, managed transitions, ref-counting, ownership.
+
+Mirrors the reference's VModelsTest coverage (SURVEY.md section 4).
+"""
+
+import time
+
+import grpc
+import pytest
+
+from modelmesh_tpu.proto import mesh_api_pb2 as apb
+from modelmesh_tpu.runtime import grpc_defs
+from modelmesh_tpu.runtime.fake import FAIL_LOAD_PREFIX, PREDICT_METHOD
+from tests.cluster_util import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n=2)
+    yield c
+    c.close()
+
+
+@pytest.fixture(scope="module")
+def api(cluster):
+    ch = grpc.insecure_channel(cluster[0].server.endpoint)
+    yield grpc_defs.make_stub(ch, grpc_defs.API_SERVICE, grpc_defs.API_METHODS)
+    ch.close()
+
+
+def set_vmodel(api, vmid, target, **kw):
+    return api.SetVModel(
+        apb.SetVModelRequest(
+            vmodel_id=vmid,
+            target_model_id=target,
+            info=apb.ModelInfo(model_type="example", model_path="mem://v"),
+            **kw,
+        )
+    )
+
+
+def infer_vmodel(cluster, vmid, payload=b"req"):
+    ch = grpc.insecure_channel(cluster[1].server.endpoint)
+    try:
+        return grpc_defs.raw_method(ch, PREDICT_METHOD)(
+            payload,
+            metadata=[(grpc_defs.VMODEL_ID_HEADER, vmid)],
+            timeout=20,
+        )
+    finally:
+        ch.close()
+
+
+class TestVModelBasics:
+    def test_create_and_infer_via_alias(self, cluster, api):
+        st = set_vmodel(api, "alias", "concrete-v1", load_now=True, sync=True)
+        assert st.active_model_id == "concrete-v1"
+        assert st.transition == apb.VModelStatusInfo.NONE
+        out = infer_vmodel(cluster, "alias")
+        assert out.startswith(b"concrete-v1:")
+
+    def test_update_only_missing_vmodel(self, api):
+        with pytest.raises(grpc.RpcError) as exc:
+            set_vmodel(api, "missing-vm", "x", update_only=True)
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def test_owner_protection(self, api):
+        set_vmodel(api, "owned", "own-v1", owner="team-a")
+        with pytest.raises(grpc.RpcError) as exc:
+            set_vmodel(api, "owned", "own-v2", owner="team-b")
+        assert exc.value.code() == grpc.StatusCode.ALREADY_EXISTS
+
+    def test_get_status_missing(self, api):
+        with pytest.raises(grpc.RpcError) as exc:
+            api.GetVModelStatus(apb.GetVModelStatusRequest(vmodel_id="ghost-vm"))
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+class TestTransitions:
+    def test_version_rollover_promotes_and_cleans_up(self, cluster, api):
+        inst = cluster[0].instance
+        set_vmodel(
+            api, "roll", "roll-v1", load_now=True, sync=True,
+            auto_delete_target=True,
+        )
+        assert infer_vmodel(cluster, "roll").startswith(b"roll-v1:")
+        st = set_vmodel(
+            api, "roll", "roll-v2", load_now=True, sync=True,
+            auto_delete_target=True,
+        )
+        assert st.active_model_id == "roll-v2"
+        assert st.transition == apb.VModelStatusInfo.NONE
+        assert infer_vmodel(cluster, "roll").startswith(b"roll-v2:")
+        # Old concrete model auto-deleted once unreferenced.
+        deadline = time.monotonic() + 10
+        while inst.registry.get("roll-v1") is not None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert inst.registry.get("roll-v1") is None
+
+    def test_failed_transition_parks_and_keeps_serving_active(self, cluster, api):
+        set_vmodel(api, "stuck", "stuck-v1", load_now=True, sync=True)
+        bad = FAIL_LOAD_PREFIX + "v2"
+        st = set_vmodel(api, "stuck", bad, load_now=True, sync=True)
+        assert st.active_model_id == "stuck-v1"
+        assert st.transition == apb.VModelStatusInfo.FAILED
+        # The alias still serves the old active model.
+        assert infer_vmodel(cluster, "stuck").startswith(b"stuck-v1:")
+
+    def test_concurrent_transition_needs_force(self, cluster, api):
+        set_vmodel(api, "forced", "f-v1", load_now=True, sync=True)
+        set_vmodel(api, "forced", FAIL_LOAD_PREFIX + "f2", sync=True)  # parks
+        with pytest.raises(grpc.RpcError) as exc:
+            set_vmodel(api, "forced", "f-v3")
+        assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        st = set_vmodel(api, "forced", "f-v3", force=True, sync=True)
+        assert st.active_model_id == "f-v3"
+
+    def test_delete_vmodel_releases_refs(self, cluster, api):
+        inst = cluster[0].instance
+        set_vmodel(
+            api, "deleteme", "del-v1", load_now=True, sync=True,
+            auto_delete_target=True,
+        )
+        api.DeleteVModel(apb.DeleteVModelRequest(vmodel_id="deleteme"))
+        deadline = time.monotonic() + 10
+        while inst.registry.get("del-v1") is not None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert inst.registry.get("del-v1") is None
+        with pytest.raises(grpc.RpcError):
+            api.GetVModelStatus(apb.GetVModelStatusRequest(vmodel_id="deleteme"))

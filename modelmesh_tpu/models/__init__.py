@@ -1,0 +1,23 @@
+"""TPU-served model families and the JAX model-server runtime."""
+
+from modelmesh_tpu.models.families import (
+    FAMILIES,
+    ModelSpec,
+    ServableModel,
+    build_model,
+)
+from modelmesh_tpu.models.server import (
+    InProcessJaxLoader,
+    JaxModelStore,
+    start_jax_runtime,
+)
+
+__all__ = [
+    "FAMILIES",
+    "ModelSpec",
+    "ServableModel",
+    "build_model",
+    "InProcessJaxLoader",
+    "JaxModelStore",
+    "start_jax_runtime",
+]

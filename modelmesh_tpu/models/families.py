@@ -1,0 +1,209 @@
+"""Servable JAX model families for the TPU model server.
+
+The reference serves opaque models through external runtimes (Triton etc.);
+this package is the TPU-native equivalent of such a runtime's model zoo:
+small, self-contained JAX families whose parameters are deterministically
+materialized from the model path (tests and benchmarks need no external
+storage — the "path" IS the spec, e.g. ``mlp://in=64,hidden=128,out=10``).
+
+Families are bf16-parameterized, jitted once per loaded model, and batched:
+TPU-first choices per the build guidance (large matmuls on the MXU, no
+data-dependent shapes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    family: str
+    params: dict[str, int]
+
+    @classmethod
+    def parse(cls, model_type: str, model_path: str) -> "ModelSpec":
+        """``family://k=v,k=v`` (path) with model_type as fallback family."""
+        family, sep, rest = model_path.partition("://")
+        if not sep:
+            family, rest = model_type, model_path
+        kv: dict[str, int] = {}
+        if rest:
+            for part in rest.split(","):
+                if not part:
+                    continue
+                k, _, v = part.partition("=")
+                kv[k.strip()] = int(v)
+        return cls(family=family.strip() or model_type, params=kv)
+
+
+class ServableModel:
+    """A loaded model: jitted apply + parameter tree + sizing."""
+
+    def __init__(self, apply_fn: Callable, params, input_shape, input_dtype):
+        self.apply = apply_fn
+        self.params = params
+        self.input_shape = input_shape
+        self.input_dtype = input_dtype
+
+    @property
+    def size_bytes(self) -> int:
+        return sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(self.params)
+        )
+
+    def predict_bytes(self, payload: bytes) -> bytes:
+        """Raw-bytes inference: payload is a little-endian array matching the
+        family's input dtype; output is f32 logits bytes."""
+        flat = np.frombuffer(payload, dtype=self.input_dtype)
+        feat = int(np.prod(self.input_shape))
+        n = max(1, len(flat) // feat)
+        usable = flat[: n * feat]
+        if len(usable) < n * feat:
+            usable = np.pad(usable, (0, n * feat - len(usable)))
+        x = jnp.asarray(usable.reshape((n, *self.input_shape)))
+        out = np.asarray(self.apply(self.params, x), dtype=np.float32)
+        return out.tobytes()
+
+
+def _seed_from(spec: ModelSpec, model_id: str) -> int:
+    return spec.params.get("seed", abs(hash(model_id)) % (2**31))
+
+
+# -- families ----------------------------------------------------------------
+
+def build_mlp(spec: ModelSpec, model_id: str) -> ServableModel:
+    d_in = spec.params.get("in", 64)
+    hidden = spec.params.get("hidden", 256)
+    depth = spec.params.get("depth", 2)
+    d_out = spec.params.get("out", 10)
+    key = jax.random.PRNGKey(_seed_from(spec, model_id))
+    dims = [d_in] + [hidden] * depth + [d_out]
+    params = []
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        key, k1 = jax.random.split(key)
+        w = jax.random.normal(k1, (a, b), jnp.bfloat16) * (1.0 / np.sqrt(a))
+        params.append({"w": w, "b": jnp.zeros((b,), jnp.bfloat16)})
+
+    @jax.jit
+    def apply(params, x):
+        h = x.astype(jnp.bfloat16)
+        for i, layer in enumerate(params):
+            h = h @ layer["w"] + layer["b"]
+            if i < len(params) - 1:
+                h = jax.nn.gelu(h)
+        return h.astype(jnp.float32)
+
+    return ServableModel(apply, params, (d_in,), np.float32)
+
+
+def build_linear(spec: ModelSpec, model_id: str) -> ServableModel:
+    """Single dense layer — the smallest/cheapest family (density tests)."""
+    d_in = spec.params.get("in", 32)
+    d_out = spec.params.get("out", 8)
+    key = jax.random.PRNGKey(_seed_from(spec, model_id))
+    params = {
+        "w": jax.random.normal(key, (d_in, d_out), jnp.bfloat16)
+        * (1.0 / np.sqrt(d_in))
+    }
+
+    @jax.jit
+    def apply(params, x):
+        return (x.astype(jnp.bfloat16) @ params["w"]).astype(jnp.float32)
+
+    return ServableModel(apply, params, (d_in,), np.float32)
+
+
+def build_transformer(spec: ModelSpec, model_id: str) -> ServableModel:
+    """Tiny causal transformer LM: int32 token payload -> next-token logits.
+
+    Deliberately minimal but real: learned embeddings, pre-LN blocks with
+    causal self-attention + gelu MLP, weight-tied readout. bf16 params,
+    f32 attention softmax.
+    """
+    vocab = spec.params.get("vocab", 256)
+    d = spec.params.get("d", 128)
+    n_layers = spec.params.get("layers", 2)
+    n_heads = spec.params.get("heads", 4)
+    seq = spec.params.get("seq", 64)
+    head_dim = d // n_heads
+    key = jax.random.PRNGKey(_seed_from(spec, model_id))
+
+    def dense(key, a, b):
+        return jax.random.normal(key, (a, b), jnp.bfloat16) / np.sqrt(a)
+
+    keys = jax.random.split(key, 2 + 6 * n_layers)
+    params = {
+        "embed": jax.random.normal(keys[0], (vocab, d), jnp.bfloat16) * 0.02,
+        "pos": jax.random.normal(keys[1], (seq, d), jnp.bfloat16) * 0.02,
+        "blocks": [],
+    }
+    for layer in range(n_layers):
+        k = keys[2 + 6 * layer: 8 + 6 * layer]
+        params["blocks"].append({
+            "qkv": dense(k[0], d, 3 * d),
+            "proj": dense(k[1], d, d),
+            "up": dense(k[2], d, 4 * d),
+            "down": dense(k[3], 4 * d, d),
+            "ln1": jnp.ones((d,), jnp.bfloat16),
+            "ln2": jnp.ones((d,), jnp.bfloat16),
+        })
+
+    def layer_norm(x, g):
+        x32 = x.astype(jnp.float32)
+        mu = x32.mean(-1, keepdims=True)
+        var = x32.var(-1, keepdims=True)
+        return ((x32 - mu) * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype) * g
+
+    @jax.jit
+    def apply(params, tokens):
+        # tokens: i32[batch, seq]
+        b, t = tokens.shape
+        h = params["embed"][tokens % vocab] + params["pos"][None, :t]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        for blk in params["blocks"]:
+            x = layer_norm(h, blk["ln1"])
+            qkv = x @ blk["qkv"]
+            q, kk, v = jnp.split(qkv, 3, axis=-1)
+            def heads(z):
+                return z.reshape(b, t, n_heads, head_dim).transpose(0, 2, 1, 3)
+            q, kk, v = heads(q), heads(kk), heads(v)
+            att = (q.astype(jnp.float32) @ kk.astype(jnp.float32).transpose(0, 1, 3, 2)
+                   ) / np.sqrt(head_dim)
+            att = jnp.where(mask[None, None], att, -1e30)
+            att = jax.nn.softmax(att, axis=-1).astype(jnp.bfloat16)
+            z = (att @ v).transpose(0, 2, 1, 3).reshape(b, t, d)
+            h = h + z @ blk["proj"]
+            x = layer_norm(h, blk["ln2"])
+            h = h + jax.nn.gelu(x @ blk["up"]) @ blk["down"]
+        logits = h[:, -1].astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+        return logits
+
+    return ServableModel(apply, params, (seq,), np.int32)
+
+
+FAMILIES: dict[str, Callable[[ModelSpec, str], ServableModel]] = {
+    "mlp": build_mlp,
+    "linear": build_linear,
+    "transformer": build_transformer,
+    # The fake-runtime type used across tests maps to the cheapest family.
+    "example": build_linear,
+}
+
+
+def build_model(model_id: str, model_type: str, model_path: str) -> ServableModel:
+    spec = ModelSpec.parse(model_type, model_path)
+    builder = FAMILIES.get(spec.family)
+    if builder is None:
+        raise ValueError(
+            f"unknown model family {spec.family!r} "
+            f"(known: {sorted(FAMILIES)})"
+        )
+    return builder(spec, model_id)

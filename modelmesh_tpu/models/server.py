@@ -1,0 +1,255 @@
+"""JAX model server: a real ModelRuntime serving jitted models on the TPU.
+
+The TPU-native answer to the reference's external model-server containers
+(Triton/MLServer behind model-runtime.proto): implements the same runtime
+SPI — status handshake, load/unload/size — but what it loads are jitted JAX
+programs (models/families.py) resident in device memory. One process per
+instance, fronted by the sidecar client (runtime/sidecar.py), or mounted
+in-process via ``InProcessJaxLoader`` for tests and single-binary deploys.
+
+Run standalone:
+    python -m modelmesh_tpu.models.server --port 8085 --capacity-mb 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from modelmesh_tpu.models.families import ServableModel, build_model
+from modelmesh_tpu.proto import mesh_runtime_pb2 as rpb
+from modelmesh_tpu.runtime import grpc_defs
+from modelmesh_tpu.runtime.spi import (
+    LoadedModel,
+    LocalInstanceParams,
+    ModelInfo,
+    ModelLoader,
+    ModelLoadException,
+)
+
+log = logging.getLogger(__name__)
+
+PREDICT_METHOD = "/mmtpu.models.JaxPredictor/Predict"
+
+
+class JaxModelStore:
+    """Loaded-model registry shared by the gRPC and in-process fronts."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = capacity_bytes
+        self._models: dict[str, ServableModel] = {}
+        self._lock = threading.Lock()
+
+    def load(self, model_id: str, model_type: str, model_path: str) -> int:
+        with self._lock:
+            existing = self._models.get(model_id)
+            if existing is not None:
+                return existing.size_bytes
+        model = build_model(model_id, model_type, model_path)
+        # Materialize + warm the jit before declaring loaded, so first
+        # inference latency isn't a compile.
+        import numpy as np
+
+        import jax
+
+        jax.block_until_ready(jax.tree.leaves(model.params))
+        warm = np.zeros((1, *model.input_shape), model.input_dtype)
+        model.predict_bytes(warm.tobytes())
+        with self._lock:
+            self._models[model_id] = model
+        return model.size_bytes
+
+    def unload(self, model_id: str) -> bool:
+        with self._lock:
+            return self._models.pop(model_id, None) is not None
+
+    def get(self, model_id: str) -> Optional[ServableModel]:
+        with self._lock:
+            return self._models.get(model_id)
+
+    def size(self, model_id: str) -> int:
+        m = self.get(model_id)
+        return m.size_bytes if m else 0
+
+    @property
+    def used_bytes(self) -> int:
+        with self._lock:
+            return sum(m.size_bytes for m in self._models.values())
+
+
+def predict_size_estimate(model_type: str, model_path: str) -> int:
+    """Parameter-count-based size estimate without building the model."""
+    from modelmesh_tpu.models.families import ModelSpec
+
+    spec = ModelSpec.parse(model_type, model_path)
+    p = spec.params
+    if spec.family == "mlp":
+        d_in, hidden = p.get("in", 64), p.get("hidden", 256)
+        depth, d_out = p.get("depth", 2), p.get("out", 10)
+        n = d_in * hidden + hidden * hidden * max(0, depth - 1) + hidden * d_out
+        return 2 * n + 2 * (hidden * depth + d_out)
+    if spec.family in ("linear", "example"):
+        return 2 * p.get("in", 32) * p.get("out", 8)
+    if spec.family == "transformer":
+        vocab, d = p.get("vocab", 256), p.get("d", 128)
+        layers, seq = p.get("layers", 2), p.get("seq", 64)
+        per_layer = 3 * d * d + d * d + 8 * d * d + 2 * d
+        return 2 * (vocab * d + seq * d + layers * per_layer)
+    return 1 << 20
+
+
+class JaxRuntimeServicer:
+    """gRPC ModelRuntime implementation over a JaxModelStore."""
+
+    def __init__(self, store: JaxModelStore, load_concurrency: int = 4):
+        self.store = store
+        self.load_concurrency = load_concurrency
+
+    def RuntimeStatus(self, request, context):
+        import jax
+
+        dev = jax.devices()[0]
+        mem = getattr(dev, "memory_stats", lambda: None)()
+        device_bytes = (mem or {}).get("bytes_limit", 0)
+        return rpb.RuntimeStatusResponse(
+            status=rpb.RuntimeStatusResponse.READY,
+            capacity_bytes=self.store.capacity_bytes,
+            load_concurrency=self.load_concurrency,
+            load_timeout_ms=120_000,
+            default_model_size_bytes=1 << 20,
+            device_memory_bytes=device_bytes,
+            runtime_version=f"jax-runtime/{dev.platform}",
+        )
+
+    def LoadModel(self, request, context):
+        try:
+            size = self.store.load(
+                request.model_id,
+                request.info.model_type,
+                request.info.model_path,
+            )
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:  # noqa: BLE001 — loading failure
+            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+        return rpb.LoadModelResponse(size_bytes=size)
+
+    def UnloadModel(self, request, context):
+        self.store.unload(request.model_id)
+        return rpb.UnloadModelResponse()
+
+    def PredictModelSize(self, request, context):
+        return rpb.ModelSizeResponse(
+            size_bytes=predict_size_estimate(
+                request.info.model_type, request.info.model_path
+            )
+        )
+
+    def ModelSize(self, request, context):
+        return rpb.ModelSizeResponse(size_bytes=self.store.size(request.model_id))
+
+    def predict(self, method: str, payload: bytes, context) -> bytes:
+        md = dict(context.invocation_metadata())
+        model_id = md.get(grpc_defs.MODEL_ID_HEADER, "")
+        model = self.store.get(model_id)
+        if model is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"model {model_id} not loaded"
+            )
+        try:
+            return model.predict_bytes(payload)
+        except Exception as e:  # noqa: BLE001 — inference failure
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad payload: {e}")
+
+
+def start_jax_runtime(
+    port: int = 0,
+    capacity_bytes: int = 256 << 20,
+    max_workers: int = 16,
+) -> tuple[grpc.Server, int, JaxRuntimeServicer]:
+    store = JaxModelStore(capacity_bytes)
+    servicer = JaxRuntimeServicer(store)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    grpc_defs.add_servicer(
+        server, servicer, grpc_defs.RUNTIME_SERVICE, grpc_defs.RUNTIME_METHODS
+    )
+    server.add_generic_rpc_handlers(
+        (grpc_defs.RawFallbackHandler(servicer.predict),)
+    )
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound, servicer
+
+
+class InProcessJaxLoader(ModelLoader[ServableModel]):
+    """ModelLoader serving jitted models in the SAME process as the mesh
+    instance — no sidecar hop; the runtime handle is the ServableModel.
+    The single-binary deployment mode (and the fastest test path)."""
+
+    def __init__(self, capacity_bytes: int = 256 << 20,
+                 load_concurrency: int = 4):
+        self.store = JaxModelStore(capacity_bytes)
+        self._load_concurrency = load_concurrency
+
+    def startup(self) -> LocalInstanceParams:
+        return LocalInstanceParams(
+            capacity_bytes=self.store.capacity_bytes,
+            load_concurrency=self._load_concurrency,
+            load_timeout_ms=120_000,
+            default_model_size_bytes=1 << 20,
+        )
+
+    def load(self, model_id: str, info: ModelInfo) -> LoadedModel[ServableModel]:
+        try:
+            size = self.store.load(model_id, info.model_type, info.model_path)
+        except Exception as e:  # noqa: BLE001
+            raise ModelLoadException(f"{type(e).__name__}: {e}") from e
+        return LoadedModel(handle=self.store.get(model_id), size_bytes=size)
+
+    def predict_size(self, model_id: str, info: ModelInfo) -> int:
+        return predict_size_estimate(info.model_type, info.model_path)
+
+    def model_size(self, model_id: str, handle: ServableModel) -> int:
+        return handle.size_bytes if handle else self.store.size(model_id)
+
+    def unload(self, model_id: str) -> None:
+        self.store.unload(model_id)
+
+    def call_model(
+        self, model_id: str, full_method: str, payload: bytes,
+        headers=None, timeout_s=None,
+    ) -> bytes:
+        from modelmesh_tpu.runtime.spi import ModelNotLoadedError
+
+        model = self.store.get(model_id)
+        if model is None:
+            raise ModelNotLoadedError(model_id)
+        return model.predict_bytes(payload)
+
+    @property
+    def requires_unload(self) -> bool:
+        return True
+
+
+def main() -> None:
+    from modelmesh_tpu.utils import honor_platform_env
+
+    honor_platform_env()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=8085)
+    parser.add_argument("--capacity-mb", type=int, default=256)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    server, port, _ = start_jax_runtime(args.port, args.capacity_mb << 20)
+    log.info("jax model runtime on :%d", port)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

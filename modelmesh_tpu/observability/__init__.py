@@ -1,0 +1,35 @@
+"""Observability: metrics facade + payload processors."""
+
+from modelmesh_tpu.observability.metrics import (
+    Metric,
+    Metrics,
+    NoopMetrics,
+    PrometheusMetrics,
+    StatsDMetrics,
+)
+from modelmesh_tpu.observability.payloads import (
+    AsyncPayloadProcessor,
+    CompositePayloadProcessor,
+    LoggingPayloadProcessor,
+    MatchingPayloadProcessor,
+    Payload,
+    PayloadProcessor,
+    RemotePayloadProcessor,
+    build_processor,
+)
+
+__all__ = [
+    "Metric",
+    "Metrics",
+    "NoopMetrics",
+    "PrometheusMetrics",
+    "StatsDMetrics",
+    "AsyncPayloadProcessor",
+    "CompositePayloadProcessor",
+    "LoggingPayloadProcessor",
+    "MatchingPayloadProcessor",
+    "Payload",
+    "PayloadProcessor",
+    "RemotePayloadProcessor",
+    "build_processor",
+]

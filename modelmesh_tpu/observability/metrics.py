@@ -1,0 +1,260 @@
+"""Metrics facade: no-op / Prometheus / StatsD backends.
+
+Capability parity with the reference's Metrics layer (Metrics.java:63,
+PrometheusMetrics :153, StatsDMetrics :444; metric inventory in
+Metric.java:29-108): a small facade the serving core calls, with pluggable
+backends. The Prometheus backend is hand-rolled (text exposition 0.0.4 over
+a threaded HTTP server, default port 2112 like the reference's netty
+endpoint); StatsD pushes UDP. No third-party client libraries.
+"""
+
+from __future__ import annotations
+
+import enum
+import http.server
+import logging
+import socket
+import threading
+import time
+from typing import Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+
+class Metric(enum.Enum):
+    """Metric inventory (name, kind, help). Mirrors the reference's set at
+    the capability level: request counts/timings per stage, load/unload
+    lifecycle, cache state, instance state."""
+
+    # counters
+    API_REQUEST_COUNT = ("mm_api_request_count", "counter", "external inference requests")
+    API_REQUEST_FAILED = ("mm_api_request_failed", "counter", "failed external requests")
+    INVOKE_LOCAL_COUNT = ("mm_invoke_local_count", "counter", "locally served invocations")
+    INVOKE_FORWARD_COUNT = ("mm_invoke_forward_count", "counter", "forwarded invocations")
+    LOAD_COUNT = ("mm_load_count", "counter", "model loads")
+    LOAD_FAILED_COUNT = ("mm_load_failed_count", "counter", "failed model loads")
+    UNLOAD_COUNT = ("mm_unload_count", "counter", "model unloads")
+    EVICT_COUNT = ("mm_evict_count", "counter", "cache evictions")
+    SCALE_UP_COUNT = ("mm_scale_up_count", "counter", "copy scale-ups requested")
+    SCALE_DOWN_COUNT = ("mm_scale_down_count", "counter", "surplus copies dropped")
+    # histograms (ms)
+    API_REQUEST_TIME = ("mm_api_request_time_ms", "histogram", "request latency")
+    LOAD_TIME = ("mm_load_time_ms", "histogram", "model load time")
+    QUEUE_DELAY = ("mm_queue_delay_ms", "histogram", "load queue delay")
+    CACHE_MISS_DELAY = ("mm_cache_miss_delay_ms", "histogram", "wait for load on miss")
+    PLACEMENT_SOLVE_TIME = ("mm_placement_solve_time_ms", "histogram", "global plan solve time")
+    # gauges
+    MODELS_LOADED = ("mm_models_loaded", "gauge", "local loaded model count")
+    CACHE_USED_UNITS = ("mm_cache_used_units", "gauge", "cache units in use")
+    CACHE_CAPACITY_UNITS = ("mm_cache_capacity_units", "gauge", "cache capacity units")
+    PENDING_UNLOAD_UNITS = ("mm_pending_unload_units", "gauge", "units awaiting unload")
+    INSTANCE_RPM = ("mm_instance_rpm", "gauge", "instance requests/min")
+    LRU_AGE_SECONDS = ("mm_lru_age_seconds", "gauge", "age of oldest cache entry")
+
+    def __init__(self, metric_name: str, kind: str, help_: str):
+        self.metric_name = metric_name
+        self.kind = kind
+        self.help = help_
+
+
+DEFAULT_BUCKETS_MS = (
+    1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000, 120000
+)
+
+
+class Metrics:
+    """Facade base: every backend implements these three."""
+
+    def inc(self, metric: Metric, value: float = 1.0, model_id: str = "") -> None:
+        pass
+
+    def observe(self, metric: Metric, value_ms: float, model_id: str = "") -> None:
+        pass
+
+    def set_gauge(self, metric: Metric, value: float) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class NoopMetrics(Metrics):
+    pass
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "total", "count")
+
+    def __init__(self, buckets: Sequence[float]):
+        self.buckets = list(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class PrometheusMetrics(Metrics):
+    """In-memory registry + /metrics HTTP endpoint (text format 0.0.4).
+
+    ``per_model`` adds a model_id label to counters/histograms that carry
+    one (cardinality opt-in, like the reference's per-model metrics flag).
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        per_model: bool = False,
+        instance_id: str = "",
+        start_server: bool = True,
+    ):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, str], float] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[tuple[str, str], _Histogram] = {}
+        self.per_model = per_model
+        self.instance_id = instance_id
+        self.port = 0
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+        if start_server:
+            self._start_http(port)
+
+    # -- recording -----------------------------------------------------------
+
+    def _label(self, model_id: str) -> str:
+        return model_id if (self.per_model and model_id) else ""
+
+    def inc(self, metric: Metric, value: float = 1.0, model_id: str = "") -> None:
+        key = (metric.metric_name, self._label(model_id))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def observe(self, metric: Metric, value_ms: float, model_id: str = "") -> None:
+        key = (metric.metric_name, self._label(model_id))
+        with self._lock:
+            hist = self._hists.get(key)
+            if hist is None:
+                hist = self._hists[key] = _Histogram(DEFAULT_BUCKETS_MS)
+            hist.observe(value_ms)
+
+    def set_gauge(self, metric: Metric, value: float) -> None:
+        with self._lock:
+            self._gauges[metric.metric_name] = value
+
+    # -- exposition ----------------------------------------------------------
+
+    def render(self) -> str:
+        by_name: dict[str, Metric] = {m.metric_name: m for m in Metric}
+        lines: list[str] = []
+        inst = (
+            f'instance="{self.instance_id}"' if self.instance_id else ""
+        )
+
+        def labels(extra: str = "") -> str:
+            parts = [p for p in (inst, extra) if p]
+            return "{" + ",".join(parts) + "}" if parts else ""
+
+        # HELP/TYPE must appear exactly once per metric NAME; repeating them
+        # per label-set makes scrapers reject the whole page.
+        seen_meta: set[str] = set()
+
+        def meta(name: str, kind: str) -> None:
+            if name in seen_meta:
+                return
+            seen_meta.add(name)
+            m = by_name.get(name)
+            if m:
+                lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# TYPE {name} {kind}")
+
+        with self._lock:
+            for (name, model), v in sorted(self._counters.items()):
+                meta(name, "counter")
+                extra = f'model_id="{model}"' if model else ""
+                lines.append(f"{name}{labels(extra)} {v}")
+            for name, v in sorted(self._gauges.items()):
+                meta(name, "gauge")
+                lines.append(f"{name}{labels()} {v}")
+            for (name, model), h in sorted(self._hists.items()):
+                meta(name, "histogram")
+                extra = f'model_id="{model}"' if model else ""
+                cum = 0
+                for b, c in zip(h.buckets, h.counts):
+                    cum += c
+                    le = f'le="{b}"'
+                    lab = labels(", ".join(x for x in (extra, le) if x) if extra else le)
+                    lines.append(f"{name}_bucket{lab} {cum}")
+                cum += h.counts[-1]
+                le = 'le="+Inf"'
+                lab = labels(", ".join(x for x in (extra, le) if x) if extra else le)
+                lines.append(f"{name}_bucket{lab} {cum}")
+                lines.append(f"{name}_sum{labels(extra)} {h.total}")
+                lines.append(f"{name}_count{labels(extra)} {h.count}")
+        return "\n".join(lines) + "\n"
+
+    def _start_http(self, port: int) -> None:
+        metrics = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = metrics.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request logging
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, name="metrics-http", daemon=True
+        ).start()
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+
+
+class StatsDMetrics(Metrics):
+    """Minimal UDP statsd push (counter ``|c``, gauge ``|g``, timer ``|ms``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125,
+                 prefix: str = "mm"):
+        self._addr = (host, port)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._prefix = prefix
+
+    def _send(self, payload: str) -> None:
+        try:
+            self._sock.sendto(payload.encode(), self._addr)
+        except OSError:
+            pass  # fire and forget
+
+    def inc(self, metric: Metric, value: float = 1.0, model_id: str = "") -> None:
+        self._send(f"{self._prefix}.{metric.metric_name}:{value}|c")
+
+    def observe(self, metric: Metric, value_ms: float, model_id: str = "") -> None:
+        self._send(f"{self._prefix}.{metric.metric_name}:{value_ms}|ms")
+
+    def set_gauge(self, metric: Metric, value: float) -> None:
+        self._send(f"{self._prefix}.{metric.metric_name}:{value}|g")
+
+    def close(self) -> None:
+        self._sock.close()

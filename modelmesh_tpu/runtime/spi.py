@@ -51,6 +51,11 @@ class ModelLoadException(Exception):
         self.timeout = timeout
 
 
+class ModelNotLoadedError(Exception):
+    """Runtime no longer has the model (the NOT_FOUND-on-serve case);
+    the serving layer purges its entry and retries elsewhere."""
+
+
 class ModelLoader(abc.ABC, Generic[T]):
     """Per-instance loading SPI. All methods may block; the serving core
     runs them on its loading pool with timeouts."""

@@ -16,12 +16,17 @@ peer transport, with mesh errors mapped onto gRPC status + a detail header.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
+import time as _time
 from concurrent import futures
 from typing import Optional
 
 import grpc
+
+from modelmesh_tpu.observability.metrics import Metric as MX
+from modelmesh_tpu.observability.payloads import Payload
 
 from modelmesh_tpu.proto import mesh_api_pb2 as apb
 from modelmesh_tpu.proto import mesh_internal_pb2 as ipb
@@ -218,13 +223,33 @@ class MeshInternalServicer:
 
 
 class InferenceFallback:
-    """Arbitrary-method inference entry: metadata id -> invoke_model."""
+    """Arbitrary-method inference entry: metadata id -> invoke_model.
 
-    def __init__(self, instance: ModelMeshInstance, vmodels=None):
+    Also the request-metrics and payload-observation point (reference:
+    ModelMeshApi request metrics + PayloadProcessor hooks :778-818).
+    """
+
+    def __init__(self, instance: ModelMeshInstance, vmodels=None,
+                 payload_processor=None):
         self.instance = instance
         self.vmodels = vmodels
+        self.payload_processor = payload_processor
+        self._req_seq = itertools.count(1)
+
+    def _observe_payload(self, req_id, model_id, method, kind, data, status):
+        proc = self.payload_processor
+        if proc is None:
+            return
+        try:
+            proc.process(Payload(
+                request_id=req_id, model_id=model_id, method=method,
+                kind=kind, data=data, status=status,
+            ))
+        except Exception:  # noqa: BLE001 — observer must not break serving
+            log.exception("payload processor failed")
 
     def __call__(self, method: str, request: bytes, context) -> bytes:
+        metrics = self.instance.metrics
         md = dict(context.invocation_metadata())
         model_id = md.get(grpc_defs.MODEL_ID_HEADER, "")
         vmodel_id = md.get(grpc_defs.VMODEL_ID_HEADER, "")
@@ -243,21 +268,40 @@ class InferenceFallback:
             (k, v) for k, v in md.items()
             if not k.startswith("grpc-") and isinstance(v, str)
         ]
+        req_id = f"{self.instance.instance_id}-{next(self._req_seq)}"
+        metrics.inc(MX.API_REQUEST_COUNT, model_id=model_id)
+        self._observe_payload(req_id, model_id, method, "request", request, "OK")
+        t0 = _time.perf_counter()
         try:
             result = self.instance.invoke_model(
                 model_id, method, request, headers
             )
+            metrics.observe(
+                MX.API_REQUEST_TIME, (_time.perf_counter() - t0) * 1e3,
+                model_id=model_id,
+            )
+            self._observe_payload(
+                req_id, model_id, method, "response", result.payload, "OK"
+            )
             return result.payload
         except ModelNotFoundError:
+            metrics.inc(MX.API_REQUEST_FAILED, model_id=model_id)
+            self._observe_payload(
+                req_id, model_id, method, "response", b"", "NOT_FOUND"
+            )
             context.abort(grpc.StatusCode.NOT_FOUND, f"model {model_id}")
         except NoCapacityError as e:
+            metrics.inc(MX.API_REQUEST_FAILED, model_id=model_id)
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except (ModelLoadException, ModelNotHereError) as e:
+            metrics.inc(MX.API_REQUEST_FAILED, model_id=model_id)
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         except ApplierError as e:
+            metrics.inc(MX.API_REQUEST_FAILED, model_id=model_id)
             code = getattr(grpc.StatusCode, e.grpc_code, grpc.StatusCode.UNKNOWN)
             context.abort(code, str(e))
         except ServiceUnavailableError as e:
+            metrics.inc(MX.API_REQUEST_FAILED, model_id=model_id)
             context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
 
 
@@ -272,6 +316,7 @@ class MeshServer:
         max_workers: int = 24,
         bind_host: str = "0.0.0.0",
         advertise_host: str = "127.0.0.1",
+        payload_processor=None,
     ):
         """``bind_host`` is the listen address (0.0.0.0 for cross-host
         deployments); ``advertise_host`` is what peers dial — production
@@ -288,7 +333,9 @@ class MeshServer:
             grpc_defs.INTERNAL_SERVICE, grpc_defs.INTERNAL_METHODS,
         )
         self.server.add_generic_rpc_handlers(
-            (grpc_defs.RawFallbackHandler(InferenceFallback(instance, vmodels)),)
+            (grpc_defs.RawFallbackHandler(
+                InferenceFallback(instance, vmodels, payload_processor)
+            ),)
         )
         self.port = self.server.add_insecure_port(f"{bind_host}:{port}")
         self.server.start()

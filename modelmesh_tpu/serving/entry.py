@@ -80,6 +80,7 @@ class CacheEntry:
         self.state = EntryState.NEW
         self.error: Optional[str] = None
         self.loaded: Optional[LoadedModel] = None
+        self.queued_ms: Optional[int] = None
         self.load_started_ms: Optional[int] = None
         self.load_completed_ms: Optional[int] = None
         self._lock = threading.Lock()

@@ -59,6 +59,7 @@ from modelmesh_tpu.serving.errors import (
     NoCapacityError,
     ServiceUnavailableError,
 )
+from modelmesh_tpu.observability.metrics import Metric as MX
 from modelmesh_tpu.serving.rate import RateTracker
 
 log = logging.getLogger(__name__)
@@ -157,6 +158,7 @@ class ModelMeshInstance:
         strategy: Optional[PlacementStrategy] = None,
         peer_call: Optional[PeerCall] = None,
         runtime_call: Optional[Callable[..., bytes]] = None,
+        metrics=None,
     ):
         """``peer_call(endpoint, model_id, method, payload, headers, ctx)``
         forwards to a peer (gRPC in production, direct-call in tests).
@@ -172,6 +174,11 @@ class ModelMeshInstance:
         self._runtime_call = runtime_call or self._default_runtime_call
         self.shutting_down = False
         self.is_leader = False
+        if metrics is None:
+            from modelmesh_tpu.observability.metrics import NoopMetrics
+
+            metrics = NoopMetrics()
+        self.metrics = metrics
 
         params = loader.startup()
         self.params = params
@@ -287,6 +294,17 @@ class ModelMeshInstance:
             rec.start_ts = prev.start_ts if prev else rec.start_ts
             self._session.update(rec.to_bytes())
             self._last_published = rec
+        self.metrics.set_gauge(MX.MODELS_LOADED, len(self.cache))
+        self.metrics.set_gauge(MX.CACHE_USED_UNITS, self.cache.weight)
+        self.metrics.set_gauge(MX.CACHE_CAPACITY_UNITS, self.cache.capacity)
+        self.metrics.set_gauge(
+            MX.PENDING_UNLOAD_UNITS, self.unload_tracker.pending_units
+        )
+        self.metrics.set_gauge(MX.INSTANCE_RPM, self.rate.rpm())
+        oldest = self.cache.oldest_time()
+        self.metrics.set_gauge(
+            MX.LRU_AGE_SECONDS, (now_ms() - oldest) / 1000.0 if oldest else 0
+        )
 
     # ------------------------------------------------------------------ #
     # management API                                                     #
@@ -512,6 +530,7 @@ class ModelMeshInstance:
             self.rate.record()
             self._model_rate(ce.model_id).record()
             self.cache.get(ce.model_id)  # LRU touch
+            self.metrics.inc(MX.INVOKE_LOCAL_COUNT, model_id=ce.model_id)
             return InvokeResult(out, self.instance_id, "LOADED")
         except ModelNotHereError:
             # Runtime claims NOT_FOUND for a model we think is loaded — the
@@ -528,6 +547,7 @@ class ModelMeshInstance:
     ) -> bytes:
         import grpc
 
+        from modelmesh_tpu.runtime.spi import ModelNotLoadedError
         from modelmesh_tpu.serving.errors import ApplierError
 
         call_model = getattr(self.loader, "call_model", None)
@@ -537,6 +557,8 @@ class ModelMeshInstance:
             )
         try:
             return call_model(ce.model_id, method, payload, headers)
+        except ModelNotLoadedError as e:
+            raise ModelNotHereError(self.instance_id, ce.model_id) from e
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.NOT_FOUND:
                 raise ModelNotHereError(self.instance_id, ce.model_id) from e
@@ -620,6 +642,7 @@ class ModelMeshInstance:
             raise
 
         ce.state = EntryState.QUEUED
+        ce.queued_ms = now_ms()
         urgent = ctx.hop != RoutingContext.INTERNAL
         self.loading_pool.submit(
             lambda: self._run_load(ce), urgent=urgent, last_used=last_used
@@ -632,6 +655,10 @@ class ModelMeshInstance:
         clobbered; if the entry is removed after the runtime load happened,
         the runtime copy is released here."""
         model_id = ce.model_id
+        # Anchor the queue-delay at submit time (set in _load_local), not at
+        # worker pickup — otherwise the metric reads ~0 exactly when the
+        # loading pool is saturated.
+        queued_ms = getattr(ce, "queued_ms", None) or now_ms()
         try:
             if self.loader.requires_unload:
                 if not ce.try_transition(EntryState.WAITING):
@@ -644,6 +671,9 @@ class ModelMeshInstance:
             if not ce.try_transition(EntryState.LOADING):
                 return
             ce.load_started_ms = now_ms()
+            self.metrics.observe(
+                MX.QUEUE_DELAY, ce.load_started_ms - queued_ms, model_id
+            )
             loaded = self.loader.load(model_id, ce.info)
             size_bytes = loaded.size_bytes
             if not size_bytes and ce.try_transition(EntryState.SIZING):
@@ -663,6 +693,11 @@ class ModelMeshInstance:
                 self.loader.unload(model_id)
                 return
             self._promote_loaded(model_id, size_units=ce.weight_units)
+            self.metrics.inc(MX.LOAD_COUNT, model_id=model_id)
+            if ce.load_started_ms:
+                self.metrics.observe(
+                    MX.LOAD_TIME, now_ms() - ce.load_started_ms, model_id
+                )
             self.publish_instance_record()
         except ModelLoadException as e:
             self._load_failed(ce, str(e))
@@ -693,6 +728,7 @@ class ModelMeshInstance:
 
     def _load_failed(self, ce: CacheEntry, message: str) -> None:
         log.warning("load of %s failed: %s", ce.model_id, message)
+        self.metrics.inc(MX.LOAD_FAILED_COUNT, model_id=ce.model_id)
         ce.fail(message)
         self.cache.remove_if_value(ce.model_id, ce)
         self._record_load_failure(ce.model_id, message)
@@ -721,6 +757,7 @@ class ModelMeshInstance:
         runtime unload run on a separate thread so the inference hot path
         (which takes the same lock) never stalls on KV round trips."""
         log.info("evicting %s (last used %d)", model_id, last_used)
+        self.metrics.inc(MX.EVICT_COUNT, model_id=model_id)
         was_active = ce.state is EntryState.ACTIVE
         ce.remove()
         units = ce.weight_units
@@ -767,6 +804,7 @@ class ModelMeshInstance:
                 self.loader.unload(model_id)
             finally:
                 self.unload_tracker.unload_finished(units)
+                self.metrics.inc(MX.UNLOAD_COUNT, model_id=model_id)
                 self.publish_instance_record()
 
         threading.Thread(
@@ -813,6 +851,7 @@ class ModelMeshInstance:
             known_size_bytes=ctx.known_size_bytes,
             last_used_ms=ctx.last_used_ms,
         )
+        self.metrics.inc(MX.INVOKE_FORWARD_COUNT, model_id=model_id)
         return self._peer_call(
             rec.endpoint or target, model_id, method, payload, headers, fwd_ctx
         )

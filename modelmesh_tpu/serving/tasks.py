@@ -122,6 +122,12 @@ class BackgroundTasks:
         tick_start = now_ms()
         cutoff = self._last_rate_tick
         self._last_rate_tick = tick_start
+        # Prune usage history for models no longer cached here (stale
+        # entries both leak and can trigger spurious 1->2 scale-ups when a
+        # model id is re-registered later).
+        cached = set(inst.cache.keys())
+        for gone in [k for k in self._prev_use if k not in cached]:
+            del self._prev_use[gone]
         for model_id, ce, last_used in inst.cache.items_used_since(cutoff):
             if ce.state is not EntryState.ACTIVE:
                 continue
@@ -221,7 +227,9 @@ class BackgroundTasks:
             return
         for model_id in inst.cache.keys():
             mr = inst.registry_view.get(model_id)
-            if mr is None or mr.copy_count < 2:
+            # Count only READY copies: a copy still loading elsewhere must
+            # not license dropping the sole active one.
+            if mr is None or len(mr.instance_ids) < 2:
                 continue
             rpm = inst.model_rpm(model_id)
             # Our copy is surplus if OUR traffic is well under the per-copy

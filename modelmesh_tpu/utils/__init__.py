@@ -1,0 +1,5 @@
+"""Shared utilities."""
+
+from modelmesh_tpu.utils.platform import honor_platform_env
+
+__all__ = ["honor_platform_env"]

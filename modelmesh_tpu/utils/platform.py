@@ -1,0 +1,26 @@
+"""Platform selection guard.
+
+Some images register an out-of-process accelerator PJRT plugin from
+sitecustomize and force ``jax_platforms`` through jax.config — which
+silently overrides the JAX_PLATFORMS environment variable. Any entrypoint
+that must respect an explicit ``JAX_PLATFORMS=cpu`` (tests, CPU smoke
+benches, virtual-device dry runs) calls this before touching JAX backends.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    """Re-assert the JAX_PLATFORMS env var over any config-level override.
+
+    No-op when the variable is unset. Must run before the first backend
+    initialization (jax.devices() / first op).
+    """
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if not plats:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", plats)

@@ -1,0 +1,163 @@
+"""Metrics + payload-processor tests: live /metrics scrape of a serving mesh
+(the reference's ModelMeshMetricsTest pattern) and processor-chain behavior."""
+
+import time
+import urllib.request
+
+import pytest
+
+from modelmesh_tpu.observability.metrics import (
+    Metric,
+    PrometheusMetrics,
+    StatsDMetrics,
+)
+from modelmesh_tpu.observability.payloads import (
+    AsyncPayloadProcessor,
+    CompositePayloadProcessor,
+    MatchingPayloadProcessor,
+    Payload,
+    PayloadProcessor,
+    build_processor,
+)
+
+
+class _Capture(PayloadProcessor):
+    def __init__(self):
+        self.seen = []
+
+    def process(self, payload):
+        self.seen.append(payload)
+        return False
+
+
+class TestPrometheusMetrics:
+    def test_counter_gauge_histogram_exposition(self):
+        m = PrometheusMetrics(instance_id="iX", start_server=False)
+        m.inc(Metric.API_REQUEST_COUNT)
+        m.inc(Metric.API_REQUEST_COUNT, 2)
+        m.set_gauge(Metric.MODELS_LOADED, 7)
+        m.observe(Metric.API_REQUEST_TIME, 3.0)
+        m.observe(Metric.API_REQUEST_TIME, 600.0)
+        text = m.render()
+        assert 'mm_api_request_count{instance="iX"} 3.0' in text
+        assert 'mm_models_loaded{instance="iX"} 7' in text
+        assert 'mm_api_request_time_ms_count{instance="iX"} 2' in text
+        assert "# TYPE mm_api_request_time_ms histogram" in text
+        # bucket counts cumulative; 3ms lands in le=5, 600 in le=1000
+        assert 'le="5"' in text and 'le="+Inf"' in text
+
+    def test_per_model_labels(self):
+        m = PrometheusMetrics(per_model=True, start_server=False)
+        m.inc(Metric.LOAD_COUNT, model_id="m1")
+        m.inc(Metric.LOAD_COUNT, model_id="m2")
+        text = m.render()
+        assert 'model_id="m1"' in text and 'model_id="m2"' in text
+
+    def test_http_endpoint_scrape(self):
+        m = PrometheusMetrics(port=0)
+        try:
+            m.inc(Metric.LOAD_COUNT)
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{m.port}/metrics", timeout=5
+            ).read().decode()
+            assert "mm_load_count" in body
+        finally:
+            m.close()
+
+    def test_statsd_does_not_crash_without_server(self):
+        s = StatsDMetrics(port=18125)
+        s.inc(Metric.LOAD_COUNT)
+        s.observe(Metric.LOAD_TIME, 5)
+        s.set_gauge(Metric.MODELS_LOADED, 1)
+        s.close()
+
+
+class TestPayloadProcessors:
+    def _payload(self, model="m1", method="/p/Predict", kind="request"):
+        return Payload("r1", model, method, kind, b"data")
+
+    def test_matching_filters(self):
+        cap = _Capture()
+        proc = MatchingPayloadProcessor(cap, model_id="m1")
+        proc.process(self._payload(model="m2"))
+        proc.process(self._payload(model="m1"))
+        assert len(cap.seen) == 1
+
+    def test_composite_fans_out(self):
+        a, b = _Capture(), _Capture()
+        proc = CompositePayloadProcessor([a, b])
+        proc.process(self._payload())
+        assert len(a.seen) == len(b.seen) == 1
+
+    def test_async_never_blocks_and_drops_when_full(self):
+        class Slow(PayloadProcessor):
+            def process(self, p):
+                time.sleep(0.2)
+                return False
+
+        proc = AsyncPayloadProcessor(Slow(), capacity=2, workers=1)
+        for _ in range(20):
+            assert proc.process(self._payload()) is True
+        assert proc.dropped > 0
+        proc.close()
+
+    def test_build_processor_grammar(self):
+        assert build_processor([]) is None
+        p = build_processor(["logger"])
+        from modelmesh_tpu.observability.payloads import LoggingPayloadProcessor
+        assert isinstance(p, LoggingPayloadProcessor)
+        p2 = build_processor(["logger?model=m1", "logger"])
+        assert isinstance(p2, CompositePayloadProcessor)
+        with pytest.raises(ValueError):
+            build_processor(["bogus://x"])
+
+
+class TestMeshMetricsEndToEnd:
+    def test_serving_updates_metrics_and_payloads(self):
+        from modelmesh_tpu.kv import InMemoryKV
+        from modelmesh_tpu.models.server import (
+            PREDICT_METHOD,
+            InProcessJaxLoader,
+        )
+        from modelmesh_tpu.runtime import ModelInfo, grpc_defs
+        from modelmesh_tpu.serving.api import MeshServer
+        from modelmesh_tpu.serving.instance import (
+            InstanceConfig,
+            ModelMeshInstance,
+        )
+
+        import grpc
+        import numpy as np
+
+        store = InMemoryKV(sweep_interval_s=0.05)
+        metrics = PrometheusMetrics(port=0, instance_id="i-obs")
+        cap = _Capture()
+        inst = ModelMeshInstance(
+            store,
+            InProcessJaxLoader(capacity_bytes=32 << 20),
+            InstanceConfig(instance_id="i-obs", min_churn_age_ms=0),
+            metrics=metrics,
+        )
+        server = MeshServer(inst, payload_processor=cap)
+        try:
+            inst.register_model("om", ModelInfo("linear", "linear://in=8,out=2"))
+            ch = grpc.insecure_channel(server.endpoint)
+            call = grpc_defs.raw_method(ch, PREDICT_METHOD)
+            x = np.ones((1, 8), np.float32)
+            call(x.tobytes(), metadata=[("mm-model-id", "om")], timeout=20)
+            call(x.tobytes(), metadata=[("mm-model-id", "om")], timeout=20)
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics.port}/metrics", timeout=5
+            ).read().decode()
+            assert 'mm_api_request_count{instance="i-obs"} 2.0' in body
+            assert "mm_load_count" in body
+            assert "mm_api_request_time_ms_count" in body
+            # request + response observed per call
+            kinds = [p.kind for p in cap.seen]
+            assert kinds.count("request") == 2 and kinds.count("response") == 2
+            ch.close()
+        finally:
+            server.stop()
+            inst.shutdown()
+            metrics.close()
+            store.close()

@@ -74,7 +74,11 @@ class ServableModel:
 
 
 def _seed_from(spec: ModelSpec, model_id: str) -> int:
-    return spec.params.get("seed", abs(hash(model_id)) % (2**31))
+    # Stable across processes: every copy of a model (scale-up, failover)
+    # must build identical weights. Python's hash() is salted per process.
+    import zlib
+
+    return spec.params.get("seed", zlib.crc32(model_id.encode()))
 
 
 # -- families ----------------------------------------------------------------

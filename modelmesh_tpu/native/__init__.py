@@ -1,0 +1,16 @@
+"""Native (C++) components with pure-Python fallbacks.
+
+The compute path of this framework is JAX/XLA; the native layer covers the
+runtime's hot byte-level paths — currently the protobuf splicer used by the
+data plane for in-body model-id extraction. Binaries are built on demand
+with g++ into ``_build/`` next to this package; absence of a toolchain
+degrades gracefully to the Python implementations.
+"""
+
+from modelmesh_tpu.native.proto_splicer import (
+    backend,
+    extract_id,
+    splice_id,
+)
+
+__all__ = ["backend", "extract_id", "splice_id"]

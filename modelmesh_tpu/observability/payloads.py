@@ -131,6 +131,17 @@ class AsyncPayloadProcessor(PayloadProcessor):
 
     def close(self) -> None:
         self._stop.set()
+        # Join workers before closing the delegate: an in-flight process()
+        # must not race a closed delegate, and remaining queued payloads
+        # are accounted as dropped rather than silently vanishing.
+        for t in self._threads:
+            t.join(timeout=2.0)
+        try:
+            while True:
+                self._q.get_nowait()
+                self.dropped += 1
+        except queue.Empty:
+            pass
         self.delegate.close()
 
 

@@ -42,6 +42,7 @@ def build_problem(
     rpm_fn: Optional[Callable[[str], int]] = None,
     default_size_units: int = 128,
     max_copies: int = 8,
+    constraints=None,
 ):
     """Assemble a PlacementProblem from registry/instance snapshots.
 
@@ -97,6 +98,18 @@ def build_problem(
         zone[j] = zone_id[rec.zone]
         feasible_cols[j] = not rec.shutting_down
     feasible = np.broadcast_to(feasible_cols, (n, m)).copy()
+    if constraints is not None:
+        # Type-constraint mask: one row pattern per model type.
+        type_mask: dict[str, np.ndarray] = {}
+        for i, (mid, mr) in enumerate(models):
+            mask = type_mask.get(mr.model_type)
+            if mask is None:
+                mask = np.array([
+                    constraints.is_candidate(mr.model_type, rec.labels)
+                    for _, rec in instances
+                ])
+                type_mask[mr.model_type] = mask
+            feasible[i] &= mask
 
     problem = PlacementProblem(
         sizes=jnp.asarray(sizes),

@@ -136,6 +136,18 @@ class MeshApiServicer:
         return apb.UnregisterModelResponse()
 
     def GetModelStatus(self, request, context):
+        # Reserved diagnostic id: dump full cache + cluster state (the
+        # reference's ***LOGCACHE***/***GETSTATE*** facility).
+        from modelmesh_tpu.serving.bootstrap import STATE_DUMP_ID, debug_dump
+
+        if request.model_id == STATE_DUMP_ID:
+            import json as _json
+
+            return apb.ModelStatusInfo(
+                status=apb.UNKNOWN,
+                model_id=STATE_DUMP_ID,
+                errors=[_json.dumps(debug_dump(self.instance))],
+            )
         self._require_id(request.model_id, context)
         return self._status_info(request.model_id)
 
@@ -229,12 +241,20 @@ class InferenceFallback:
     ModelMeshApi request metrics + PayloadProcessor hooks :778-818).
     """
 
+    # Parallelism of multi-model fan-out (reference MM_MULTI_PARALLELISM=4,
+    # applyParallelMultiModel ModelMeshApi.java:947-1058).
+    MULTI_PARALLELISM = 4
+
     def __init__(self, instance: ModelMeshInstance, vmodels=None,
-                 payload_processor=None):
+                 payload_processor=None, dataplane=None):
         self.instance = instance
         self.vmodels = vmodels
         self.payload_processor = payload_processor
+        self.dataplane = dataplane  # DataplaneApiConfig, optional
         self._req_seq = itertools.count(1)
+        self._multi_pool = futures.ThreadPoolExecutor(
+            max_workers=self.MULTI_PARALLELISM, thread_name_prefix="multi"
+        )
 
     def _observe_payload(self, req_id, model_id, method, kind, data, status):
         proc = self.payload_processor
@@ -251,8 +271,30 @@ class InferenceFallback:
     def __call__(self, method: str, request: bytes, context) -> bytes:
         metrics = self.instance.metrics
         md = dict(context.invocation_metadata())
+        if self.dataplane is not None and not self.dataplane.is_allowed(method):
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                f"method {method} not permitted by dataplane config",
+            )
         model_id = md.get(grpc_defs.MODEL_ID_HEADER, "")
         vmodel_id = md.get(grpc_defs.VMODEL_ID_HEADER, "")
+        if not model_id and not vmodel_id and self.dataplane is not None:
+            # In-body id extraction (ProtoSplicer path, reference
+            # ModelMeshApi.java:689).
+            path = self.dataplane.extraction_path(method)
+            if path:
+                from modelmesh_tpu.native import proto_splicer
+
+                try:
+                    extracted = proto_splicer.extract_id(request, path)
+                except ValueError:
+                    extracted = None
+                if extracted:
+                    cfg = self.dataplane.rpc(method)
+                    if cfg is not None and cfg.vmodel:
+                        vmodel_id = extracted
+                    else:
+                        model_id = extracted
         if vmodel_id and not model_id:
             if self.vmodels is None:
                 context.abort(
@@ -264,9 +306,12 @@ class InferenceFallback:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"missing {grpc_defs.MODEL_ID_HEADER} metadata",
             )
+        if "," in model_id:
+            return self._multi_model(method, request, context, model_id, md)
         headers = [
             (k, v) for k, v in md.items()
             if not k.startswith("grpc-") and isinstance(v, str)
+            and k not in (grpc_defs.MODEL_ID_HEADER, grpc_defs.VMODEL_ID_HEADER)
         ]
         req_id = f"{self.instance.instance_id}-{next(self._req_seq)}"
         metrics.inc(MX.API_REQUEST_COUNT, model_id=model_id)
@@ -304,6 +349,57 @@ class InferenceFallback:
             metrics.inc(MX.API_REQUEST_FAILED, model_id=model_id)
             context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
 
+    def _multi_model(self, method, request, context, model_ids, md) -> bytes:
+        """Fan the same request out to several models in parallel; responses
+        are concatenated as length-prefixed frames (4-byte big-endian per
+        response, in the order the ids were given). First failure aborts the
+        whole call, mirroring the reference's all-or-nothing semantics."""
+        metrics = self.instance.metrics
+        ids = [m.strip() for m in model_ids.split(",") if m.strip()]
+        # Strip the routing ids: each per-model call gets its own id header
+        # from the runtime client; the original comma-list must not leak
+        # through (duplicate metadata keys would shadow it).
+        headers = [
+            (k, v) for k, v in md.items()
+            if not k.startswith("grpc-") and isinstance(v, str)
+            and k not in (grpc_defs.MODEL_ID_HEADER, grpc_defs.VMODEL_ID_HEADER)
+        ]
+        req_id = f"{self.instance.instance_id}-{next(self._req_seq)}"
+        metrics.inc(MX.API_REQUEST_COUNT, model_id=model_ids)
+        self._observe_payload(req_id, model_ids, method, "request", request, "OK")
+        t0 = _time.perf_counter()
+        futs = [
+            self._multi_pool.submit(
+                self.instance.invoke_model, mid, method, request, headers
+            )
+            for mid in ids
+        ]
+        out = bytearray()
+        try:
+            for fut in futs:
+                payload = fut.result(timeout=60).payload
+                out += len(payload).to_bytes(4, "big") + payload
+        except ModelNotFoundError as e:
+            metrics.inc(MX.API_REQUEST_FAILED, model_id=model_ids)
+            self._observe_payload(
+                req_id, model_ids, method, "response", b"", "NOT_FOUND"
+            )
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except Exception as e:  # noqa: BLE001 — map to one status
+            metrics.inc(MX.API_REQUEST_FAILED, model_id=model_ids)
+            self._observe_payload(
+                req_id, model_ids, method, "response", b"", "INTERNAL"
+            )
+            context.abort(grpc.StatusCode.INTERNAL, f"multi-model: {e}")
+        metrics.observe(
+            MX.API_REQUEST_TIME, (_time.perf_counter() - t0) * 1e3,
+            model_id=model_ids,
+        )
+        self._observe_payload(
+            req_id, model_ids, method, "response", bytes(out), "OK"
+        )
+        return bytes(out)
+
 
 class MeshServer:
     """One gRPC server exposing all three surfaces for an instance."""
@@ -317,6 +413,7 @@ class MeshServer:
         bind_host: str = "0.0.0.0",
         advertise_host: str = "127.0.0.1",
         payload_processor=None,
+        dataplane=None,
     ):
         """``bind_host`` is the listen address (0.0.0.0 for cross-host
         deployments); ``advertise_host`` is what peers dial — production
@@ -334,7 +431,9 @@ class MeshServer:
         )
         self.server.add_generic_rpc_handlers(
             (grpc_defs.RawFallbackHandler(
-                InferenceFallback(instance, vmodels, payload_processor)
+                InferenceFallback(
+                    instance, vmodels, payload_processor, dataplane
+                )
             ),)
         )
         self.port = self.server.add_insecure_port(f"{bind_host}:{port}")

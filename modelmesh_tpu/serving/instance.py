@@ -159,6 +159,8 @@ class ModelMeshInstance:
         peer_call: Optional[PeerCall] = None,
         runtime_call: Optional[Callable[..., bytes]] = None,
         metrics=None,
+        constraints=None,
+        upgrade_tracker=None,
     ):
         """``peer_call(endpoint, model_id, method, payload, headers, ctx)``
         forwards to a peer (gRPC in production, direct-call in tests).
@@ -179,6 +181,10 @@ class ModelMeshInstance:
 
             metrics = NoopMetrics()
         self.metrics = metrics
+        # Optional placement filters (serving/constraints.py): model-type ->
+        # label requirements, and rolling-update replicaset avoidance.
+        self.constraints = constraints
+        self.upgrade_tracker = upgrade_tracker
 
         params = loader.startup()
         self.params = params
@@ -352,7 +358,10 @@ class ModelMeshInstance:
         """-> (status, record): status in NOT_FOUND/NOT_LOADED/LOADING/
         LOADED/LOADING_FAILED."""
         ce = self.cache.get_quietly(model_id)
-        mr = self.registry_view.get(model_id) or self.registry.get(model_id)
+        # Authoritative read: the watch-fed view lags mutations (e.g. an
+        # unregister a moment ago would still show LOADED); management
+        # status RPCs are rare enough to pay the direct KV get.
+        mr = self.registry.get(model_id)
         if mr is None:
             return "NOT_FOUND", None
         if ce is not None and ce.state is EntryState.ACTIVE:
@@ -463,7 +472,14 @@ class ModelMeshInstance:
             hard_exclude = (
                 ctx.exclude_load | mr.all_placements | set(mr.load_failures)
             )
+            views = self.instances_view.items()
+            if self.constraints is not None:
+                hard_exclude |= self.constraints.non_candidates(
+                    mr.model_type, views
+                )
             strategy_exclude = hard_exclude | (ctx.visited - {self.instance_id})
+            if self.upgrade_tracker is not None:
+                strategy_exclude |= self.upgrade_tracker.likely_replaced(views)
             if not ctx.known_size_bytes:
                 ctx.known_size_bytes = self._predict_size_bytes(model_id, mr)
             req = PlacementRequest(

@@ -1,0 +1,300 @@
+"""Tests for the parity-tail components: type constraints + file watcher,
+upgrade tracker, dataplane config + in-body id extraction, multi-model
+fan-out, static registration, state dump, preStop hook."""
+
+import json
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from modelmesh_tpu.records import InstanceRecord
+from modelmesh_tpu.runtime import ModelInfo, grpc_defs
+from modelmesh_tpu.runtime.fake import PREDICT_METHOD
+from modelmesh_tpu.serving.constraints import (
+    ConstraintsFileWatcher,
+    TypeConstraints,
+    UpgradeTracker,
+    parse_instance_id,
+)
+from modelmesh_tpu.serving.dataplane import DataplaneApiConfig
+from tests.cluster_util import Cluster
+
+INFO = ModelInfo(model_type="example", model_path="mem://pt")
+
+
+class TestTypeConstraints:
+    def test_required_and_preferred(self):
+        tc = TypeConstraints({"types": {
+            "big": {"required": ["gpu"], "preferred": ["zone-a"]},
+        }})
+        assert tc.is_candidate("big", ["gpu", "zone-b"])
+        assert not tc.is_candidate("big", ["cpu-only"])
+        assert tc.is_preferred("big", ["zone-a", "gpu"])
+        assert not tc.is_preferred("big", ["zone-b"])
+        # Unknown type: unconstrained.
+        assert tc.is_candidate("other", [])
+
+    def test_default_spec(self):
+        tc = TypeConstraints({"types": {"_default": {"required": ["std"]}}})
+        assert not tc.is_candidate("anything", [])
+        assert tc.is_candidate("anything", ["std"])
+
+    def test_non_candidates(self):
+        tc = TypeConstraints({"types": {"t": {"required": ["lbl"]}}})
+        instances = [
+            ("a", InstanceRecord(labels=["lbl"])),
+            ("b", InstanceRecord(labels=[])),
+        ]
+        assert tc.non_candidates("t", instances) == {"b"}
+
+    def test_file_watcher_live_reload(self, tmp_path):
+        path = tmp_path / "constraints.json"
+        path.write_text(json.dumps({"types": {"t": {"required": ["x"]}}}))
+        tc = TypeConstraints()
+        w = ConstraintsFileWatcher(str(path), tc, poll_interval_s=0.05)
+        try:
+            assert not tc.is_candidate("t", [])
+            path.write_text(json.dumps({"types": {"t": {"required": []}}}))
+            deadline = time.monotonic() + 5
+            while not tc.is_candidate("t", []) and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert tc.is_candidate("t", [])
+        finally:
+            w.close()
+
+
+class TestUpgradeTracker:
+    def test_parse_instance_id(self):
+        assert parse_instance_id("msrv-abc123-x9z42") == ("msrv", "msrv-abc123")
+        assert parse_instance_id("simple") == ("simple", "simple")
+
+    def test_old_replicaset_flagged(self):
+        ut = UpgradeTracker(fresh_window_ms=60_000)
+        old = [(f"dep-rs1-p{i}", InstanceRecord()) for i in range(2)]
+        ut.observe(old)
+        time.sleep(0.05)
+        both = old + [("dep-rs2-p0", InstanceRecord())]
+        doomed = ut.likely_replaced(both)
+        assert doomed == {"dep-rs1-p0", "dep-rs1-p1"}
+
+    def test_stable_single_rs_not_flagged(self):
+        ut = UpgradeTracker()
+        insts = [(f"dep-rs1-p{i}", InstanceRecord()) for i in range(3)]
+        assert ut.likely_replaced(insts) == set()
+
+
+class TestDataplaneConfig:
+    CFG = json.dumps({
+        "rpcs": {
+            "/svc/Allowed": {"idExtractionPath": [1]},
+            "/svc/Blocked": {"allowed": False},
+            "/svc/VAlias": {"idExtractionPath": [1], "vmodel": True},
+        },
+        "allowOtherRpcs": False,
+    })
+
+    def test_parse_and_policy(self):
+        dc = DataplaneApiConfig.from_json(self.CFG)
+        assert dc.is_allowed("/svc/Allowed")
+        assert not dc.is_allowed("/svc/Blocked")
+        assert not dc.is_allowed("/svc/Unlisted")
+        assert dc.extraction_path("/svc/Allowed") == (1,)
+        assert dc.rpc("/svc/VAlias").vmodel
+
+    def test_default_allows_everything(self):
+        dc = DataplaneApiConfig.from_json("")
+        assert dc.is_allowed("/any/Thing")
+        assert dc.extraction_path("/any/Thing") == ()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n=2)
+    yield c
+    c.close()
+
+
+class TestDataplaneIntegration:
+    def test_blocked_method_rejected(self, cluster):
+        from modelmesh_tpu.serving.api import InferenceFallback, MeshServer
+
+        dc = DataplaneApiConfig.from_json(json.dumps({
+            "rpcs": {PREDICT_METHOD: {"allowed": True}},
+            "allowOtherRpcs": False,
+        }))
+        extra = MeshServer(cluster[0].instance, dataplane=dc)
+        try:
+            ch = grpc.insecure_channel(extra.endpoint)
+            cluster[0].instance.register_model("m-dp", INFO)
+            out = grpc_defs.raw_method(ch, PREDICT_METHOD)(
+                b"x", metadata=[("mm-model-id", "m-dp")], timeout=20
+            )
+            assert out.startswith(b"m-dp:")
+            with pytest.raises(grpc.RpcError) as exc:
+                grpc_defs.raw_method(ch, "/other/Method")(
+                    b"x", metadata=[("mm-model-id", "m-dp")], timeout=20
+                )
+            assert exc.value.code() == grpc.StatusCode.UNIMPLEMENTED
+            ch.close()
+        finally:
+            extra.stop()
+
+    def test_in_body_id_extraction(self, cluster):
+        from modelmesh_tpu.proto import mesh_internal_pb2 as ipb
+        from modelmesh_tpu.serving.api import MeshServer
+
+        # Use ForwardRequest's shape as an arbitrary client message whose
+        # field 1 is the model id.
+        dc = DataplaneApiConfig.from_json(json.dumps({
+            "rpcs": {PREDICT_METHOD: {"idExtractionPath": [1]}},
+        }))
+        extra = MeshServer(cluster[0].instance, dataplane=dc)
+        try:
+            cluster[0].instance.register_model("m-body", INFO)
+            body = ipb.ForwardRequest(model_id="m-body").SerializeToString()
+            ch = grpc.insecure_channel(extra.endpoint)
+            out = grpc_defs.raw_method(ch, PREDICT_METHOD)(body, timeout=20)
+            assert out.startswith(b"m-body:")
+            ch.close()
+        finally:
+            extra.stop()
+
+
+class TestMultiModel:
+    def test_parallel_fanout_framing(self, cluster):
+        inst = cluster[0].instance
+        for k in range(3):
+            inst.register_model(f"mm-fan-{k}", INFO)
+        ch = grpc.insecure_channel(cluster[0].server.endpoint)
+        out = grpc_defs.raw_method(ch, PREDICT_METHOD)(
+            b"payload",
+            metadata=[("mm-model-id", "mm-fan-0,mm-fan-1,mm-fan-2")],
+            timeout=30,
+        )
+        frames = []
+        pos = 0
+        while pos < len(out):
+            ln = int.from_bytes(out[pos:pos + 4], "big")
+            frames.append(out[pos + 4:pos + 4 + ln])
+            pos += 4 + ln
+        assert len(frames) == 3
+        for k, frame in enumerate(frames):
+            assert frame.startswith(f"mm-fan-{k}:".encode())
+        ch.close()
+
+    def test_fanout_fails_on_missing_model(self, cluster):
+        ch = grpc.insecure_channel(cluster[0].server.endpoint)
+        with pytest.raises(grpc.RpcError) as exc:
+            grpc_defs.raw_method(ch, PREDICT_METHOD)(
+                b"p", metadata=[("mm-model-id", "mm-fan-0,ghost-model")],
+                timeout=30,
+            )
+        assert exc.value.code() in (
+            grpc.StatusCode.NOT_FOUND, grpc.StatusCode.INTERNAL
+        )
+        ch.close()
+
+
+class TestConstraintRouting:
+    def test_constrained_type_lands_on_labeled_instance(self):
+        from modelmesh_tpu.serving.constraints import TypeConstraints
+
+        c = Cluster(n=3)
+        try:
+            tc = TypeConstraints({"types": {
+                "example": {"required": ["special"]},
+            }})
+            for pod in c.pods:
+                pod.instance.constraints = tc
+            # Only i-2 carries the label.
+            c[2].instance.config.labels = ["special"]
+            c[2].instance.publish_instance_record(force=True)
+            for pod in c.pods:
+                pod.instance.instances_view.wait_for(
+                    lambda v: v.get("i-2") is not None
+                    and "special" in v.get("i-2").labels
+                )
+            c[0].instance.register_model("m-constrained", INFO)
+            res = c[0].instance.invoke_model(
+                "m-constrained", PREDICT_METHOD, b"x", []
+            )
+            assert res.served_by == "i-2"
+        finally:
+            c.close()
+
+    def test_jax_problem_respects_constraints(self):
+        import numpy as np
+
+        from modelmesh_tpu.placement.jax_engine import build_problem
+        from modelmesh_tpu.records import ModelRecord
+        from modelmesh_tpu.serving.constraints import TypeConstraints
+
+        tc = TypeConstraints({"types": {"gpu-only": {"required": ["gpu"]}}})
+        models = [("m0", ModelRecord(model_type="gpu-only", size_units=8))]
+        instances = [
+            ("a", InstanceRecord(capacity_units=100, labels=["gpu"])),
+            ("b", InstanceRecord(capacity_units=100, labels=[])),
+        ]
+        problem, _, _ = build_problem(models, instances, constraints=tc)
+        feas = np.asarray(problem.feasible)
+        assert feas[0, 0] and not feas[0, 1]
+
+
+class TestBootstrap:
+    def test_static_registration(self, cluster):
+        from modelmesh_tpu.serving.bootstrap import register_static_models
+
+        cfg = json.dumps({
+            "models": [
+                {"modelId": "static-1", "type": "example", "path": "mem://s"},
+            ],
+            "vmodels": [
+                {"vModelId": "static-alias", "targetModelId": "static-2",
+                 "type": "example", "path": "mem://s2"},
+            ],
+        })
+        ids = register_static_models(
+            cluster[0].instance, vmodels=cluster[0].vmodels, config_json=cfg
+        )
+        assert set(ids) == {"static-1", "static-2"}
+        assert cluster[0].instance.get_status("static-1")[0] == "LOADED"
+        assert cluster[0].vmodels.resolve("static-alias") == "static-2"
+
+    def test_state_dump_via_api(self, cluster):
+        from modelmesh_tpu.proto import mesh_api_pb2 as apb
+        from modelmesh_tpu.serving.bootstrap import STATE_DUMP_ID
+
+        ch = grpc.insecure_channel(cluster[0].server.endpoint)
+        stub = grpc_defs.make_stub(
+            ch, grpc_defs.API_SERVICE, grpc_defs.API_METHODS
+        )
+        st = stub.GetModelStatus(
+            apb.GetModelStatusRequest(model_id=STATE_DUMP_ID)
+        )
+        dump = json.loads(st.errors[0])
+        assert dump["instanceId"] == cluster[0].iid
+        assert "cache" in dump and "cluster" in dump and "registry" in dump
+        assert len(dump["cluster"]) == 2
+        ch.close()
+
+    def test_prestop_blocks_until_migrated(self):
+        from modelmesh_tpu.serving.bootstrap import PreStopServer
+
+        c = Cluster(n=2)
+        try:
+            c[0].instance.register_model("m-ps", INFO)
+            c[0].instance.invoke_model("m-ps", PREDICT_METHOD, b"x", [])
+            holder = c.pod_with_copy("m-ps")
+            other = c[1] if holder is c[0] else c[0]
+            ps = PreStopServer(holder.instance, port=0)
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ps.port}/prestop", timeout=30
+            ).read()
+            assert holder.instance.shutting_down
+            mr = other.instance.registry.get("m-ps")
+            assert other.iid in mr.instance_ids
+            ps.close()
+        finally:
+            c.close()

@@ -1,0 +1,434 @@
+"""MeshKV: the coordination store served over gRPC + its client.
+
+Server side wraps any local KVStore (normally InMemoryKV) and exposes it on
+the network; ``RemoteKV`` implements the same KVStore interface over the
+wire, so a fleet of separate instance PROCESSES (the reference's
+forked-JVM cluster-test tier, AbstractModelMeshClusterTest) shares one
+coordination store with full watch/lease semantics — no etcd binary needed.
+Production swaps in the etcd backend (kv/etcd.py); both sit behind the same
+KVStore interface.
+
+Run standalone:  python -m modelmesh_tpu.kv.service --port 2379
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import queue
+import threading
+from concurrent import futures
+from typing import Iterable, Optional
+
+import grpc
+
+from modelmesh_tpu.kv.memory import InMemoryKV
+from modelmesh_tpu.kv.store import (
+    Compare,
+    EventType,
+    KeyValue,
+    KVStore,
+    Op,
+    WatchCallback,
+    WatchEvent,
+    WatchHandle,
+)
+from modelmesh_tpu.proto import mesh_kv_pb2 as kpb
+from modelmesh_tpu.runtime import grpc_defs
+
+log = logging.getLogger(__name__)
+
+KV_SERVICE = "mmtpu.kv.MeshKV"
+KV_METHODS = {
+    "Get": (kpb.GetRequest, kpb.GetResponse),
+    "RangePrefix": (kpb.RangeRequest, kpb.RangeResponse),
+    "Put": (kpb.PutRequest, kpb.PutResponse),
+    "Delete": (kpb.DeleteRequest, kpb.DeleteResponse),
+    "Txn": (kpb.TxnRequest, kpb.TxnResponse),
+    "LeaseGrant": (kpb.LeaseGrantRequest, kpb.LeaseGrantResponse),
+    "LeaseKeepalive": (kpb.LeaseKeepaliveRequest, kpb.LeaseKeepaliveResponse),
+    "LeaseRevoke": (kpb.LeaseRevokeRequest, kpb.LeaseRevokeResponse),
+}
+WATCH_METHOD = f"/{KV_SERVICE}/Watch"
+
+
+def _to_proto(kv: KeyValue) -> kpb.KeyValue:
+    return kpb.KeyValue(
+        key=kv.key, value=kv.value, create_rev=kv.create_rev,
+        mod_rev=kv.mod_rev, version=kv.version, lease=kv.lease,
+    )
+
+
+def _from_proto(p: kpb.KeyValue) -> KeyValue:
+    return KeyValue(
+        key=p.key, value=p.value, create_rev=p.create_rev,
+        mod_rev=p.mod_rev, version=p.version, lease=p.lease,
+    )
+
+
+class MeshKVServicer:
+    def __init__(self, store: KVStore):
+        self.store = store
+
+    def Get(self, request, context):
+        kv = self.store.get(request.key)
+        if kv is None:
+            return kpb.GetResponse(found=False)
+        return kpb.GetResponse(kv=_to_proto(kv), found=True)
+
+    def RangePrefix(self, request, context):
+        return kpb.RangeResponse(
+            kvs=[_to_proto(kv) for kv in self.store.range(request.prefix)]
+        )
+
+    def Put(self, request, context):
+        try:
+            kv = self.store.put(request.key, request.value, request.lease)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        return kpb.PutResponse(kv=_to_proto(kv))
+
+    def Delete(self, request, context):
+        return kpb.DeleteResponse(deleted=self.store.delete(request.key))
+
+    def Txn(self, request, context):
+        ok, results = self.store.txn(
+            [Compare(c.key, c.version) for c in request.compares],
+            [self._op(o) for o in request.on_success],
+            [self._op(o) for o in request.on_failure],
+        )
+        return kpb.TxnResponse(
+            succeeded=ok, results=[_to_proto(kv) for kv in results]
+        )
+
+    @staticmethod
+    def _op(o: kpb.Op) -> Op:
+        return Op(
+            key=o.key, value=None if o.is_delete else o.value, lease=o.lease
+        )
+
+    def watch(self, request_bytes: bytes, context):
+        """Server-streaming watch (registered via a generic handler).
+
+        Protocol: the first yielded batch is ALWAYS empty — the "watch
+        created" ack. The client blocks on it before returning from
+        ``watch()``, closing the register-vs-mutate race. On backlog
+        overflow the stream is CLOSED (not silently dropped): the client's
+        reconnect logic resubscribes from its last-seen revision, which is
+        lossless; dropping batches mid-stream would not be.
+        """
+        request = kpb.WatchRequest.FromString(request_bytes)
+        q: "queue.Queue" = queue.Queue(maxsize=1024)
+        overflow = threading.Event()
+
+        def on_events(events):
+            try:
+                q.put_nowait(events)
+            except queue.Full:
+                log.warning("watch stream backlogged; closing for resync")
+                overflow.set()
+
+        start_rev = None if request.start_rev < 0 else request.start_rev
+        handle = self.store.watch(request.prefix, on_events, start_rev=start_rev)
+        try:
+            yield kpb.WatchBatch().SerializeToString()  # created ack
+            while context.is_active() and not overflow.is_set():
+                try:
+                    events = q.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                batch = kpb.WatchBatch(events=[
+                    kpb.WatchEvent(
+                        type=(
+                            kpb.WatchEvent.DELETE
+                            if ev.type is EventType.DELETE
+                            else kpb.WatchEvent.PUT
+                        ),
+                        kv=_to_proto(ev.kv),
+                    )
+                    for ev in events
+                ])
+                yield batch.SerializeToString()
+        finally:
+            handle.cancel()
+
+    def LeaseGrant(self, request, context):
+        return kpb.LeaseGrantResponse(
+            lease_id=self.store.lease_grant(request.ttl_s)
+        )
+
+    def LeaseKeepalive(self, request, context):
+        return kpb.LeaseKeepaliveResponse(
+            alive=self.store.lease_keepalive(request.lease_id)
+        )
+
+    def LeaseRevoke(self, request, context):
+        self.store.lease_revoke(request.lease_id)
+        return kpb.LeaseRevokeResponse()
+
+
+class _WatchStreamHandler(grpc.GenericRpcHandler):
+    def __init__(self, servicer: MeshKVServicer):
+        self._servicer = servicer
+
+    def service(self, handler_call_details):
+        if handler_call_details.method != WATCH_METHOD:
+            return None
+        return grpc.unary_stream_rpc_method_handler(
+            self._servicer.watch,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
+
+
+def start_kv_server(
+    port: int = 0,
+    store: Optional[KVStore] = None,
+    max_workers: int = 16,
+    bind_host: str = "127.0.0.1",
+) -> tuple[grpc.Server, int, KVStore]:
+    """The store is UNAUTHENTICATED: default to loopback; pass an explicit
+    bind_host (and front with mTLS/network policy) for multi-host fleets."""
+    store = store or InMemoryKV()
+    servicer = MeshKVServicer(store)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    grpc_defs.add_servicer(server, servicer, KV_SERVICE, KV_METHODS)
+    server.add_generic_rpc_handlers((_WatchStreamHandler(servicer),))
+    bound = server.add_insecure_port(f"{bind_host}:{port}")
+    server.start()
+    return server, bound, store
+
+
+class _RemoteWatch(WatchHandle):
+    def __init__(self):
+        self.cancelled = threading.Event()
+        self._call = None
+
+    def cancel(self) -> None:
+        self.cancelled.set()
+        if self._call is not None:
+            self._call.cancel()
+
+
+class RemoteKV(KVStore):
+    """KVStore over a MeshKV server."""
+
+    def __init__(self, target: str, timeout_s: float = 10.0):
+        self._channel = grpc.insecure_channel(target)
+        self._stub = grpc_defs.make_stub(self._channel, KV_SERVICE, KV_METHODS)
+        self._timeout = timeout_s
+        self._watches: list[_RemoteWatch] = []
+
+    def get(self, key: str) -> Optional[KeyValue]:
+        resp = self._stub.Get(kpb.GetRequest(key=key), timeout=self._timeout)
+        return _from_proto(resp.kv) if resp.found else None
+
+    def range(self, prefix: str) -> list[KeyValue]:
+        resp = self._stub.RangePrefix(
+            kpb.RangeRequest(prefix=prefix), timeout=self._timeout
+        )
+        return [_from_proto(kv) for kv in resp.kvs]
+
+    def put(self, key: str, value: bytes, lease: int = 0) -> KeyValue:
+        try:
+            resp = self._stub.Put(
+                kpb.PutRequest(key=key, value=value, lease=lease),
+                timeout=self._timeout,
+            )
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
+                raise ValueError(e.details()) from e
+            raise
+        return _from_proto(resp.kv)
+
+    def delete(self, key: str) -> bool:
+        return self._stub.Delete(
+            kpb.DeleteRequest(key=key), timeout=self._timeout
+        ).deleted
+
+    def txn(
+        self,
+        compares: Iterable[Compare],
+        on_success: Iterable[Op],
+        on_failure: Iterable[Op] = (),
+    ) -> tuple[bool, list[KeyValue]]:
+        def op(o: Op) -> kpb.Op:
+            return kpb.Op(
+                key=o.key,
+                value=o.value or b"",
+                is_delete=o.value is None,
+                lease=o.lease,
+            )
+
+        resp = self._stub.Txn(
+            kpb.TxnRequest(
+                compares=[kpb.Compare(key=c.key, version=c.version)
+                          for c in compares],
+                on_success=[op(o) for o in on_success],
+                on_failure=[op(o) for o in on_failure],
+            ),
+            timeout=self._timeout,
+        )
+        return resp.succeeded, [_from_proto(kv) for kv in resp.results]
+
+    def watch(
+        self,
+        prefix: str,
+        callback: WatchCallback,
+        start_rev: Optional[int] = None,
+    ) -> WatchHandle:
+        """Subscribe with two durability guarantees the raw stream lacks:
+
+        - Registration barrier: blocks until the server's "created" ack (an
+          initial empty batch), so a mutation issued right after watch()
+          returns is guaranteed to be observed.
+        - Auto-resubscribe: if the stream dies (server restart, network
+          blip, server-side backlog close), the pump reconnects from the
+          last-seen revision — watch-fed views never go silently stale.
+        """
+        handle = _RemoteWatch()
+        created = threading.Event()
+        # Track delivery progress for lossless resubscription.
+        state = {"last_rev": -1 if start_rev is None else start_rev}
+
+        def open_stream():
+            req = kpb.WatchRequest(prefix=prefix, start_rev=state["last_rev"])
+            call = self._channel.unary_stream(
+                WATCH_METHOD,
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )(req.SerializeToString())
+            handle._call = call
+            return call
+
+        def pump():
+            backoff = 0.1
+            while not handle.cancelled.is_set():
+                try:
+                    call = open_stream()
+                    first = True
+                    for batch_bytes in call:
+                        if handle.cancelled.is_set():
+                            return
+                        if first:
+                            first = False
+                            created.set()
+                            backoff = 0.1
+                        batch = kpb.WatchBatch.FromString(batch_bytes)
+                        events = [
+                            WatchEvent(
+                                type=(
+                                    EventType.DELETE
+                                    if ev.type == kpb.WatchEvent.DELETE
+                                    else EventType.PUT
+                                ),
+                                kv=_from_proto(ev.kv),
+                            )
+                            for ev in batch.events
+                        ]
+                        if events:
+                            state["last_rev"] = max(
+                                state["last_rev"],
+                                max(ev.kv.mod_rev for ev in events),
+                            )
+                            try:
+                                callback(events)
+                            except Exception:  # noqa: BLE001
+                                log.exception("watch callback failed")
+                except grpc.RpcError:
+                    pass
+                if handle.cancelled.is_set():
+                    return
+                log.warning(
+                    "watch stream for %r interrupted; resubscribing from "
+                    "rev %d", prefix, state["last_rev"],
+                )
+                # After the first successful subscribe, reconnects must
+                # replay from last_rev; before it, honor the original mode.
+                if created.is_set() and state["last_rev"] < 0:
+                    state["last_rev"] = 0
+                if handle.cancelled.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 5.0)
+
+        threading.Thread(target=pump, name=f"kvwatch-{prefix}", daemon=True).start()
+        if not created.wait(10.0):
+            log.warning("watch on %r: no created ack within 10s", prefix)
+        self._watches.append(handle)
+        return handle
+
+    def lease_grant(self, ttl_s: float) -> int:
+        return self._stub.LeaseGrant(
+            kpb.LeaseGrantRequest(ttl_s=ttl_s), timeout=self._timeout
+        ).lease_id
+
+    def lease_keepalive(self, lease_id: int) -> bool:
+        try:
+            return self._stub.LeaseKeepalive(
+                kpb.LeaseKeepaliveRequest(lease_id=lease_id),
+                timeout=self._timeout,
+            ).alive
+        except grpc.RpcError:
+            return False
+
+    def lease_revoke(self, lease_id: int) -> None:
+        try:
+            self._stub.LeaseRevoke(
+                kpb.LeaseRevokeRequest(lease_id=lease_id), timeout=self._timeout
+            )
+        except grpc.RpcError:
+            pass
+
+    def wait_idle(self, timeout: float = 5.0) -> None:
+        """Real delivery barrier: write a sentinel under a dedicated watched
+        prefix and wait for our own event to arrive. Any event that reached
+        the server before the sentinel is delivered before it (per-watch
+        FIFO), so earlier watches on this client have seen their events by
+        the time this returns (server dispatch is a single ordered queue)."""
+        import time as _time
+        import uuid as _uuid
+
+        if not hasattr(self, "_barrier_events"):
+            self._barrier_events: dict[str, threading.Event] = {}
+            self._barrier_lock = threading.Lock()
+
+            def on_barrier(events):
+                with self._barrier_lock:
+                    for ev in events:
+                        e = self._barrier_events.pop(
+                            ev.kv.key.rsplit("/", 1)[-1], None
+                        )
+                        if e is not None:
+                            e.set()
+
+            self._barrier_watch = self.watch("__barrier__/", on_barrier)
+        token = _uuid.uuid4().hex
+        evt = threading.Event()
+        with self._barrier_lock:
+            self._barrier_events[token] = evt
+        self.put(f"__barrier__/{token}", b"")
+        if not evt.wait(timeout):
+            raise TimeoutError("kv barrier event did not arrive")
+        self.delete(f"__barrier__/{token}")
+        # Events for OTHER watches dispatch on their own streams; give their
+        # pumps a beat to drain callbacks.
+        _time.sleep(0.05)
+
+    def close(self) -> None:
+        for w in self._watches:
+            w.cancel()
+        self._channel.close()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=2379)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    server, port, _ = start_kv_server(args.port)
+    log.info("mesh kv server on :%d", port)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

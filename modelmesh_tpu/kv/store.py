@@ -154,3 +154,13 @@ class KVStore(abc.ABC):
 
     @abc.abstractmethod
     def close(self) -> None: ...
+
+    # -- test support -------------------------------------------------------
+
+    def wait_idle(self, timeout: float = 5.0) -> None:
+        """Best-effort barrier for watch-event delivery (test helper).
+        In-process stores drain their dispatch queue; networked stores can
+        only allow propagation time."""
+        import time as _time
+
+        _time.sleep(0.25)

@@ -1,0 +1,200 @@
+"""Deployable serving-instance entrypoint.
+
+One process = one mesh instance: KV client (remote MeshKV or etcd),
+model runtime (in-process JAX server, external sidecar, or the test fake),
+the gRPC mesh server (management + internal + inference), background tasks,
+vmodels, metrics, optional preStop hook. The equivalent of the reference's
+start.sh + litelinks service bootstrap (SURVEY.md section 3.1).
+
+    python -m modelmesh_tpu.serving.main \
+        --kv mesh://127.0.0.1:2379 --instance-id i-0 --port 9000 \
+        --runtime jax --capacity-mb 512 --metrics-port 2112
+
+Env: MM_STATIC_MODELS (JSON) for startup registration,
+MM_PAYLOAD_PROCESSORS (comma-separated URIs), MM_TYPE_CONSTRAINTS (path to
+watched JSON file), MM_ZONE / MM_LABELS for placement metadata.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import threading
+
+log = logging.getLogger("modelmesh_tpu.main")
+
+
+def build_store(kv_uri: str):
+    """mesh://host:port | etcd://host:port | memory:// (single process)."""
+    scheme, _, rest = kv_uri.partition("://")
+    if scheme == "memory":
+        from modelmesh_tpu.kv.memory import InMemoryKV
+
+        return InMemoryKV()
+    if scheme == "mesh":
+        from modelmesh_tpu.kv.service import RemoteKV
+
+        return RemoteKV(rest)
+    if scheme == "etcd":
+        from modelmesh_tpu.kv.etcd import EtcdKV
+
+        return EtcdKV(rest)
+    raise ValueError(f"unknown kv scheme {scheme!r} (mesh://, etcd://, memory://)")
+
+
+def build_loader(runtime: str, capacity_mb: int):
+    if runtime == "jax":
+        from modelmesh_tpu.models.server import InProcessJaxLoader
+
+        return InProcessJaxLoader(capacity_bytes=capacity_mb << 20)
+    if runtime == "fake":
+        from modelmesh_tpu.runtime.fake import FakeRuntimeServicer, start_fake_runtime
+        from modelmesh_tpu.runtime.sidecar import SidecarRuntime
+
+        server, port, _ = start_fake_runtime(
+            servicer=FakeRuntimeServicer(capacity_bytes=capacity_mb << 20)
+        )
+        loader = SidecarRuntime(f"127.0.0.1:{port}", startup_timeout_s=30)
+        # Keep the embedded runtime server alive for the loader's lifetime.
+        loader._embedded_runtime_server = server
+        return loader
+    if runtime.startswith("sidecar:"):
+        from modelmesh_tpu.runtime.sidecar import SidecarRuntime
+
+        return SidecarRuntime(runtime[len("sidecar:"):], startup_timeout_s=300)
+    raise ValueError(f"unknown runtime {runtime!r} (jax | fake | sidecar:addr)")
+
+
+def main(argv=None) -> None:
+    from modelmesh_tpu.utils import honor_platform_env
+
+    honor_platform_env()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--kv", default="memory://")
+    parser.add_argument("--instance-id", default=None)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--advertise-host", default="127.0.0.1")
+    parser.add_argument("--runtime", default="jax")
+    parser.add_argument("--capacity-mb", type=int, default=256)
+    parser.add_argument("--metrics-port", type=int, default=-1)
+    parser.add_argument("--prestop-port", type=int, default=-1)
+    parser.add_argument("--strategy", choices=["greedy", "jax"], default="greedy")
+    parser.add_argument("--load-timeout-s", type=float, default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=os.environ.get("MM_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    from modelmesh_tpu.observability.metrics import NoopMetrics, PrometheusMetrics
+    from modelmesh_tpu.observability.payloads import build_processor
+    from modelmesh_tpu.serving.api import MeshServer, make_grpc_peer_call
+    from modelmesh_tpu.serving.bootstrap import (
+        PreStopServer,
+        register_static_models,
+    )
+    from modelmesh_tpu.serving.constraints import (
+        ConstraintsFileWatcher,
+        TypeConstraints,
+        UpgradeTracker,
+    )
+    from modelmesh_tpu.serving.instance import InstanceConfig, ModelMeshInstance
+    from modelmesh_tpu.serving.tasks import BackgroundTasks
+    from modelmesh_tpu.serving.vmodels import VModelManager
+
+    store = build_store(args.kv)
+    loader = build_loader(args.runtime, args.capacity_mb)
+    metrics = (
+        PrometheusMetrics(
+            port=max(args.metrics_port, 0),
+            instance_id=args.instance_id or "",
+        )
+        if args.metrics_port >= 0
+        else NoopMetrics()
+    )
+    constraints = None
+    watcher = None
+    constraints_path = os.environ.get("MM_TYPE_CONSTRAINTS", "")
+    if constraints_path:
+        constraints = TypeConstraints()
+        watcher = ConstraintsFileWatcher(constraints_path, constraints)
+
+    strategy = None
+    if args.strategy == "jax":
+        from modelmesh_tpu.placement.jax_engine import JaxPlacementStrategy
+
+        strategy = JaxPlacementStrategy()
+
+    instance = ModelMeshInstance(
+        store,
+        loader,
+        InstanceConfig(
+            instance_id=args.instance_id,
+            zone=os.environ.get("MM_ZONE", ""),
+            labels=[
+                s for s in os.environ.get("MM_LABELS", "").split(",") if s
+            ],
+            load_timeout_s=args.load_timeout_s,
+        ),
+        strategy=strategy,
+        peer_call=make_grpc_peer_call(),
+        metrics=metrics,
+        constraints=constraints,
+        upgrade_tracker=UpgradeTracker(),
+    )
+    vmodels = VModelManager(instance)
+    payload_proc = build_processor(
+        [u for u in os.environ.get("MM_PAYLOAD_PROCESSORS", "").split(",") if u]
+    )
+    server = MeshServer(
+        instance,
+        port=args.port,
+        vmodels=vmodels,
+        advertise_host=args.advertise_host,
+        payload_processor=payload_proc,
+    )
+    instance.config.endpoint = server.endpoint
+    instance.publish_instance_record(force=True)
+    tasks = BackgroundTasks(instance)
+    tasks.start()
+    prestop = (
+        PreStopServer(instance, port=max(args.prestop_port, 0))
+        if args.prestop_port >= 0
+        else None
+    )
+    register_static_models(instance, vmodels=vmodels)
+    log.info(
+        "instance %s serving on %s (kv=%s runtime=%s strategy=%s)",
+        instance.instance_id, server.endpoint, args.kv, args.runtime,
+        args.strategy,
+    )
+    print(f"READY {server.endpoint}", flush=True)
+
+    stop = threading.Event()
+
+    def on_term(signum, frame):
+        log.info("signal %d: migrating and shutting down", signum)
+        try:
+            instance.pre_shutdown()
+        finally:
+            stop.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    stop.wait()
+    tasks.stop()
+    vmodels.close()
+    server.stop()
+    if prestop is not None:
+        prestop.close()
+    if watcher is not None:
+        watcher.close()
+    instance.shutdown()
+    metrics.close()
+    store.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -27,11 +27,25 @@ from modelmesh_tpu.kv import (
 )
 
 
-@pytest.fixture()
-def kv():
-    store = InMemoryKV(sweep_interval_s=0.05)
-    yield store
-    store.close()
+@pytest.fixture(params=["memory", "remote"])
+def kv(request):
+    """Every KV test runs against BOTH the in-memory store and the
+    gRPC-served RemoteKV (same interface, full watch/lease semantics over
+    the wire) — the reference's etcd-or-zookeeper matrix, our way."""
+    if request.param == "memory":
+        store = InMemoryKV(sweep_interval_s=0.05)
+        yield store
+        store.close()
+    else:
+        from modelmesh_tpu.kv.service import RemoteKV, start_kv_server
+
+        backing = InMemoryKV(sweep_interval_s=0.05)
+        server, port, _ = start_kv_server(store=backing)
+        client = RemoteKV(f"127.0.0.1:{port}")
+        yield client
+        client.close()
+        server.stop(0)
+        backing.close()
 
 
 class TestStore:
@@ -84,6 +98,15 @@ class TestStore:
         assert kv.get("eph/x") is not None
         time.sleep(0.4)
         assert kv.get("eph/x") is None
+
+    def test_watch_sees_put_issued_immediately_after_subscribe(self, kv):
+        # Registration barrier: an event written right after watch() returns
+        # must be delivered (regression for the register-vs-mutate race).
+        got = []
+        kv.watch("race/", lambda evs: got.extend(evs))
+        kv.put("race/x", b"1")
+        kv.wait_idle()
+        assert any(e.kv.key == "race/x" for e in got)
 
     def test_lease_keepalive_extends(self, kv):
         lease = kv.lease_grant(0.2)

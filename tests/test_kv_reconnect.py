@@ -1,0 +1,70 @@
+"""Watch durability over server restarts: the silent-stale-view regression.
+
+A MeshKV server restart (same backing store identity is NOT required — the
+client replays from its last-seen revision) must not leave client-side
+watch-fed views frozen.
+"""
+
+import socket
+import time
+
+import pytest
+
+from modelmesh_tpu.kv.memory import InMemoryKV
+from modelmesh_tpu.kv.service import RemoteKV, start_kv_server
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestWatchReconnect:
+    def test_watch_survives_server_restart(self):
+        port = _free_port()
+        backing = InMemoryKV(sweep_interval_s=0.05)
+        server, _, _ = start_kv_server(port=port, store=backing)
+        client = RemoteKV(f"127.0.0.1:{port}")
+        got = []
+        try:
+            client.watch("w/", lambda evs: got.extend(evs))
+            client.put("w/a", b"1")
+            deadline = time.monotonic() + 5
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert any(e.kv.key == "w/a" for e in got)
+
+            # Hard-stop the server (stream dies), mutate the backing store
+            # while the client is disconnected, then restart on the same
+            # port with the same store.
+            server.stop(0)
+            time.sleep(0.3)
+            backing.put("w/b", b"2")
+            server2, _, _ = start_kv_server(port=port, store=backing)
+            try:
+                deadline = time.monotonic() + 15
+                while (
+                    not any(e.kv.key == "w/b" for e in got)
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.1)
+                assert any(
+                    e.kv.key == "w/b" for e in got
+                ), "event during outage lost after reconnect"
+                # And the stream keeps working live.
+                client.put("w/c", b"3")
+                deadline = time.monotonic() + 10
+                while (
+                    not any(e.kv.key == "w/c" for e in got)
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.05)
+                assert any(e.kv.key == "w/c" for e in got)
+            finally:
+                server2.stop(0)
+        finally:
+            client.close()
+            backing.close()

@@ -414,12 +414,16 @@ class MeshServer:
         advertise_host: str = "127.0.0.1",
         payload_processor=None,
         dataplane=None,
+        tls=None,
     ):
         """``bind_host`` is the listen address (0.0.0.0 for cross-host
         deployments); ``advertise_host`` is what peers dial — production
-        config passes the pod IP / hostname."""
+        config passes the pod IP / hostname. ``tls`` (serving.tls.TlsConfig)
+        secures all three surfaces; with require_client_auth peers must
+        present certs signed by the configured CA."""
         self.instance = instance
         self._advertise_host = advertise_host
+        self.tls = tls
         self.server = grpc.server(futures.ThreadPoolExecutor(max_workers))
         grpc_defs.add_servicer(
             self.server, MeshApiServicer(instance, vmodels),
@@ -436,7 +440,13 @@ class MeshServer:
                 )
             ),)
         )
-        self.port = self.server.add_insecure_port(f"{bind_host}:{port}")
+        addr = f"{bind_host}:{port}"
+        if tls is not None:
+            self.port = self.server.add_secure_port(
+                addr, tls.server_credentials()
+            )
+        else:
+            self.port = self.server.add_insecure_port(addr)
         self.server.start()
 
     @property
@@ -450,17 +460,26 @@ class MeshServer:
 # -- client side --------------------------------------------------------------
 
 class PeerChannels:
-    """Channel cache for instance-to-instance calls."""
+    """Channel cache for instance-to-instance calls (TLS-aware)."""
 
-    def __init__(self):
+    def __init__(self, tls=None):
         self._channels: dict[str, grpc.Channel] = {}
         self._lock = threading.Lock()
+        self._tls = tls
 
     def get(self, endpoint: str) -> grpc.Channel:
         with self._lock:
             ch = self._channels.get(endpoint)
             if ch is None:
-                ch = grpc.insecure_channel(endpoint)
+                if self._tls is not None:
+                    from modelmesh_tpu.serving.tls import secure_channel
+
+                    ch = secure_channel(
+                        endpoint, self._tls,
+                        override_authority=self._tls.override_authority,
+                    )
+                else:
+                    ch = grpc.insecure_channel(endpoint)
                 self._channels[endpoint] = ch
             return ch
 
@@ -472,9 +491,14 @@ class PeerChannels:
 
 
 def make_grpc_peer_call(channels: Optional[PeerChannels] = None,
-                        timeout_s: float = 30.0):
+                        timeout_s: float = 30.0, tls=None):
     """Build the instance's peer transport over gRPC."""
-    channels = channels or PeerChannels()
+    if channels is not None and tls is not None:
+        raise ValueError(
+            "pass tls to the PeerChannels cache, not alongside it — a "
+            "caller-supplied cache keeps its own transport security"
+        )
+    channels = channels or PeerChannels(tls)
 
     def peer_call(
         endpoint: str, model_id: str, method: Optional[str], payload: bytes,

@@ -86,8 +86,38 @@ class CacheEntry:
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._sem: Optional[threading.Semaphore] = None
+        self.max_concurrency = 0
         self.inflight = 0
         self.total_invocations = 0
+        # EWMA of invocation latency (ms); drives the latency-based
+        # autoscaling threshold (reference MaxConcCacheEntry bandwidth
+        # estimate, ModelMesh.java:2641-2797).
+        self.avg_latency_ms = 0.0
+        self._latency_samples = 0
+
+    # bandwidth_rpm() stays 0 until this many samples — the first call often
+    # includes cold-start/compile time and must not collapse the threshold.
+    MIN_LATENCY_SAMPLES = 20
+
+    def record_latency(self, ms: float, alpha: float = 0.1) -> None:
+        self._latency_samples += 1
+        if self._latency_samples == 1:
+            # Discard the very first sample entirely (cold start/compile).
+            return
+        prev = self.avg_latency_ms
+        self.avg_latency_ms = ms if prev == 0 else (1 - alpha) * prev + alpha * ms
+
+    def bandwidth_rpm(self) -> int:
+        """Estimated sustainable requests/min of this copy: concurrency
+        slots / average service time. 0 = unknown (insufficient latency
+        data or no concurrency limit)."""
+        if (
+            self.avg_latency_ms <= 0
+            or self.max_concurrency <= 0
+            or self._latency_samples < self.MIN_LATENCY_SAMPLES
+        ):
+            return 0
+        return int(60_000.0 / self.avg_latency_ms * self.max_concurrency)
 
     # -- state ------------------------------------------------------------
 
@@ -115,6 +145,7 @@ class CacheEntry:
             self.loaded = loaded
             self.load_completed_ms = now_ms()
             if loaded.max_concurrency:
+                self.max_concurrency = loaded.max_concurrency
                 self._sem = threading.Semaphore(loaded.max_concurrency)
             self._transition(EntryState.ACTIVE)
             return True

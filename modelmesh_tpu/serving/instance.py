@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time as _time
 import uuid
 from typing import Callable, Optional
 
@@ -202,6 +203,8 @@ class ModelMeshInstance:
         self.rate = RateTracker()
         self._model_rates: dict[str, RateTracker] = {}
         self._model_rates_lock = threading.Lock()
+        # model_id -> failfast-until timestamp (KV-outage sentinels).
+        self._kv_failfast: dict[str, int] = {}
 
         prefix = self.config.kv_prefix
         self.registry: KVTable[ModelRecord] = KVTable(
@@ -237,6 +240,32 @@ class ModelMeshInstance:
 
     def cluster_view(self) -> ClusterView:
         return ClusterView(instances=self.instances_view.items())
+
+    # KV outage fail-fast: after a registry read error, requests for THAT
+    # model fail immediately (UNAVAILABLE) for a cooldown window instead of
+    # hammering the dead store, then self-heal — per-model sentinels like
+    # the reference's KVSTORE_LOAD_FAILURE cache entries
+    # (ModelMesh.java:5295-5350). Models already in the local cache or the
+    # watch-fed view are unaffected (serving continues through an outage).
+    KV_FAILFAST_COOLDOWN_MS = 30_000
+
+    def _registry_get_failfast(self, model_id: str):
+        until = self._kv_failfast.get(model_id, 0)
+        if now_ms() < until:
+            raise ServiceUnavailableError(
+                f"registry unavailable for {model_id} (cooling down)"
+            )
+        try:
+            mr = self.registry.get(model_id)
+            self._kv_failfast.pop(model_id, None)
+            return mr
+        except Exception as e:  # noqa: BLE001 — any store error trips it
+            self._kv_failfast[model_id] = (
+                now_ms() + self.KV_FAILFAST_COOLDOWN_MS
+            )
+            log.error("registry read of %s failed; failing fast for %ds: %s",
+                      model_id, self.KV_FAILFAST_COOLDOWN_MS // 1000, e)
+            raise ServiceUnavailableError(f"registry unavailable: {e}") from e
 
     def _model_rate(self, model_id: str) -> RateTracker:
         with self._model_rates_lock:
@@ -431,7 +460,9 @@ class ModelMeshInstance:
                     last_exc = e
                     ctx.exclude_load.add(self.instance_id)
 
-            mr = self.registry_view.get(model_id) or self.registry.get(model_id)
+            mr = self.registry_view.get(model_id)
+            if mr is None:
+                mr = self._registry_get_failfast(model_id)
             if mr is None:
                 raise ModelNotFoundError(model_id)
 
@@ -542,7 +573,9 @@ class ModelMeshInstance:
         if not ce.before_invoke():
             raise ModelLoadException(f"{ce.model_id}: concurrency gate timeout")
         try:
+            t0 = _time.perf_counter()
             out = self._runtime_call(ce, method, payload, headers)
+            ce.record_latency((_time.perf_counter() - t0) * 1e3)
             self.rate.record()
             self._model_rate(ce.model_id).record()
             self.cache.get(ce.model_id)  # LRU touch

@@ -82,6 +82,13 @@ def main(argv=None) -> None:
     parser.add_argument("--prestop-port", type=int, default=-1)
     parser.add_argument("--strategy", choices=["greedy", "jax"], default="greedy")
     parser.add_argument("--load-timeout-s", type=float, default=None)
+    parser.add_argument("--tls-cert", default="", help="server cert PEM path")
+    parser.add_argument("--tls-key", default="", help="server key PEM path")
+    parser.add_argument("--tls-ca", default="", help="trust-root PEM path")
+    parser.add_argument(
+        "--tls-client-auth", action="store_true",
+        help="require peer/client certificates signed by --tls-ca (mTLS)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=os.environ.get("MM_LOG_LEVEL", "INFO"),
@@ -127,6 +134,15 @@ def main(argv=None) -> None:
 
         strategy = JaxPlacementStrategy()
 
+    tls = None
+    if args.tls_cert:
+        from modelmesh_tpu.serving.tls import TlsConfig
+
+        tls = TlsConfig.from_files(
+            args.tls_cert, args.tls_key, args.tls_ca or None,
+            require_client_auth=args.tls_client_auth,
+        )
+
     instance = ModelMeshInstance(
         store,
         loader,
@@ -139,7 +155,7 @@ def main(argv=None) -> None:
             load_timeout_s=args.load_timeout_s,
         ),
         strategy=strategy,
-        peer_call=make_grpc_peer_call(),
+        peer_call=make_grpc_peer_call(tls=tls),
         metrics=metrics,
         constraints=constraints,
         upgrade_tracker=UpgradeTracker(),
@@ -154,6 +170,7 @@ def main(argv=None) -> None:
         vmodels=vmodels,
         advertise_host=args.advertise_host,
         payload_processor=payload_proc,
+        tls=tls,
     )
     instance.config.endpoint = server.endpoint
     instance.publish_instance_record(force=True)

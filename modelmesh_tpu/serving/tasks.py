@@ -100,14 +100,32 @@ class BackgroundTasks:
     def stop(self) -> None:
         self._stop.set()
 
+    # Tasks that mutate the registry skip their cycle when the KV store is
+    # unreachable (reference janitor/reaper guard, ModelMesh.java:5886,
+    # 6449) — half-applied reconciliation against a flapping store does
+    # more harm than a skipped cycle.
+    _NEEDS_KV = frozenset({"janitor", "reaper"})
+
+    def _kv_reachable(self) -> bool:
+        try:
+            self.instance.store.get(
+                f"{self.instance.config.kv_prefix}/__health__"
+            )
+            return True
+        except Exception:  # noqa: BLE001 — any store error counts
+            return False
+
     def _loop(self, name: str, interval: float, fn) -> None:
         while not self._stop.wait(interval):
             if self.instance.shutting_down:
                 return
+            if name in self._NEEDS_KV and not self._kv_reachable():
+                log.warning("task %s: kv unreachable; skipping cycle", name)
+                continue
             try:
                 fn()
             except Exception:  # noqa: BLE001 — tasks must not die
-                log.exception("task %s failed", name)
+                log.exception("task %s failed (cycle aborted)", name)
 
     # -- publisher ---------------------------------------------------------
 
@@ -145,14 +163,21 @@ class BackgroundTasks:
                 age = last_used - prev
                 if prev and cfg.second_copy_min_age_ms <= age <= cfg.second_copy_max_age_ms:
                     self._add_copy(model_id, mr)
-                continue
-            # Local per-copy rate vs the per-copy threshold: each instance
-            # sees only its own copy's traffic, so if the copy it serves is
-            # at threshold, the model needs another copy (reference
-            # rateTrackingTask compares local rpm to scaleUpRpms,
-            # ModelMesh.java:5762).
+                    continue
+            # Local per-copy rate vs the per-copy threshold (applies at any
+            # copy count — a saturated single copy must scale too): each
+            # instance sees only its own copy's traffic, so if the copy it
+            # serves is at threshold, the model needs another copy
+            # (reference rateTrackingTask, ModelMesh.java:5762). In latency
+            # mode (runtime declared a per-model concurrency limit) the
+            # threshold is dynamic: 90% of this copy's measured bandwidth
+            # (reference :719-732).
             rpm = inst.model_rpm(model_id)
-            if rpm >= cfg.scale_up_rpm:
+            threshold = cfg.scale_up_rpm
+            bandwidth = ce.bandwidth_rpm()
+            if bandwidth > 0:
+                threshold = max(1, int(bandwidth * 0.9))
+            if rpm >= threshold:
                 self._add_copy(model_id, mr)
 
     def _add_copy(self, model_id: str, mr: ModelRecord) -> None:

@@ -1,0 +1,203 @@
+"""TLS surfaces, KV-outage fail-fast, latency-based autoscaling."""
+
+import time
+
+import grpc
+import pytest
+
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.runtime import ModelInfo, grpc_defs
+from modelmesh_tpu.runtime.fake import (
+    PREDICT_METHOD,
+    FakeRuntimeServicer,
+    start_fake_runtime,
+)
+from modelmesh_tpu.runtime.sidecar import SidecarRuntime
+from modelmesh_tpu.serving.api import MeshServer, make_grpc_peer_call
+from modelmesh_tpu.serving.instance import InstanceConfig, ModelMeshInstance
+from modelmesh_tpu.serving.tls import TlsConfig, generate_self_signed, secure_channel
+
+INFO = ModelInfo(model_type="example", model_path="mem://r")
+
+
+class TestTls:
+    @pytest.fixture(scope="class")
+    def tls(self):
+        return generate_self_signed()
+
+    def _mk_instance(self, store, iid, peer_call=None):
+        server, port, _ = start_fake_runtime(
+            servicer=FakeRuntimeServicer(capacity_bytes=64 << 20)
+        )
+        loader = SidecarRuntime(f"127.0.0.1:{port}", startup_timeout_s=10)
+        inst = ModelMeshInstance(
+            store, loader,
+            InstanceConfig(instance_id=iid, load_timeout_s=10,
+                           min_churn_age_ms=0),
+            peer_call=peer_call,
+        )
+        return inst, server
+
+    def test_tls_server_rejects_plaintext_and_serves_tls(self, tls):
+        store = InMemoryKV(sweep_interval_s=0.05)
+        inst, rt = self._mk_instance(store, "i-tls")
+        server = MeshServer(inst, tls=tls)
+        try:
+            inst.register_model("m-tls", INFO)
+            # Plaintext to a TLS port fails.
+            ch_plain = grpc.insecure_channel(server.endpoint)
+            with pytest.raises(grpc.RpcError):
+                grpc_defs.raw_method(ch_plain, PREDICT_METHOD)(
+                    b"x", metadata=[("mm-model-id", "m-tls")], timeout=5
+                )
+            ch_plain.close()
+            # TLS client works.
+            ch = secure_channel(server.endpoint, tls, override_authority="localhost")
+            out = grpc_defs.raw_method(ch, PREDICT_METHOD)(
+                b"x", metadata=[("mm-model-id", "m-tls")], timeout=20
+            )
+            assert out.startswith(b"m-tls:")
+            ch.close()
+        finally:
+            server.stop()
+            inst.shutdown()
+            rt.stop(0)
+            store.close()
+
+    def test_mtls_forwarding_between_instances(self, tls):
+        mtls = TlsConfig(
+            cert_pem=tls.cert_pem, key_pem=tls.key_pem, ca_pem=tls.ca_pem,
+            require_client_auth=True,
+            override_authority="localhost",  # shared test cert's SAN
+        )
+        store = InMemoryKV(sweep_interval_s=0.05)
+        peer_call = make_grpc_peer_call(tls=mtls, timeout_s=15)
+        a, rt_a = self._mk_instance(store, "i-mta", peer_call)
+        b, rt_b = self._mk_instance(store, "i-mtb", peer_call)
+        sa = MeshServer(a, tls=mtls)
+        sb = MeshServer(b, tls=mtls)
+        a.config.endpoint = sa.endpoint
+        b.config.endpoint = sb.endpoint
+        a.publish_instance_record(force=True)
+        b.publish_instance_record(force=True)
+        try:
+            for inst in (a, b):
+                inst.instances_view.wait_for(lambda v: len(v) >= 2)
+            a.register_model("m-mtls", INFO, load_now=True, sync=True)
+            holder = "i-mta" if a.cache.get_quietly("m-mtls") else "i-mtb"
+            other = b if holder == "i-mta" else a
+            # Wait for the non-holder's registry view to see the placement,
+            # else it treats the request as a cache miss and loads locally.
+            other.registry_view.wait_for(
+                lambda v: v.get("m-mtls") is not None
+                and holder in v.get("m-mtls").instance_ids
+            )
+            # Request at the non-holder forwards over mTLS.
+            res = other.invoke_model("m-mtls", PREDICT_METHOD, b"x", [])
+            assert res.payload.startswith(b"m-mtls:")
+            assert res.served_by == holder
+        finally:
+            sa.stop()
+            sb.stop()
+            a.shutdown()
+            b.shutdown()
+            rt_a.stop(0)
+            rt_b.stop(0)
+            store.close()
+
+
+class TestKvFailFast:
+    def test_registry_outage_fails_fast_then_heals(self):
+        from modelmesh_tpu.serving.errors import ServiceUnavailableError
+
+        store = InMemoryKV(sweep_interval_s=0.05)
+        rt_server, port, _ = start_fake_runtime(
+            servicer=FakeRuntimeServicer(capacity_bytes=64 << 20)
+        )
+        loader = SidecarRuntime(f"127.0.0.1:{port}", startup_timeout_s=10)
+        inst = ModelMeshInstance(
+            store, loader,
+            InstanceConfig(instance_id="i-kvff", load_timeout_s=10,
+                           min_churn_age_ms=0),
+        )
+        try:
+            # Unknown model + broken store -> fail fast with UNAVAILABLE.
+            real_get = inst.registry.get
+            inst.registry.get = lambda *a, **k: (_ for _ in ()).throw(
+                ConnectionError("kv down")
+            )
+            with pytest.raises(ServiceUnavailableError):
+                inst.invoke_model("m-kvff", PREDICT_METHOD, b"x", [])
+            # Cooldown: next request fails immediately without touching KV.
+            t0 = time.monotonic()
+            with pytest.raises(ServiceUnavailableError):
+                inst.invoke_model("m-kvff", PREDICT_METHOD, b"x", [])
+            assert time.monotonic() - t0 < 0.5
+            # Heal: restore the store and expire the cooldown.
+            inst.registry.get = real_get
+            inst._kv_failfast.clear()
+            inst.register_model("m-kvff", INFO)
+            out = inst.invoke_model("m-kvff", PREDICT_METHOD, b"x", [])
+            assert out.payload.startswith(b"m-kvff:")
+        finally:
+            inst.shutdown()
+            rt_server.stop(0)
+            store.close()
+
+
+class TestLatencyBandwidth:
+    def test_bandwidth_estimate(self):
+        from modelmesh_tpu.runtime.spi import LoadedModel
+        from modelmesh_tpu.serving.entry import CacheEntry
+        from modelmesh_tpu.runtime.spi import ModelInfo as MI
+
+        ce = CacheEntry("m", MI("t"))
+        ce.state = ce.state  # no-op
+        assert ce.bandwidth_rpm() == 0  # no data yet
+        ce.max_concurrency = 2
+        for _ in range(50):
+            ce.record_latency(10.0)  # 10ms avg, 2 slots
+        # ~2 slots * 6000 rpm/slot = ~12000 rpm
+        assert 10_000 < ce.bandwidth_rpm() < 13_000
+
+    def test_latency_mode_scales_with_dynamic_threshold(self):
+        # A slow model with a concurrency limit must scale up even though
+        # its RPM is far below the static threshold.
+        from tests.cluster_util import Cluster
+        from modelmesh_tpu.serving.tasks import BackgroundTasks, TaskConfig
+
+        c = Cluster(n=2)
+        try:
+            cfg = TaskConfig(
+                rate_interval_s=0.2, scale_up_rpm=10**9,  # static: never
+                second_copy_min_age_ms=10**9,  # disable the 1->2 pattern
+            )
+            tasks = [BackgroundTasks(p.instance, cfg) for p in c.pods]
+            for t in tasks:
+                t.start()
+            inst = c[0].instance
+            inst.register_model("m-slow", INFO)
+            inst.invoke_model("m-slow", PREDICT_METHOD, b"x", [])
+            holder = c.pod_with_copy("m-slow").instance
+            ce = holder.cache.get_quietly("m-slow")
+            # Simulate a saturated slow copy: 1 slot, 2s per call ->
+            # bandwidth ~30 rpm; push local rate above 27 rpm.
+            ce.max_concurrency = 1
+            for _ in range(50):
+                ce.record_latency(2000.0)
+            # bandwidth ~30 rpm -> threshold ~27; the 5-min-window RPM is
+            # total/window, so ~150 records ≈ 37 rpm > threshold.
+            for _ in range(150):
+                holder._model_rate("m-slow").record()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                holder.cache.get("m-slow")  # keep it in the used-since window
+                mr = inst.registry.get("m-slow")
+                if mr.copy_count >= 2:
+                    break
+                time.sleep(0.2)
+            assert inst.registry.get("m-slow").copy_count >= 2
+            for t in tasks:
+                t.stop()
+        finally:
+            c.close()

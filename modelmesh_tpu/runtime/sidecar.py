@@ -46,8 +46,18 @@ class SidecarRuntime(ModelLoader[str]):
         startup_timeout_s: float = 120.0,
         poll_interval_s: float = 1.0,
         channel: Optional[grpc.Channel] = None,
+        tls=None,
     ):
-        self._channel = channel or grpc.insecure_channel(target)
+        """``tls`` (serving.tls.TlsConfig) secures the runtime link — needed
+        whenever the model server isn't a loopback/UDS sidecar."""
+        if channel is None:
+            if tls is not None:
+                from modelmesh_tpu.serving.tls import secure_channel
+
+                channel = secure_channel(target, tls)
+            else:
+                channel = grpc.insecure_channel(target)
+        self._channel = channel
         self._stub = grpc_defs.make_stub(
             self._channel, grpc_defs.RUNTIME_SERVICE, grpc_defs.RUNTIME_METHODS
         )

@@ -474,10 +474,7 @@ class PeerChannels:
                 if self._tls is not None:
                     from modelmesh_tpu.serving.tls import secure_channel
 
-                    ch = secure_channel(
-                        endpoint, self._tls,
-                        override_authority=self._tls.override_authority,
-                    )
+                    ch = secure_channel(endpoint, self._tls)
                 else:
                     ch = grpc.insecure_channel(endpoint)
                 self._channels[endpoint] = ch

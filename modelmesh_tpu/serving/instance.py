@@ -260,9 +260,14 @@ class ModelMeshInstance:
             self._kv_failfast.pop(model_id, None)
             return mr
         except Exception as e:  # noqa: BLE001 — any store error trips it
-            self._kv_failfast[model_id] = (
-                now_ms() + self.KV_FAILFAST_COOLDOWN_MS
-            )
+            now = now_ms()
+            # Prune expired sentinels on insert so externally-driven id
+            # churn can't grow the dict without bound.
+            if len(self._kv_failfast) > 1024:
+                self._kv_failfast = {
+                    k: v for k, v in self._kv_failfast.items() if v > now
+                }
+            self._kv_failfast[model_id] = now + self.KV_FAILFAST_COOLDOWN_MS
             log.error("registry read of %s failed; failing fast for %ds: %s",
                       model_id, self.KV_FAILFAST_COOLDOWN_MS // 1000, e)
             raise ServiceUnavailableError(f"registry unavailable: {e}") from e
@@ -439,7 +444,10 @@ class ModelMeshInstance:
             ce = self.cache.get(model_id)
             if ce is None or ce.state in (EntryState.FAILED, EntryState.REMOVED):
                 raise ModelNotHereError(self.instance_id, model_id)
-            return self._invoke_local(ce, method, payload, headers, sync=sync)
+            return self._invoke_local(
+                ce, method, payload, headers, sync=sync,
+                chain_count=ctx.chain_load_count,
+            )
 
         last_exc: Optional[Exception] = None
         # A pure placement op (method None) with ourselves excluded must not
@@ -453,7 +461,10 @@ class ModelMeshInstance:
                 EntryState.FAILED, EntryState.REMOVED
             ):
                 try:
-                    return self._invoke_local(ce, method, payload, headers, sync=sync)
+                    return self._invoke_local(
+                        ce, method, payload, headers, sync=sync,
+                        chain_count=ctx.chain_load_count,
+                    )
                 except ModelNotHereError as e:
                     last_exc = e  # runtime lost it; cleanup already done
                 except ModelLoadException as e:
@@ -470,7 +481,10 @@ class ModelMeshInstance:
                 ce = self._load_local(model_id, mr, ctx)
                 if ce is None:
                     raise NoCapacityError(self.instance_id)
-                return self._invoke_local(ce, method, payload, headers, sync=sync)
+                return self._invoke_local(
+                    ce, method, payload, headers, sync=sync,
+                    chain_count=ctx.chain_load_count,
+                )
 
             # 2. cache-hit loop: forward to a loaded copy
             exclude = (
@@ -525,7 +539,10 @@ class ModelMeshInstance:
             if target in (LOAD_HERE, self.instance_id):
                 ce = self._load_local(model_id, mr, ctx)
                 if ce is not None:
-                    return self._invoke_local(ce, method, payload, headers, sync=sync)
+                    return self._invoke_local(
+                        ce, method, payload, headers, sync=sync,
+                        chain_count=ctx.chain_load_count,
+                    )
                 ctx.exclude_load.add(self.instance_id)
                 last_exc = last_exc or NoCapacityError(self.instance_id)
                 continue
@@ -557,6 +574,7 @@ class ModelMeshInstance:
     def _invoke_local(
         self, ce: CacheEntry, method: Optional[str], payload: bytes,
         headers: list[tuple[str, str]], sync: bool = True,
+        chain_count: int = 0,
     ) -> InvokeResult:
         if not sync and ce.state.is_loading:
             return InvokeResult(b"", self.instance_id, "LOADING")
@@ -567,8 +585,15 @@ class ModelMeshInstance:
         if ce.state is not EntryState.ACTIVE:
             raise ModelNotHereError(self.instance_id, ce.model_id)
         if method is None:
-            # ensure-loaded op: presence is the result
-            self._maybe_chain_load(ce)
+            # ensure-loaded op: presence is the result. A chain count must
+            # still propagate even though the copy already exists here —
+            # otherwise ensure_loaded(chain=N) silently truncates whenever
+            # the first target is already a holder (the fresh-load path
+            # fires its own chain in _run_load; the _chain_fired flag
+            # prevents double-fire).
+            if chain_count > 0 and not getattr(ce, "_chain_fired", False):
+                ce._chain_fired = True
+                self._spawn_chain(ce.model_id, ce.last_used, chain_count)
             return InvokeResult(b"", self.instance_id, "LOADED")
         if not ce.before_invoke():
             raise ModelLoadException(f"{ce.model_id}: concurrency gate timeout")
@@ -613,11 +638,38 @@ class ModelMeshInstance:
                 raise ModelNotHereError(self.instance_id, ce.model_id) from e
             raise ApplierError(e.code().name, e.details() or "") from e
 
-    def _maybe_chain_load(self, ce: CacheEntry) -> None:
-        """Chained copy loads: each target triggers the next copy with itself
-        appended to the exclusions (reference triggerChainedLoadIfNecessary,
-        ModelMesh.java:4560-4585). Handled by tasks layer via ensure_loaded;
-        kept as a hook here."""
+    def _trigger_chained_load(self, ce: CacheEntry) -> None:
+        """Chained copy loads: each instance that completes a chained load
+        triggers the NEXT copy with itself and all existing placements
+        excluded (reference triggerChainedLoadIfNecessary,
+        ModelMesh.java:4560-4585) — distributing an N-copy ensureLoaded
+        across the fleet one hop at a time instead of hammering one caller.
+        """
+        remaining = getattr(ce, "chain_load_count", 0)
+        if remaining <= 0:
+            return
+        ce._chain_fired = True
+        self._spawn_chain(ce.model_id, ce.last_used, remaining)
+
+    def _spawn_chain(self, model_id: str, last_used: int, remaining: int) -> None:
+        def chain():
+            try:
+                mr = self.registry.get(model_id)
+                if mr is None:
+                    return
+                self.ensure_loaded(
+                    model_id,
+                    last_used_ms=last_used,
+                    sync=False,
+                    exclude=set(mr.all_placements) | {self.instance_id},
+                    chain=remaining - 1,
+                )
+            except Exception as e:  # noqa: BLE001 — chain is best-effort
+                log.debug("chained load of %s stopped: %s", model_id, e)
+
+        threading.Thread(
+            target=chain, name=f"chain-{model_id}", daemon=True
+        ).start()
 
     # ------------------------------------------------------------------ #
     # local load lifecycle                                               #
@@ -667,6 +719,7 @@ class ModelMeshInstance:
 
         last_used = ctx.last_used_ms or now_ms()
         ce = CacheEntry(model_id, info, weight_units=units, last_used=last_used)
+        ce.chain_load_count = ctx.chain_load_count
         prev = self.cache.put_if_absent(model_id, ce, units, last_used=last_used)
         if prev is not None:
             return prev
@@ -742,6 +795,7 @@ class ModelMeshInstance:
                 self.loader.unload(model_id)
                 return
             self._promote_loaded(model_id, size_units=ce.weight_units)
+            self._trigger_chained_load(ce)
             self.metrics.inc(MX.LOAD_COUNT, model_id=model_id)
             if ce.load_started_ms:
                 self.metrics.observe(

@@ -44,7 +44,7 @@ def build_store(kv_uri: str):
     raise ValueError(f"unknown kv scheme {scheme!r} (mesh://, etcd://, memory://)")
 
 
-def build_loader(runtime: str, capacity_mb: int):
+def build_loader(runtime: str, capacity_mb: int, tls=None):
     if runtime == "jax":
         from modelmesh_tpu.models.server import InProcessJaxLoader
 
@@ -63,7 +63,9 @@ def build_loader(runtime: str, capacity_mb: int):
     if runtime.startswith("sidecar:"):
         from modelmesh_tpu.runtime.sidecar import SidecarRuntime
 
-        return SidecarRuntime(runtime[len("sidecar:"):], startup_timeout_s=300)
+        return SidecarRuntime(
+            runtime[len("sidecar:"):], startup_timeout_s=300, tls=tls
+        )
     raise ValueError(f"unknown runtime {runtime!r} (jax | fake | sidecar:addr)")
 
 
@@ -111,8 +113,17 @@ def main(argv=None) -> None:
     from modelmesh_tpu.serving.tasks import BackgroundTasks
     from modelmesh_tpu.serving.vmodels import VModelManager
 
+    tls = None
+    if args.tls_cert:
+        from modelmesh_tpu.serving.tls import TlsConfig
+
+        tls = TlsConfig.from_files(
+            args.tls_cert, args.tls_key, args.tls_ca or None,
+            require_client_auth=args.tls_client_auth,
+        )
+
     store = build_store(args.kv)
-    loader = build_loader(args.runtime, args.capacity_mb)
+    loader = build_loader(args.runtime, args.capacity_mb, tls=tls)
     metrics = (
         PrometheusMetrics(
             port=max(args.metrics_port, 0),
@@ -133,15 +144,6 @@ def main(argv=None) -> None:
         from modelmesh_tpu.placement.jax_engine import JaxPlacementStrategy
 
         strategy = JaxPlacementStrategy()
-
-    tls = None
-    if args.tls_cert:
-        from modelmesh_tpu.serving.tls import TlsConfig
-
-        tls = TlsConfig.from_files(
-            args.tls_cert, args.tls_key, args.tls_ca or None,
-            require_client_auth=args.tls_client_auth,
-        )
 
     instance = ModelMeshInstance(
         store,

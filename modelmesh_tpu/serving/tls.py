@@ -43,6 +43,11 @@ class TlsConfig:
         return cls(cert, key, ca, require_client_auth)
 
     def server_credentials(self) -> grpc.ServerCredentials:
+        if self.require_client_auth and not self.ca_pem:
+            raise ValueError(
+                "client-auth (mTLS) requires trust roots: provide ca_pem "
+                "(--tls-ca) alongside require_client_auth"
+            )
         return grpc.ssl_server_credentials(
             [(self.key_pem, self.cert_pem)],
             root_certificates=self.ca_pem,
@@ -61,11 +66,14 @@ class TlsConfig:
 
 def secure_channel(endpoint: str, tls: Optional[TlsConfig],
                    override_authority: Optional[str] = None) -> grpc.Channel:
+    """``override_authority`` defaults to ``tls.override_authority`` so call
+    sites don't have to re-plumb a field the config already carries."""
     if tls is None:
         return grpc.insecure_channel(endpoint)
+    authority = override_authority or tls.override_authority
     options = []
-    if override_authority:
-        options.append(("grpc.ssl_target_name_override", override_authority))
+    if authority:
+        options.append(("grpc.ssl_target_name_override", authority))
     return grpc.secure_channel(endpoint, tls.channel_credentials(), options)
 
 

@@ -76,6 +76,20 @@ class TestClusterBasics:
         mr = inst0.registry.get("m-2copy")
         assert len(mr.instance_ids) == 2
 
+    def test_chained_load_fans_copies_across_fleet(self, cluster):
+        # ensure_loaded with a chain count distributes N copies hop by hop:
+        # each completing instance triggers the next with itself excluded.
+        inst0 = cluster[0].instance
+        inst0.register_model("m-chain", INFO)
+        inst0.ensure_loaded("m-chain", sync=True, chain=2)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            mr = inst0.registry.get("m-chain")
+            if len(mr.instance_ids) >= 3:
+                break
+            time.sleep(0.1)
+        assert len(inst0.registry.get("m-chain").instance_ids) == 3
+
     def test_management_api_over_grpc(self, cluster):
         from modelmesh_tpu.proto import mesh_api_pb2 as apb
 

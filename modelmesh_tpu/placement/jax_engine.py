@@ -127,18 +127,57 @@ def build_problem(
 
 
 class GlobalPlan:
-    """Solved assignment: model -> ordered preferred instances."""
+    """Solved assignment: model -> ordered preferred instances.
+
+    Plans travel: the leader solves and publishes the serialized plan to the
+    KV store (placement/plan_sync.py) and every instance adopts it from a
+    watch — the analog of the reference's leader-computed placement
+    decisions propagating via the shared registry (ModelMesh.java:6616-6747),
+    except here the whole assignment ships as one artifact. ``age_ms`` is
+    measured from *local adoption time* so follower TTLs don't depend on
+    clock agreement with the leader: a dead leader stops publishing and
+    plans expire everywhere on their own clocks.
+    """
 
     def __init__(
         self, placements: dict[str, list[str]], solved_at_ms: int,
-        solve_ms: float,
+        solve_ms: float, generation: int = 0,
     ):
         self.placements = placements
         self.solved_at_ms = solved_at_ms
         self.solve_ms = solve_ms
+        self.generation = generation
+        self.adopted_at_ms = solved_at_ms
 
     def age_ms(self) -> int:
-        return now_ms() - self.solved_at_ms
+        return now_ms() - self.adopted_at_ms
+
+    # -- wire format (zlib'd JSON; compact keys — plans can cover 100k models)
+
+    def to_bytes(self) -> bytes:
+        import json
+        import zlib
+
+        payload = json.dumps(
+            {
+                "g": self.generation,
+                "t": self.solved_at_ms,
+                "ms": self.solve_ms,
+                "p": self.placements,
+            },
+            separators=(",", ":"),
+        )
+        return zlib.compress(payload.encode(), level=1)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "GlobalPlan":
+        import json
+        import zlib
+
+        d = json.loads(zlib.decompress(data).decode())
+        plan = cls(d["p"], d["t"], d["ms"], d.get("g", 0))
+        plan.adopted_at_ms = now_ms()
+        return plan
 
 
 def solve_plan(
@@ -159,9 +198,13 @@ def solve_plan(
     sol = jax.block_until_ready(solve_placement(problem, seed=seed))
     idx = np.asarray(sol.indices)
     valid = np.asarray(sol.valid)
+    # Hottest-first insertion order: publish_plan truncates from the tail
+    # under its byte budget, so the models that lose central placement must
+    # be the coldest, not whichever ones the registry iterated last.
+    order = np.argsort(-np.asarray(problem.rates), kind="stable")
     placements = {
         model_ids[i]: [instance_ids[j] for j in idx[i][valid[i]]]
-        for i in range(len(model_ids))
+        for i in order
     }
     solve_ms = (time.perf_counter() - t0) * 1e3
     return GlobalPlan(placements, now_ms(), solve_ms)
@@ -177,7 +220,10 @@ class JaxPlacementStrategy(PlacementStrategy):
 
     def __init__(
         self,
-        plan_ttl_ms: int = 60_000,
+        # Must exceed the publish cadence (the leader reaper's
+        # reaper_interval_s, default 420 s) or followers spend most of each
+        # cycle TTL-expired and silently serving greedy.
+        plan_ttl_ms: int = 15 * 60_000,
         fallback: Optional[PlacementStrategy] = None,
     ):
         self.plan_ttl_ms = plan_ttl_ms
@@ -199,12 +245,22 @@ class JaxPlacementStrategy(PlacementStrategy):
         with self._refresh_lock:
             self._seed += 1
             plan = solve_plan(models, instances, rpm_fn, seed=self._seed)
+            plan.generation = self._seed
             self._plan = plan
             log.info(
                 "placement plan refreshed: %d models x %d instances in %.1f ms",
                 len(plan.placements), len(instances), plan.solve_ms,
             )
             return plan
+
+    def adopt(self, plan: Optional[GlobalPlan]) -> None:
+        """Install a plan published by the leader (watch-fed; None clears).
+
+        Adoption order is the KV watch's event order — the store serializes
+        publishes, so the latest delivered plan is the freshest and no
+        generation comparison against a possibly-restarted leader is needed.
+        """
+        self._plan = plan
 
     # -- SPI ----------------------------------------------------------------
 

@@ -227,6 +227,15 @@ class ModelMeshInstance:
             store, f"{prefix}/leader", self.instance_id, self._on_leader_change
         )
         self._election.start()
+        # Fleet-wide plan distribution: any strategy that can adopt a
+        # published GlobalPlan (the JAX strategy) follows the leader's
+        # solves via a KV watch — non-leaders serve the central plan too,
+        # not just the process that happened to solve it.
+        self._plan_follower = None
+        if hasattr(self.strategy, "adopt"):
+            from modelmesh_tpu.placement.plan_sync import PlanFollower
+
+            self._plan_follower = PlanFollower(store, prefix, self.strategy)
         self._publish_lock = threading.Lock()
         self._last_published: Optional[InstanceRecord] = None
         log.info(
@@ -994,6 +1003,8 @@ class ModelMeshInstance:
 
     def shutdown(self) -> None:
         self.loading_pool.shutdown()
+        if self._plan_follower is not None:
+            self._plan_follower.close()
         self._election.close()
         self._session.close()
         self.registry_view.close()

@@ -281,13 +281,18 @@ class BackgroundTasks:
         refresh = getattr(inst.strategy, "refresh", None)
         if refresh is not None:
             try:
-                refresh(
+                plan = refresh(
                     list(inst.registry.items()),
                     inst.instances_view.items(),
                     inst.model_rpm,
                 )
+                # Publish so EVERY instance's PlanFollower (instance.py)
+                # serves this solve, not just the leader's own strategy.
+                from modelmesh_tpu.placement.plan_sync import publish_plan
+
+                publish_plan(inst.store, inst.config.kv_prefix, plan)
             except Exception:  # noqa: BLE001 — plan is advisory
-                log.exception("global plan refresh failed")
+                log.exception("global plan refresh/publish failed")
         now = now_ms()
         live = {iid for iid, _ in inst.instances_view.items()}
         # Track how long each referenced instance has been missing.

@@ -52,6 +52,7 @@ class Cluster:
         n: int = 3,
         capacity_bytes: int = 64 << 20,
         kv: InMemoryKV | None = None,
+        strategy_factory=None,
         **config_kwargs,
     ):
         self.kv = kv or InMemoryKV(sweep_interval_s=0.05)
@@ -74,6 +75,7 @@ class Cluster:
                     **config_kwargs,
                 ),
                 peer_call=peer_call,
+                strategy=strategy_factory() if strategy_factory else None,
             )
             vmodels = VModelManager(inst, sweep_interval_s=0.3)
             server = MeshServer(inst, vmodels=vmodels)

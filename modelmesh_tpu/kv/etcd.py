@@ -25,6 +25,7 @@ from typing import Iterable, Optional
 
 import grpc
 
+from modelmesh_tpu.utils.grpcopts import env_int, message_size_options
 from modelmesh_tpu.kv.store import (
     Compare,
     EventType,
@@ -90,13 +91,19 @@ class _EtcdWatch(WatchHandle):
 
 class EtcdKV(KVStore):
     def __init__(self, target: str, timeout_s: float = 10.0):
-        self._channel = grpc.insecure_channel(target)
+        self._channel = grpc.insecure_channel(
+            target, options=message_size_options()
+        )
         self._kv = grpc_defs.make_stub(self._channel, _KV_SERVICE, _KV_METHODS)
         self._lease = grpc_defs.make_stub(
             self._channel, _LEASE_SERVICE, _LEASE_METHODS
         )
         self._timeout = timeout_s
         self._watches: list[_EtcdWatch] = []
+        # etcd enforces a server-side request quota (--max-request-bytes,
+        # 1.5 MiB default); stay conservatively under it so puts fail here
+        # with a clear error instead of an opaque etcdserver rejection.
+        self._max_value_bytes = env_int("MM_ETCD_MAX_VALUE_BYTES", 1 << 20)
 
     # -- reads ------------------------------------------------------------
 
@@ -116,7 +123,11 @@ class EtcdKV(KVStore):
 
     # -- writes -----------------------------------------------------------
 
+    def max_value_bytes(self):
+        return self._max_value_bytes
+
     def put(self, key: str, value: bytes, lease: int = 0) -> KeyValue:
+        self.check_value_size(value)
         # Atomic put+read-back in one Txn so a concurrent delete/re-put
         # can't make us return another writer's KeyValue (or crash).
         k = key.encode()

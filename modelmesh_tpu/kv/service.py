@@ -22,6 +22,7 @@ from typing import Iterable, Optional
 
 import grpc
 
+from modelmesh_tpu.utils.grpcopts import max_message_bytes, message_size_options
 from modelmesh_tpu.kv.memory import InMemoryKV
 from modelmesh_tpu.kv.store import (
     Compare,
@@ -82,6 +83,18 @@ class MeshKVServicer:
         )
 
     def Put(self, request, context):
+        # Server-side limit enforcement: the client's env may disagree with
+        # ours (config skew) — reject with a clear status rather than letting
+        # the transport or backing store fail opaquely.
+        limit = self.store.max_value_bytes()
+        transport = max_message_bytes() - (64 << 10)
+        limit = transport if limit is None else min(limit, transport)
+        if len(request.value) > limit:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"value of {len(request.value)} bytes exceeds server limit "
+                f"{limit} (MM_MAX_MSG_BYTES)",
+            )
         try:
             kv = self.store.put(request.key, request.value, request.lease)
         except ValueError as e:
@@ -191,7 +204,10 @@ def start_kv_server(
     bind_host (and front with mTLS/network policy) for multi-host fleets."""
     store = store or InMemoryKV()
     servicer = MeshKVServicer(store)
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=message_size_options(),
+    )
     grpc_defs.add_servicer(server, servicer, KV_SERVICE, KV_METHODS)
     server.add_generic_rpc_handlers((_WatchStreamHandler(servicer),))
     bound = server.add_insecure_port(f"{bind_host}:{port}")
@@ -214,8 +230,13 @@ class RemoteKV(KVStore):
     """KVStore over a MeshKV server."""
 
     def __init__(self, target: str, timeout_s: float = 10.0):
-        self._channel = grpc.insecure_channel(target)
+        self._channel = grpc.insecure_channel(
+            target, options=message_size_options()
+        )
         self._stub = grpc_defs.make_stub(self._channel, KV_SERVICE, KV_METHODS)
+        # Transport-bound cap (headroom for the proto envelope), fixed at
+        # construction so the hot put path doesn't re-read the environment.
+        self._max_value_bytes = max_message_bytes() - (64 << 10)
         self._timeout = timeout_s
         self._watches: list[_RemoteWatch] = []
 
@@ -229,7 +250,11 @@ class RemoteKV(KVStore):
         )
         return [_from_proto(kv) for kv in resp.kvs]
 
+    def max_value_bytes(self):
+        return self._max_value_bytes
+
     def put(self, key: str, value: bytes, lease: int = 0) -> KeyValue:
+        self.check_value_size(value)
         try:
             resp = self._stub.Put(
                 kpb.PutRequest(key=key, value=value, lease=lease),

@@ -150,6 +150,26 @@ class KVStore(abc.ABC):
     def lease_revoke(self, lease_id: int) -> None:
         """Drop the lease and delete all attached keys."""
 
+    # -- limits -----------------------------------------------------------
+
+    def max_value_bytes(self) -> Optional[int]:
+        """Largest value this backend can store (None = unbounded).
+
+        Writers of potentially-large values (plan publication) size their
+        artifacts against this instead of discovering RESOURCE_EXHAUSTED at
+        put time.
+        """
+        return None
+
+    def check_value_size(self, value: bytes) -> None:
+        """Raise ValueError when ``value`` exceeds max_value_bytes()."""
+        limit = self.max_value_bytes()
+        if limit is not None and len(value) > limit:
+            raise ValueError(
+                f"value of {len(value)} bytes exceeds this store's limit "
+                f"of {limit} bytes"
+            )
+
     # -- lifecycle ---------------------------------------------------------
 
     @abc.abstractmethod

@@ -22,6 +22,7 @@ from typing import Optional
 
 import grpc
 
+from modelmesh_tpu.utils.grpcopts import message_size_options
 from modelmesh_tpu.models.families import ServableModel, build_model
 from modelmesh_tpu.proto import mesh_runtime_pb2 as rpb
 from modelmesh_tpu.runtime import grpc_defs
@@ -175,7 +176,10 @@ def start_jax_runtime(
 ) -> tuple[grpc.Server, int, JaxRuntimeServicer]:
     store = JaxModelStore(capacity_bytes)
     servicer = JaxRuntimeServicer(store)
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=message_size_options(),
+    )
     grpc_defs.add_servicer(
         server, servicer, grpc_defs.RUNTIME_SERVICE, grpc_defs.RUNTIME_METHODS
     )

@@ -23,6 +23,7 @@ from typing import Optional
 
 import grpc
 
+from modelmesh_tpu.utils.grpcopts import message_size_options
 from modelmesh_tpu.proto import mesh_runtime_pb2 as rpb
 from modelmesh_tpu.runtime import grpc_defs
 
@@ -122,6 +123,10 @@ class FakeRuntimeServicer:
             # The Triton/MLServer quirk: runtime lost the model
             # (reference handling at SidecarModelMesh.java:304-322, 961-988).
             context.abort(grpc.StatusCode.NOT_FOUND, f"model {mid} not loaded")
+        if method.endswith("/Echo"):
+            # Large-payload data-plane probe: response mirrors the request,
+            # exercising the send path at the same size as the receive path.
+            return request
         # Deterministic "prediction": classify payload by hash.
         label = (len(request) + sum(request[:16])) % 10
         return f"{mid}:category_{label}".encode()
@@ -134,7 +139,10 @@ def start_fake_runtime(
 ) -> tuple[grpc.Server, int, FakeRuntimeServicer]:
     """Start on localhost; returns (server, bound_port, servicer)."""
     servicer = servicer or FakeRuntimeServicer()
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=message_size_options(),
+    )
     grpc_defs.add_servicer(
         server, servicer, grpc_defs.RUNTIME_SERVICE, grpc_defs.RUNTIME_METHODS
     )

@@ -20,6 +20,7 @@ from typing import Optional
 
 import grpc
 
+from modelmesh_tpu.utils.grpcopts import message_size_options
 from modelmesh_tpu.proto import mesh_runtime_pb2 as rpb
 from modelmesh_tpu.runtime import grpc_defs
 from modelmesh_tpu.runtime.spi import (
@@ -56,7 +57,9 @@ class SidecarRuntime(ModelLoader[str]):
 
                 channel = secure_channel(target, tls)
             else:
-                channel = grpc.insecure_channel(target)
+                channel = grpc.insecure_channel(
+                    target, options=message_size_options()
+                )
         self._channel = channel
         self._stub = grpc_defs.make_stub(
             self._channel, grpc_defs.RUNTIME_SERVICE, grpc_defs.RUNTIME_METHODS

@@ -25,6 +25,7 @@ from typing import Optional
 
 import grpc
 
+from modelmesh_tpu.utils.grpcopts import message_size_options
 from modelmesh_tpu.observability.metrics import Metric as MX
 from modelmesh_tpu.observability.payloads import Payload
 
@@ -424,7 +425,10 @@ class MeshServer:
         self.instance = instance
         self._advertise_host = advertise_host
         self.tls = tls
-        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers))
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers),
+            options=message_size_options(),
+        )
         grpc_defs.add_servicer(
             self.server, MeshApiServicer(instance, vmodels),
             grpc_defs.API_SERVICE, grpc_defs.API_METHODS,
@@ -476,7 +480,9 @@ class PeerChannels:
 
                     ch = secure_channel(endpoint, self._tls)
                 else:
-                    ch = grpc.insecure_channel(endpoint)
+                    ch = grpc.insecure_channel(
+                        endpoint, options=message_size_options()
+                    )
                 self._channels[endpoint] = ch
             return ch
 

@@ -68,10 +68,12 @@ def secure_channel(endpoint: str, tls: Optional[TlsConfig],
                    override_authority: Optional[str] = None) -> grpc.Channel:
     """``override_authority`` defaults to ``tls.override_authority`` so call
     sites don't have to re-plumb a field the config already carries."""
+    from modelmesh_tpu.utils.grpcopts import message_size_options
+
     if tls is None:
-        return grpc.insecure_channel(endpoint)
+        return grpc.insecure_channel(endpoint, options=message_size_options())
     authority = override_authority or tls.override_authority
-    options = []
+    options = message_size_options()
     if authority:
         options.append(("grpc.ssl_target_name_override", authority))
     return grpc.secure_channel(endpoint, tls.channel_credentials(), options)

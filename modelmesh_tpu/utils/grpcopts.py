@@ -1,0 +1,35 @@
+"""Shared gRPC message-size options — the 16 MiB data plane.
+
+Every hop a payload can cross (external API, peer forward, runtime sidecar
+link, model server, MeshKV service, etcd client) must carry messages up to
+the configured maximum, or payloads die mid-mesh with RESOURCE_EXHAUSTED at
+gRPC's 4 MiB default. The reference defaults its service message cap to
+16 MiB (ModelMesh.java:149, env MM_SVC_GRPC_MAX_MSG_SIZE); we honor the
+same default under ``MM_MAX_MSG_BYTES``.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_MAX_MESSAGE_BYTES = 16 << 20
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def max_message_bytes() -> int:
+    return env_int("MM_MAX_MSG_BYTES", DEFAULT_MAX_MESSAGE_BYTES)
+
+
+def message_size_options() -> list[tuple[str, int]]:
+    """Channel/server options enabling the configured message cap."""
+    n = max_message_bytes()
+    return [
+        ("grpc.max_receive_message_length", n),
+        ("grpc.max_send_message_length", n),
+    ]

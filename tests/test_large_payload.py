@@ -1,0 +1,61 @@
+"""16 MiB data plane (SURVEY §5.7, reference default ModelMesh.java:149).
+
+A 12 MiB payload must survive the full wire path — external client →
+instance A → peer-forward → instance B → runtime sidecar → echo back —
+which crosses every gRPC hop the mesh has. Before MM_MAX_MSG_BYTES wiring
+this died at the first 4 MiB-default hop with RESOURCE_EXHAUSTED.
+"""
+
+import grpc
+import pytest
+
+from modelmesh_tpu.runtime import ModelInfo, grpc_defs
+
+ECHO_METHOD = "/mmtpu.example.Predictor/Echo"
+PAYLOAD = bytes(bytearray(range(256)) * (12 * 4096))  # 12 MiB, non-trivial
+
+
+class TestLargePayloadDataPlane:
+    def test_12mib_payload_forwarded_and_echoed(self):
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=2, capacity_bytes=64 << 20)
+        try:
+            holder, requester = c[0], c[1]
+            holder.instance.register_model(
+                "big-pay", ModelInfo(model_type="example"), load_now=True,
+                sync=True,
+            )
+            assert holder.instance.cache.get_quietly("big-pay") is not None
+            # External gRPC into the NON-holding instance: the request must
+            # forward (instance->instance hop) then hit the runtime hop.
+            ch = grpc.insecure_channel(
+                requester.server.endpoint,
+                options=[
+                    ("grpc.max_receive_message_length", 16 << 20),
+                    ("grpc.max_send_message_length", 16 << 20),
+                ],
+            )
+            out = grpc_defs.raw_method(ch, ECHO_METHOD)(
+                PAYLOAD, metadata=[("mm-model-id", "big-pay")], timeout=60
+            )
+            assert out == PAYLOAD
+            ch.close()
+        finally:
+            c.close()
+
+    def test_oversized_kv_value_rejected_explicitly(self):
+        from modelmesh_tpu.kv.service import RemoteKV, start_kv_server
+
+        server, port, store = start_kv_server()
+        try:
+            remote = RemoteKV(f"127.0.0.1:{port}")
+            with pytest.raises(ValueError, match="exceeds this store's limit"):
+                remote.put("mm/too-big", b"x" * (17 << 20))
+            # A large-but-legal value (over the old 4 MiB default) works.
+            remote.put("mm/big-ok", b"y" * (6 << 20))
+            assert len(remote.get("mm/big-ok").value) == 6 << 20
+            remote.close()
+        finally:
+            server.stop(0)
+            store.close()

@@ -47,7 +47,14 @@ class Record:
 
     @classmethod
     def from_bytes(cls: Type[R], data: bytes, version: int) -> R:
-        obj = cls(**json.loads(data.decode()))  # type: ignore[call-arg]
+        d = json.loads(data.decode())
+        # Forward compatibility across mixed-version rolling updates: a
+        # newer peer may publish fields this version doesn't know; dropping
+        # them beats a TypeError inside every watch callback.
+        import dataclasses as _dc
+
+        known = {f.name for f in _dc.fields(cls)}  # type: ignore[arg-type]
+        obj = cls(**{k: v for k, v in d.items() if k in known})  # type: ignore[call-arg]
         obj.version = version
         return obj
 

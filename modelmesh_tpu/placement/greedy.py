@@ -41,7 +41,7 @@ class GreedyStrategy(PlacementStrategy):
     ) -> Optional[str]:
         candidates: list[tuple[str, InstanceRecord]] = [
             (iid, rec)
-            for iid, rec in view.live()
+            for iid, rec in view.placeable()
             if iid not in req.exclude and iid not in req.model.instance_ids
         ]
         if not candidates:

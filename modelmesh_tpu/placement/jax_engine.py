@@ -96,7 +96,7 @@ def build_problem(
         lru_age[j] = max(0.0, (now - rec.lru_ts) / 1000.0) if rec.lru_ts else 0.0
         busy[j] = rec.req_per_minute
         zone[j] = zone_id[rec.zone]
-        feasible_cols[j] = not rec.shutting_down
+        feasible_cols[j] = not rec.shutting_down and not rec.disabled
     feasible = np.broadcast_to(feasible_cols, (n, m)).copy()
     if constraints is not None:
         # Type-constraint mask: one row pattern per model type.
@@ -271,7 +271,7 @@ class JaxPlacementStrategy(PlacementStrategy):
         if plan is not None and plan.age_ms() <= self.plan_ttl_ms:
             desired = plan.placements.get(req.model_id)
             if desired:
-                live = {iid for iid, rec in view.live()}
+                live = {iid for iid, rec in view.placeable()}
                 for iid in desired:
                     if iid in req.exclude or iid not in live:
                         continue

@@ -41,6 +41,12 @@ class ClusterView:
     def live(self) -> list[tuple[str, InstanceRecord]]:
         return [(i, r) for i, r in self.instances if not r.shutting_down]
 
+    def placeable(self) -> list[tuple[str, InstanceRecord]]:
+        """Candidates for NEW placements: live and not admin-drained.
+        Serve routing keeps using live() — a disabled instance's
+        already-loaded copies continue serving (drain, not eviction)."""
+        return [(i, r) for i, r in self.live() if not r.disabled]
+
 
 class PlacementStrategy(abc.ABC):
     @abc.abstractmethod

@@ -155,6 +155,10 @@ class InstanceRecord(Record):
     loading_in_progress: int = 0
     req_per_minute: int = 0
     shutting_down: bool = False
+    # Admin drain (dynamic config `disable`): excluded from new placements
+    # but NOT migrating and NOT holding peers' readiness (unlike
+    # shutting_down).
+    disabled: bool = False
     endpoint: str = ""           # host:port of the instance's internal RPC
     location: str = ""           # node/host for anti-affinity
     zone: str = ""

@@ -155,18 +155,41 @@ def debug_dump(instance: ModelMeshInstance) -> dict:
 
 
 class PreStopServer:
-    """HTTP preStop hook: GET /prestop blocks until migration completes."""
+    """Lifecycle HTTP endpoints: preStop hook + kubelet probes.
+
+    - GET /prestop — blocks until migration completes (k8s preStop hook).
+    - GET /ready — 200 only when the ReadinessGate passes: not shutting
+      down AND no peer draining (holds a rolling update while migrations
+      are in flight; reference isReady(), ModelMesh.java:1310-1331).
+    - GET /live — 200 while the process serves HTTP at all.
+    """
 
     def __init__(self, instance: ModelMeshInstance, port: int = 8090,
                  max_wait_s: float = 120.0):
+        from modelmesh_tpu.serving.health import ReadinessGate
+
         self.instance = instance
         self.migrated = threading.Event()
+        self.gate = ReadinessGate(instance)
         inst = self.instance
         migrated = self.migrated
+        gate = self.gate
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — stdlib API
-                if self.path.rstrip("/") != "/prestop":
+                path = self.path.rstrip("/")
+                if path == "/live":
+                    self.send_response(200)
+                    self.end_headers()
+                    self.wfile.write(b"live\n")
+                    return
+                if path == "/ready":
+                    ok, reason = gate.is_ready()
+                    self.send_response(200 if ok else 503)
+                    self.end_headers()
+                    self.wfile.write(reason.encode() + b"\n")
+                    return
+                if path != "/prestop":
                     self.send_response(404)
                     self.end_headers()
                     return

@@ -1,0 +1,119 @@
+"""Readiness gating + bootstrap fail-fast probation.
+
+Reference behaviors re-derived (not transcribed):
+
+- Readiness (ModelMesh.java:1310-1331): an instance answers NOT ready while
+  any peer in the fleet advertises shutting-down. A rolling update's
+  readiness probe then holds the rollout — the next pod isn't torn down
+  until migrations off the draining pod finish (its record disappears when
+  its session lease is revoked).
+- Bootstrap probation (ModelMesh.java:1335-1419): during a startup window,
+  repeated early load failures with zero successful loads mean the runtime
+  or image is poisoned; the process aborts non-zero so the rollout FAILS at
+  pod 1 instead of the bad image absorbing the whole fleet model-by-model
+  as each migration lands on it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PROBATION_WINDOW_S = 360.0
+DEFAULT_PROBATION_MAX_FAILURES = 3
+
+
+class ReadinessGate:
+    """Answers the /ready probe from live cluster state."""
+
+    def __init__(self, instance) -> None:
+        self.instance = instance
+
+    def is_ready(self) -> tuple[bool, str]:
+        inst = self.instance
+        if inst.shutting_down:
+            return False, "shutting down"
+        for iid, rec in inst.instances_view.items():
+            if iid != inst.instance_id and rec.shutting_down:
+                return False, f"peer {iid} draining (rolling update in flight)"
+        return True, "ok"
+
+
+def _default_abort(reason: str) -> None:
+    log.critical("bootstrap probation abort: %s", reason)
+    # Raw exit: the process is declared unfit; supervisors (k8s) see a
+    # non-zero exit and halt the rollout.
+    os._exit(3)
+
+
+class BootstrapProbation:
+    """Counts early load outcomes; aborts a poisoned bootstrap.
+
+    Armed for ``window_s`` after construction. Any successful load disarms
+    it (the runtime demonstrably works); ``max_failures`` failures with no
+    success abort via ``abort_fn``. Thread-safe — loads complete on pool
+    threads.
+    """
+
+    def __init__(
+        self,
+        window_s: float = DEFAULT_PROBATION_WINDOW_S,
+        max_failures: int = DEFAULT_PROBATION_MAX_FAILURES,
+        abort_fn: Callable[[str], None] = _default_abort,
+    ) -> None:
+        self.window_s = window_s
+        self.max_failures = max(1, max_failures)
+        self.abort_fn = abort_fn
+        self._started = time.monotonic()
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._disarmed = False
+
+    @classmethod
+    def from_env(cls) -> Optional["BootstrapProbation"]:
+        """MM_PROBATION_S (0 disables) / MM_PROBATION_FAILURES."""
+        try:
+            window = float(os.environ.get("MM_PROBATION_S", DEFAULT_PROBATION_WINDOW_S))
+        except ValueError:
+            window = DEFAULT_PROBATION_WINDOW_S
+        if window <= 0:
+            return None
+        try:
+            max_failures = int(
+                os.environ.get("MM_PROBATION_FAILURES", DEFAULT_PROBATION_MAX_FAILURES)
+            )
+        except ValueError:
+            max_failures = DEFAULT_PROBATION_MAX_FAILURES
+        return cls(window_s=window, max_failures=max_failures)
+
+    def reset_window(self) -> None:
+        """Re-stamp the window start. Called after slow runtime/accelerator
+        initialization so probation guards the load-serving period, not the
+        (potentially minutes-long) TPU claim that precedes it."""
+        with self._lock:
+            self._started = time.monotonic()
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._disarmed = True
+
+    def record_failure(self, model_id: str, message: str) -> None:
+        with self._lock:
+            if self._disarmed:
+                return
+            if time.monotonic() - self._started > self.window_s:
+                self._disarmed = True
+                return
+            self._failures += 1
+            n = self._failures
+        if n >= self.max_failures:
+            self.abort_fn(
+                f"{n} load failures with no success within {self.window_s:.0f}s "
+                f"of startup (last: {model_id}: {message}) — runtime looks "
+                f"poisoned; failing the rollout"
+            )

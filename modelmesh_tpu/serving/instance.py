@@ -162,6 +162,7 @@ class ModelMeshInstance:
         metrics=None,
         constraints=None,
         upgrade_tracker=None,
+        probation=None,
     ):
         """``peer_call(endpoint, model_id, method, payload, headers, ctx)``
         forwards to a peer (gRPC in production, direct-call in tests).
@@ -176,6 +177,11 @@ class ModelMeshInstance:
         self._peer_call = peer_call
         self._runtime_call = runtime_call or self._default_runtime_call
         self.shutting_down = False
+        # Admin drain via dynamic config `disable` (ModelMesh.java:1008-1061):
+        # stop taking NEW loads/placements; keep serving what's loaded.
+        self.disabled = False
+        # Dynamic config `log_each_invocation`.
+        self.log_each_invocation = False
         self.is_leader = False
         if metrics is None:
             from modelmesh_tpu.observability.metrics import NoopMetrics
@@ -186,8 +192,15 @@ class ModelMeshInstance:
         # label requirements, and rolling-update replicaset avoidance.
         self.constraints = constraints
         self.upgrade_tracker = upgrade_tracker
+        # Bootstrap fail-fast (serving/health.py): early load outcomes are
+        # reported while the probation window is armed. The window is
+        # re-stamped after loader.startup() below (it can block for minutes
+        # on a cold accelerator claim).
+        self.probation = probation
 
         params = loader.startup()
+        if probation is not None:
+            probation.reset_window()
         self.params = params
         self.load_timeout_s = (
             self.config.load_timeout_s
@@ -317,6 +330,7 @@ class ModelMeshInstance:
             loading_in_progress=0,
             req_per_minute=self.rate.rpm() if hasattr(self, "rate") else 0,
             shutting_down=self.shutting_down,
+            disabled=self.disabled,
             endpoint=self.config.endpoint,
             location=self.config.location,
             zone=self.config.zone,
@@ -448,6 +462,11 @@ class ModelMeshInstance:
     ) -> InvokeResult:
         ctx = ctx or RoutingContext()
         ctx.visited.add(self.instance_id)
+        if self.log_each_invocation:
+            log.info(
+                "invoke model=%s method=%s bytes=%d hop=%d visited=%s",
+                model_id, method, len(payload), ctx.hop, sorted(ctx.visited),
+            )
 
         if ctx.hop == RoutingContext.HIT_ONLY:
             ce = self.cache.get(model_id)
@@ -693,7 +712,7 @@ class ModelMeshInstance:
     def _local_load_allowed(self, required_units: int) -> bool:
         """Churn guard: when full, don't evict recently-used entries
         (reference :3872-3884)."""
-        if self.shutting_down:
+        if self.shutting_down or self.disabled:
             return False
         free = self.cache.capacity - self.cache.weight
         if free >= required_units:
@@ -786,6 +805,10 @@ class ModelMeshInstance:
                 MX.QUEUE_DELAY, ce.load_started_ms - queued_ms, model_id
             )
             loaded = self.loader.load(model_id, ce.info)
+            # The runtime demonstrably works — disarm bootstrap probation
+            # even if this entry is removed before activation below.
+            if self.probation is not None:
+                self.probation.record_success()
             size_bytes = loaded.size_bytes
             if not size_bytes and ce.try_transition(EntryState.SIZING):
                 size_bytes = self.loader.model_size(model_id, loaded.handle)
@@ -840,6 +863,8 @@ class ModelMeshInstance:
 
     def _load_failed(self, ce: CacheEntry, message: str) -> None:
         log.warning("load of %s failed: %s", ce.model_id, message)
+        if self.probation is not None:
+            self.probation.record_failure(ce.model_id, message)
         self.metrics.inc(MX.LOAD_FAILED_COUNT, model_id=ce.model_id)
         ce.fail(message)
         self.cache.remove_if_value(ce.model_id, ce)

@@ -145,6 +145,8 @@ def main(argv=None) -> None:
 
         strategy = JaxPlacementStrategy()
 
+    from modelmesh_tpu.serving.health import BootstrapProbation
+
     instance = ModelMeshInstance(
         store,
         loader,
@@ -161,6 +163,7 @@ def main(argv=None) -> None:
         metrics=metrics,
         constraints=constraints,
         upgrade_tracker=UpgradeTracker(),
+        probation=BootstrapProbation.from_env(),
     )
     vmodels = VModelManager(instance)
     payload_proc = build_processor(
@@ -178,11 +181,18 @@ def main(argv=None) -> None:
     instance.publish_instance_record(force=True)
     tasks = BackgroundTasks(instance)
     tasks.start()
+    from modelmesh_tpu.serving.dynamic import ServingConfigBinder
+
+    config_binder = ServingConfigBinder(
+        store, instance.config.kv_prefix, instance, tasks.config
+    )
     prestop = (
         PreStopServer(instance, port=max(args.prestop_port, 0))
         if args.prestop_port >= 0
         else None
     )
+    if prestop is not None:
+        log.info("lifecycle http (/ready /live /prestop) on :%d", prestop.port)
     register_static_models(instance, vmodels=vmodels)
     log.info(
         "instance %s serving on %s (kv=%s runtime=%s strategy=%s)",
@@ -204,6 +214,7 @@ def main(argv=None) -> None:
     signal.signal(signal.SIGINT, on_term)
     stop.wait()
     tasks.stop()
+    config_binder.close()
     vmodels.close()
     server.stop()
     if prestop is not None:

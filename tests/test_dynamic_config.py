@@ -1,0 +1,134 @@
+"""DynamicConfig wired into serving (serving/dynamic.py).
+
+Round-1 gap: kv/config.py existed but nothing subscribed. These tests flip
+keys in the KV at runtime and observe behavior change with NO restart —
+scale-up threshold honored by the rate task, per-invocation logging, and
+admin drain via ``disable`` (reference live config, ModelMesh.java:1008-1061).
+"""
+
+import logging
+import time
+
+from modelmesh_tpu.runtime import ModelInfo
+from modelmesh_tpu.runtime.fake import PREDICT_METHOD
+from modelmesh_tpu.serving.dynamic import ServingConfigBinder
+from modelmesh_tpu.serving.tasks import BackgroundTasks, TaskConfig
+
+
+def _wait(pred, timeout=8.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestScaleUpThresholdLive:
+    def test_rate_task_scales_at_new_threshold_without_restart(self):
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=2)
+        binder = None
+        try:
+            inst = c[0].instance
+            tasks = BackgroundTasks(inst, TaskConfig())  # threads not started
+            binder = ServingConfigBinder(
+                c.kv, inst.config.kv_prefix, inst, tasks.config
+            )
+            assert tasks.config.scale_up_rpm == 2000
+            inst.register_model("dyn-m", ModelInfo(model_type="example"))
+            for _ in range(6):
+                inst.invoke_model("dyn-m", PREDICT_METHOD, b"x", [])
+            # At the default 2000 RPM threshold, a handful of requests must
+            # NOT scale up.
+            tasks._rate_tick()
+            mr = inst.registry.get("dyn-m")
+            assert len(mr.all_placements) == 1
+            # Flip the threshold live through the KV.
+            binder.config.set("scaleup_rpm_threshold", "1")
+            assert _wait(lambda: tasks.config.scale_up_rpm == 1)
+            for _ in range(6):
+                inst.invoke_model("dyn-m", PREDICT_METHOD, b"x", [])
+            tasks._rate_tick()
+            assert _wait(
+                lambda: len(inst.registry.get("dyn-m").all_placements) >= 2
+            ), "no second copy at the lowered threshold"
+            # Deleting the key restores the default.
+            c.kv.delete(f"{inst.config.kv_prefix}/config/scaleup_rpm_threshold")
+            assert _wait(lambda: tasks.config.scale_up_rpm == 2000)
+        finally:
+            if binder is not None:
+                binder.close()
+            c.close()
+
+
+class TestLogEachInvocation:
+    def test_flag_applies_live_and_logs(self, caplog):
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=1)
+        binder = None
+        try:
+            inst = c[0].instance
+            tasks = BackgroundTasks(inst, TaskConfig())
+            binder = ServingConfigBinder(
+                c.kv, inst.config.kv_prefix, inst, tasks.config
+            )
+            inst.register_model("log-m", ModelInfo(model_type="example"))
+            assert inst.log_each_invocation is False
+            binder.config.set("log_each_invocation", "true")
+            assert _wait(lambda: inst.log_each_invocation)
+            with caplog.at_level(logging.INFO, "modelmesh_tpu.serving.instance"):
+                inst.invoke_model("log-m", PREDICT_METHOD, b"x", [])
+            assert any("invoke model=log-m" in r.message for r in caplog.records)
+            binder.config.set("log_each_invocation", "false")
+            assert _wait(lambda: not inst.log_each_invocation)
+        finally:
+            if binder is not None:
+                binder.close()
+            c.close()
+
+
+class TestDisableDrain:
+    def test_disabled_instance_refused_for_placement_then_restored(self):
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=2)
+        binders = []
+        try:
+            # Bind BOTH instances (as main.py would).
+            for pod in c.pods:
+                tasks = BackgroundTasks(pod.instance, TaskConfig())
+                binders.append(ServingConfigBinder(
+                    c.kv, pod.instance.config.kv_prefix, pod.instance,
+                    tasks.config,
+                ))
+            target, other = c[0].instance, c[1].instance
+            binders[0].config.set("disable", target.instance_id)
+            assert _wait(lambda: target.disabled)
+            # The advertisement propagates; peers' views exclude it.
+            assert _wait(
+                lambda: any(
+                    rec.disabled
+                    for iid, rec in other.instances_view.items()
+                    if iid == target.instance_id
+                )
+            )
+            # New model invoked via the DISABLED instance: must not load
+            # locally — the copy lands on the other pod.
+            target.register_model("drain-m", ModelInfo(model_type="example"))
+            out = target.invoke_model("drain-m", PREDICT_METHOD, b"x", [])
+            assert out.payload.startswith(b"drain-m:")
+            mr = target.registry.get("drain-m")
+            assert list(mr.instance_ids) == [other.instance_id]
+            # Re-enable: local loads allowed again.
+            binders[0].config.set("disable", "")
+            assert _wait(lambda: not target.disabled)
+            target.register_model("drain-m2", ModelInfo(model_type="example"))
+            target.invoke_model("drain-m2", PREDICT_METHOD, b"x", [])
+            assert target.registry.get("drain-m2").instance_ids
+        finally:
+            for b in binders:
+                b.close()
+            c.close()

@@ -1,0 +1,165 @@
+"""Readiness gate + bootstrap fail-fast probation (serving/health.py).
+
+Round-1 VERDICT missing item 1: without these, a bad rolling update takes
+the whole fleet down — readiness must hold the rollout while a peer drains
+(ModelMesh.java:1310-1331), and a poisoned image must fail its own pod
+during startup probation (ModelMesh.java:1335-1419).
+"""
+
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from modelmesh_tpu.serving.health import BootstrapProbation, ReadinessGate
+
+
+def _wait(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestProbationUnit:
+    def test_aborts_after_n_failures_without_success(self):
+        calls = []
+        p = BootstrapProbation(window_s=60, max_failures=3, abort_fn=calls.append)
+        p.record_failure("m1", "boom")
+        p.record_failure("m2", "boom")
+        assert not calls
+        p.record_failure("m3", "boom")
+        assert len(calls) == 1 and "poisoned" in calls[0]
+
+    def test_success_disarms(self):
+        calls = []
+        p = BootstrapProbation(window_s=60, max_failures=2, abort_fn=calls.append)
+        p.record_failure("m1", "boom")
+        p.record_success()
+        for i in range(5):
+            p.record_failure(f"m{i}", "boom")
+        assert not calls
+
+    def test_window_expiry_disarms(self):
+        calls = []
+        p = BootstrapProbation(window_s=0.01, max_failures=1, abort_fn=calls.append)
+        time.sleep(0.05)
+        p.record_failure("m1", "boom")
+        assert not calls
+
+    def test_from_env_disable(self, monkeypatch):
+        monkeypatch.setenv("MM_PROBATION_S", "0")
+        assert BootstrapProbation.from_env() is None
+        monkeypatch.setenv("MM_PROBATION_S", "120")
+        monkeypatch.setenv("MM_PROBATION_FAILURES", "5")
+        p = BootstrapProbation.from_env()
+        assert p.window_s == 120 and p.max_failures == 5
+
+
+class TestReadinessGateCluster:
+    def test_not_ready_while_peer_drains_then_recovers(self):
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=3)
+        try:
+            gates = [ReadinessGate(p.instance) for p in c.pods]
+            for g in gates:
+                ok, reason = g.is_ready()
+                assert ok, reason
+            # Pod 2 starts draining (what SIGTERM's pre_shutdown publishes
+            # first): peers must flip to not-ready.
+            draining = c[2].instance
+            draining.shutting_down = True
+            draining.publish_instance_record(force=True)
+            assert _wait(lambda: not gates[0].is_ready()[0])
+            assert not gates[1].is_ready()[0]
+            assert "draining" in gates[0].is_ready()[1]
+            # Its own gate reports shutting down, not peer-draining.
+            assert gates[2].is_ready() == (False, "shutting down")
+            # Migration completes and the pod exits: record disappears,
+            # peers become ready again.
+            c[2].stop()
+            assert _wait(lambda: gates[0].is_ready()[0], timeout=15)
+            assert gates[1].is_ready()[0]
+        finally:
+            c.close()
+
+    def test_ready_endpoint_http(self):
+        from modelmesh_tpu.serving.bootstrap import PreStopServer
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=1)
+        try:
+            srv = PreStopServer(c[0].instance, port=0)
+            base = f"http://127.0.0.1:{srv.port}"
+            assert urllib.request.urlopen(f"{base}/live").status == 200
+            r = urllib.request.urlopen(f"{base}/ready")
+            assert r.status == 200 and r.read().strip() == b"ok"
+            c[0].instance.shutting_down = True
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/ready")
+            assert ei.value.code == 503
+            c[0].instance.shutting_down = False
+            srv.close()
+        finally:
+            c.close()
+
+
+class TestProbationProcessExit:
+    def test_poisoned_runtime_exits_nonzero(self):
+        """A real serving process whose early loads all fail must exit
+        non-zero during probation (failing the rollout)."""
+        import grpc
+
+        from modelmesh_tpu.kv.service import start_kv_server
+        from modelmesh_tpu.proto import mesh_api_pb2 as apb
+        from modelmesh_tpu.runtime import grpc_defs
+
+        server, kv_port, store = start_kv_server()
+        proc = None
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "modelmesh_tpu.serving.main",
+                 "--kv", f"mesh://127.0.0.1:{kv_port}",
+                 "--instance-id", "poisoned", "--runtime", "fake",
+                 "--capacity-mb", "64", "--load-timeout-s", "10"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+                env={**os.environ, "MM_LOG_LEVEL": "ERROR",
+                     "MM_PROBATION_S": "300", "MM_PROBATION_FAILURES": "2"},
+            )
+            endpoint = None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if line.startswith("READY "):
+                    endpoint = line.split(" ", 1)[1].strip()
+                    break
+                assert proc.poll() is None, "died before ready"
+            assert endpoint
+            ch = grpc.insecure_channel(endpoint)
+            api = grpc_defs.make_stub(
+                ch, grpc_defs.API_SERVICE, grpc_defs.API_METHODS
+            )
+            for k in range(2):
+                try:
+                    api.RegisterModel(apb.RegisterModelRequest(
+                        model_id=f"fail-load-p{k}",
+                        info=apb.ModelInfo(model_type="example"),
+                        load_now=True, sync=True,
+                    ), timeout=30)
+                except grpc.RpcError:
+                    pass  # the load failure (or the abort) surfaces here
+            ch.close()
+            proc.wait(timeout=30)
+            assert proc.returncode == 3, f"exit={proc.returncode}"
+        finally:
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                proc.wait(timeout=10)
+            server.stop(0)
+            store.close()

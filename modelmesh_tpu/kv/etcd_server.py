@@ -1,0 +1,412 @@
+"""etcd v3 wire-compatible coordination server (single node).
+
+Speaks the real etcd gRPC API (etcdserverpb method paths, mvcc field
+numbers — protos/etcd_rpc.proto) over the InMemoryKV engine, including the
+behaviors a client must survive in production: global revisions, version
+CAS via Txn, leases with TTL expiry, watch streams with start_revision
+replay, and COMPACTION — a watch whose start_revision predates the compact
+floor is canceled with ``compact_revision`` set, exactly the etcd behavior
+that forces clients to re-list (kv/etcd.py's resync path).
+
+Two roles:
+- The test double for EtcdKV: the CI image carries no etcd binary and has
+  zero egress (the reference forks a real etcd per suite,
+  AbstractModelMeshTest.java:83-192 — impossible here), so the full KV
+  matrix runs EtcdKV against this server over real gRPC instead. The wire
+  contract is pinned by the proto's field-number compatibility with the
+  public etcd v3 API.
+- A deployable single-node coordination store for clusters that want the
+  etcd protocol without operating etcd:
+      python -m modelmesh_tpu.kv.etcd_server --port 2379
+
+Limitations vs real etcd (documented, deliberate): no raft/replication, no
+auth, watch filters/fragmentation unimplemented; watch ranges must be
+whole-prefix or exact-key (all this framework's clients use).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from modelmesh_tpu.kv.memory import InMemoryKV
+from modelmesh_tpu.kv.store import EventType, KeyValue
+from modelmesh_tpu.proto import etcd_rpc_pb2 as epb
+from modelmesh_tpu.runtime import grpc_defs
+from modelmesh_tpu.utils.grpcopts import message_size_options
+
+log = logging.getLogger(__name__)
+
+_KV_SERVICE = "etcdserverpb.KV"
+_KV_METHODS = {
+    "Range": (epb.RangeRequest, epb.RangeResponse),
+    "Put": (epb.PutRequest, epb.PutResponse),
+    "DeleteRange": (epb.DeleteRangeRequest, epb.DeleteRangeResponse),
+    "Txn": (epb.TxnRequest, epb.TxnResponse),
+    "Compact": (epb.CompactionRequest, epb.CompactionResponse),
+}
+_LEASE_SERVICE = "etcdserverpb.Lease"
+_LEASE_METHODS = {
+    "LeaseGrant": (epb.LeaseGrantRequest, epb.LeaseGrantResponse),
+    "LeaseRevoke": (epb.LeaseRevokeRequest, epb.LeaseRevokeResponse),
+}
+_WATCH_METHOD = "/etcdserverpb.Watch/Watch"
+_KEEPALIVE_METHOD = "/etcdserverpb.Lease/LeaseKeepAlive"
+
+
+def _to_mvcc(kv: KeyValue) -> epb.MvccKeyValue:
+    return epb.MvccKeyValue(
+        key=kv.key.encode(),
+        value=kv.value,
+        create_revision=kv.create_rev,
+        mod_revision=kv.mod_rev,
+        version=kv.version,
+        lease=kv.lease,
+    )
+
+
+class EtcdLiteServicer:
+    """etcdserverpb.KV + Lease unary methods over InMemoryKV."""
+
+    def __init__(self, store: Optional[InMemoryKV] = None):
+        self.store = store or InMemoryKV()
+
+    def _header(self) -> epb.ResponseHeader:
+        return epb.ResponseHeader(revision=self.store.revision)
+
+    # -- KV -----------------------------------------------------------------
+
+    def _range_kvs(self, req: epb.RangeRequest) -> list[KeyValue]:
+        kvs = self.store.range_interval(
+            req.key.decode(), req.range_end.decode() if req.range_end else ""
+        )
+        if req.limit:
+            kvs = kvs[: req.limit]
+        return kvs
+
+    def Range(self, request, context):
+        kvs = self._range_kvs(request)
+        return epb.RangeResponse(
+            header=self._header(),
+            kvs=[_to_mvcc(kv) for kv in kvs],
+            count=len(kvs),
+        )
+
+    def Put(self, request, context):
+        try:
+            self.store.put(
+                request.key.decode(), request.value, request.lease
+            )
+        except ValueError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        return epb.PutResponse(header=self._header())
+
+    def DeleteRange(self, request, context):
+        keys = [
+            kv.key
+            for kv in self.store.range_interval(
+                request.key.decode(),
+                request.range_end.decode() if request.range_end else "",
+            )
+        ]
+        deleted = sum(1 for k in keys if self.store.delete(k))
+        return epb.DeleteRangeResponse(header=self._header(), deleted=deleted)
+
+    def Txn(self, request, context):
+        # One native txn when the guard set maps to the KVStore Compare
+        # shape (version EQUAL) — that covers every client in this repo;
+        # other targets evaluated under the same store lock.
+        with self.store._lock:
+            ok = all(self._compare(c) for c in request.compare)
+            branch = request.success if ok else request.failure
+            responses = []
+            for op in branch:
+                if op.HasField("request_put"):
+                    self.store._put_locked(
+                        op.request_put.key.decode(),
+                        op.request_put.value,
+                        op.request_put.lease,
+                    )
+                    responses.append(
+                        epb.ResponseOp(
+                            response_put=epb.PutResponse(header=self._header())
+                        )
+                    )
+                elif op.HasField("request_delete_range"):
+                    rng = op.request_delete_range
+                    keys = [
+                        kv.key
+                        for kv in self._range_locked(
+                            rng.key.decode(),
+                            rng.range_end.decode() if rng.range_end else "",
+                        )
+                    ]
+                    deleted = 0
+                    for k in keys:
+                        if self.store._delete_locked(k):
+                            deleted += 1
+                    responses.append(
+                        epb.ResponseOp(
+                            response_delete_range=epb.DeleteRangeResponse(
+                                header=self._header(), deleted=deleted
+                            )
+                        )
+                    )
+                elif op.HasField("request_range"):
+                    kvs = self._range_locked(
+                        op.request_range.key.decode(),
+                        op.request_range.range_end.decode()
+                        if op.request_range.range_end
+                        else "",
+                    )
+                    responses.append(
+                        epb.ResponseOp(
+                            response_range=epb.RangeResponse(
+                                header=self._header(),
+                                kvs=[_to_mvcc(kv) for kv in kvs],
+                                count=len(kvs),
+                            )
+                        )
+                    )
+            return epb.TxnResponse(
+                header=self._header(), succeeded=ok, responses=responses
+            )
+
+    def _range_locked(self, start: str, end: str) -> list[KeyValue]:
+        # Caller holds the store RLock (reentrant), so the public interval
+        # scan is safe to reuse here.
+        return self.store.range_interval(start, end)
+
+    def _compare(self, c: epb.Compare) -> bool:
+        """etcd Compare: each target reads its OWN wire field
+        (version=4, create_revision=5, mod_revision=6, value=7)."""
+        kv = self.store._data.get(c.key.decode())
+        if c.target == epb.Compare.VERSION:
+            actual, expected = (kv.version if kv else 0), c.version
+        elif c.target == epb.Compare.CREATE:
+            actual, expected = (kv.create_rev if kv else 0), c.create_revision
+        elif c.target == epb.Compare.MOD:
+            actual, expected = (kv.mod_rev if kv else 0), c.mod_revision
+        else:  # VALUE — byte compare
+            actual, expected = (kv.value if kv else b""), c.value
+        if c.result == epb.Compare.EQUAL:
+            return actual == expected
+        if c.result == epb.Compare.NOT_EQUAL:
+            return actual != expected
+        if c.result == epb.Compare.GREATER:
+            return actual > expected
+        return actual < expected
+
+    def Compact(self, request, context):
+        self.store.compact(request.revision)
+        return epb.CompactionResponse(header=self._header())
+
+    # -- Lease --------------------------------------------------------------
+
+    def LeaseGrant(self, request, context):
+        ttl = max(1, request.TTL)
+        lease_id = self.store.lease_grant(float(ttl))
+        return epb.LeaseGrantResponse(
+            header=self._header(), ID=lease_id, TTL=ttl
+        )
+
+    def LeaseRevoke(self, request, context):
+        self.store.lease_revoke(request.ID)
+        return epb.LeaseRevokeResponse(header=self._header())
+
+    # -- streams (raw-bytes handlers) ---------------------------------------
+
+    def watch_stream(self, request_iterator, context):
+        """Bidi Watch: one stream, sequential create/cancel requests.
+
+        Replays from start_revision via the store history; a start_revision
+        at or below the compact floor is answered created+canceled with
+        ``compact_revision`` (the etcd ErrCompacted contract)."""
+        out_q: "queue.Queue" = queue.Queue(maxsize=1024)
+        handles: dict[int, object] = {}
+        next_watch_id = [0]
+        closed = threading.Event()
+
+        def reader():
+            try:
+                for req_bytes in request_iterator:
+                    req = epb.WatchRequest.FromString(req_bytes)
+                    if req.HasField("create_request"):
+                        self._watch_create(req.create_request, out_q, handles,
+                                           next_watch_id)
+                    elif req.HasField("cancel_request"):
+                        h = handles.pop(req.cancel_request.watch_id, None)
+                        if h is not None:
+                            h.cancel()
+                        out_q.put(
+                            epb.WatchResponse(
+                                header=self._header(),
+                                watch_id=req.cancel_request.watch_id,
+                                canceled=True,
+                            )
+                        )
+            except Exception:  # noqa: BLE001 — stream torn down
+                pass
+            finally:
+                closed.set()
+                out_q.put(None)
+
+        threading.Thread(target=reader, daemon=True).start()
+        try:
+            while context.is_active():
+                resp = out_q.get()
+                if resp is None:
+                    return
+                yield resp.SerializeToString()
+        finally:
+            closed.set()
+            for h in handles.values():
+                h.cancel()
+
+    def _watch_create(self, create, out_q, handles, next_watch_id) -> None:
+        watch_id = next_watch_id[0]
+        next_watch_id[0] += 1
+        start = create.start_revision
+        floor = self.store.compact_rev
+        if 0 < start <= floor:
+            out_q.put(epb.WatchResponse(
+                header=self._header(), watch_id=watch_id, created=True,
+            ))
+            out_q.put(epb.WatchResponse(
+                header=self._header(), watch_id=watch_id, canceled=True,
+                compact_revision=floor + 1,
+            ))
+            return
+        prefix = create.key.decode()
+
+        def on_events(events):
+            try:
+                out_q.put_nowait(epb.WatchResponse(
+                    header=self._header(), watch_id=watch_id,
+                    events=[
+                        epb.MvccEvent(
+                            type=(
+                                epb.MvccEvent.DELETE
+                                if ev.type is EventType.DELETE
+                                else epb.MvccEvent.PUT
+                            ),
+                            kv=_to_mvcc(ev.kv),
+                        )
+                        for ev in events
+                    ],
+                ))
+            except queue.Full:
+                log.warning("etcd-lite watch backlogged; canceling %d", watch_id)
+                h = handles.pop(watch_id, None)
+                if h is not None:
+                    h.cancel()
+                out_q.put(epb.WatchResponse(
+                    header=self._header(), watch_id=watch_id, canceled=True,
+                ))
+
+        handles[watch_id] = self.store.watch(
+            prefix, on_events,
+            start_rev=(start - 1) if start > 0 else None,
+        )
+        out_q.put(epb.WatchResponse(
+            header=self._header(), watch_id=watch_id, created=True,
+        ))
+
+    def keepalive_stream(self, request_iterator, context):
+        for req_bytes in request_iterator:
+            req = epb.LeaseKeepAliveRequest.FromString(req_bytes)
+            alive = self.store.lease_keepalive(req.ID)
+            ttl = 0
+            if alive:
+                with self.store._lock:
+                    entry = self.store._leases.get(req.ID)
+                    ttl = int(entry[1]) if entry else 0
+            yield epb.LeaseKeepAliveResponse(
+                header=self._header(), ID=req.ID, TTL=ttl
+            ).SerializeToString()
+
+
+class _StreamHandler(grpc.GenericRpcHandler):
+    def __init__(self, servicer: EtcdLiteServicer):
+        self._servicer = servicer
+
+    def service(self, handler_call_details):
+        if handler_call_details.method == _WATCH_METHOD:
+            return grpc.stream_stream_rpc_method_handler(
+                self._servicer.watch_stream,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
+        if handler_call_details.method == _KEEPALIVE_METHOD:
+            return grpc.stream_stream_rpc_method_handler(
+                self._servicer.keepalive_stream,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
+        return None
+
+
+def start_etcd_server(
+    port: int = 0,
+    store: Optional[InMemoryKV] = None,
+    max_workers: int = 16,
+    bind_host: str = "127.0.0.1",
+    tls=None,
+) -> tuple[grpc.Server, int, InMemoryKV]:
+    servicer = EtcdLiteServicer(store)
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=message_size_options(),
+    )
+    grpc_defs.add_servicer(server, servicer, _KV_SERVICE, _KV_METHODS)
+    grpc_defs.add_servicer(server, servicer, _LEASE_SERVICE, _LEASE_METHODS)
+    server.add_generic_rpc_handlers((_StreamHandler(servicer),))
+    addr = f"{bind_host}:{port}"
+    if tls is not None:
+        bound = server.add_secure_port(addr, tls.server_credentials())
+    else:
+        bound = server.add_insecure_port(addr)
+    server.start()
+    return server, bound, servicer.store
+
+
+def main() -> None:
+    import argparse
+    import signal
+    import threading as _threading
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--port", type=int, default=2379)
+    parser.add_argument("--bind-host", default="127.0.0.1")
+    parser.add_argument("--tls-cert", default="")
+    parser.add_argument("--tls-key", default="")
+    parser.add_argument("--tls-ca", default="")
+    parser.add_argument("--tls-client-auth", action="store_true")
+    args = parser.parse_args()
+    logging.basicConfig(level="INFO")
+    tls = None
+    if args.tls_cert:
+        from modelmesh_tpu.serving.tls import TlsConfig
+
+        tls = TlsConfig.from_files(
+            args.tls_cert, args.tls_key, args.tls_ca or None,
+            require_client_auth=args.tls_client_auth,
+        )
+    server, port, _ = start_etcd_server(
+        port=args.port, bind_host=args.bind_host, tls=tls
+    )
+    print(f"READY {args.bind_host}:{port}", flush=True)
+    stop = _threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop(1.0)
+
+
+if __name__ == "__main__":
+    main()

@@ -45,7 +45,7 @@ class _Watcher(WatchHandle):
 
 
 class InMemoryKV(KVStore):
-    def __init__(self, sweep_interval_s: float = 0.1):
+    def __init__(self, sweep_interval_s: float = 0.1, history_cap: int = 8192):
         self._lock = threading.RLock()
         self._data: dict[str, KeyValue] = {}
         self._rev = 0
@@ -55,7 +55,13 @@ class InMemoryKV(KVStore):
         self._watchers: set[_Watcher] = set()
         self._events: "queue.Queue" = queue.Queue()
         self._closed = threading.Event()
-        self._history: list[WatchEvent] = []  # for start_rev replay
+        # Bounded replay history (etcd compaction analog): a long-running
+        # MeshKV process must not grow memory with total write count.
+        # Events at or below _compact_rev are unavailable for replay;
+        # watches starting below the floor get a full-state fallback.
+        self._history: list[WatchEvent] = []
+        self._history_cap = max(16, history_cap)
+        self._compact_rev = 0
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="kv-dispatch", daemon=True
         )
@@ -80,6 +86,44 @@ class InMemoryKV(KVStore):
                 (kv for k, kv in self._data.items() if k.startswith(prefix)),
                 key=lambda kv: kv.key,
             )
+
+    def range_interval(self, start: str, end: str) -> list[KeyValue]:
+        """Keys in [start, end) — etcd Range semantics; end "" = exact key."""
+        with self._lock:
+            if not end:
+                kv = self._data.get(start)
+                return [kv] if kv else []
+            return sorted(
+                (kv for k, kv in self._data.items() if start <= k < end),
+                key=lambda kv: kv.key,
+            )
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._rev
+
+    def snapshot(self, prefix: str) -> tuple[int, list[KeyValue]]:
+        """Atomic (global_revision, range(prefix)) — a watch started at
+        this revision misses nothing after the snapshot."""
+        with self._lock:
+            return self._rev, sorted(
+                (kv for k, kv in self._data.items() if k.startswith(prefix)),
+                key=lambda kv: kv.key,
+            )
+
+    @property
+    def compact_rev(self) -> int:
+        return self._compact_rev
+
+    def compact(self, revision: int) -> None:
+        """Drop replay history at or below ``revision`` (etcd Compact)."""
+        with self._lock:
+            revision = min(revision, self._rev)
+            self._history = [
+                ev for ev in self._history if ev.kv.mod_rev > revision
+            ]
+            self._compact_rev = max(self._compact_rev, revision)
 
     # -- writes -----------------------------------------------------------
 
@@ -162,11 +206,29 @@ class InMemoryKV(KVStore):
         with self._lock:
             replay = []
             if start_rev is not None:
-                replay = [
-                    ev
-                    for ev in self._history
-                    if ev.kv.mod_rev > start_rev and ev.kv.key.startswith(prefix)
-                ]
+                if start_rev < self._compact_rev:
+                    # Requested history was compacted: full-state fallback —
+                    # replay the current prefix contents as PUTs. Deletes in
+                    # the compacted gap cannot be replayed; networked tiers
+                    # detect the floor themselves (compact_rev) and run a
+                    # resync that synthesizes them.
+                    replay = [
+                        WatchEvent(EventType.PUT, kv)
+                        for kv in sorted(
+                            (
+                                kv for k, kv in self._data.items()
+                                if k.startswith(prefix)
+                            ),
+                            key=lambda kv: kv.key,
+                        )
+                    ]
+                else:
+                    replay = [
+                        ev
+                        for ev in self._history
+                        if ev.kv.mod_rev > start_rev
+                        and ev.kv.key.startswith(prefix)
+                    ]
             self._watchers.add(w)
         if replay:
             self._events.put((w, replay))
@@ -175,6 +237,12 @@ class InMemoryKV(KVStore):
     def _emit(self, event: WatchEvent) -> None:
         # Caller holds the lock.
         self._history.append(event)
+        if len(self._history) > self._history_cap:
+            # Trim to half capacity; the floor advances to the newest
+            # trimmed event's revision.
+            drop = len(self._history) - self._history_cap // 2
+            self._compact_rev = self._history[drop - 1].kv.mod_rev
+            del self._history[:drop]
         for w in list(self._watchers):
             if event.kv.key.startswith(w.prefix):
                 self._events.put((w, [event]))

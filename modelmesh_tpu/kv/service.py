@@ -142,9 +142,41 @@ class MeshKVServicer:
                 overflow.set()
 
         start_rev = None if request.start_rev < 0 else request.start_rev
+        resync_batches = None
+        floor = getattr(self.store, "compact_rev", 0)
+        if start_rev is not None and start_rev < floor:
+            # The replay window was compacted: ship the full current state
+            # instead and watch from the snapshot revision (atomic — a
+            # store that can compact MUST provide snapshot(), otherwise a
+            # delete between range() and watch() would be lost silently).
+            rev, kvs = self.store.snapshot(request.prefix)
+            resync_batches = []
+            chunk: list = []
+            chunk_bytes = 0
+            # Chunk under the message cap: a prefix of large values (e.g.
+            # published plans) must not produce one oversized batch that
+            # wedges the watch in a permanent resync loop.
+            budget = max_message_bytes() // 2
+            for kv in kvs:
+                ev = kpb.WatchEvent(type=kpb.WatchEvent.PUT, kv=_to_proto(kv))
+                sz = ev.ByteSize() + 8
+                if chunk and chunk_bytes + sz > budget:
+                    resync_batches.append(kpb.WatchBatch(
+                        resync=True, resync_rev=rev, events=chunk,
+                    ))
+                    chunk, chunk_bytes = [], 0
+                chunk.append(ev)
+                chunk_bytes += sz
+            resync_batches.append(kpb.WatchBatch(
+                resync=True, resync_rev=rev, resync_end=True, events=chunk,
+            ))
+            start_rev = rev
         handle = self.store.watch(request.prefix, on_events, start_rev=start_rev)
         try:
             yield kpb.WatchBatch().SerializeToString()  # created ack
+            if resync_batches is not None:
+                for b in resync_batches:
+                    yield b.SerializeToString()
             while context.is_active() and not overflow.is_set():
                 try:
                     events = q.get(timeout=0.5)
@@ -199,9 +231,11 @@ def start_kv_server(
     store: Optional[KVStore] = None,
     max_workers: int = 16,
     bind_host: str = "127.0.0.1",
+    tls=None,
 ) -> tuple[grpc.Server, int, KVStore]:
-    """The store is UNAUTHENTICATED: default to loopback; pass an explicit
-    bind_host (and front with mTLS/network policy) for multi-host fleets."""
+    """``tls`` (serving.tls.TlsConfig) secures the coordination plane —
+    registry records (incl. model_key credential blobs) cross this wire.
+    Without it, default to loopback and front with network policy."""
     store = store or InMemoryKV()
     servicer = MeshKVServicer(store)
     server = grpc.server(
@@ -210,7 +244,11 @@ def start_kv_server(
     )
     grpc_defs.add_servicer(server, servicer, KV_SERVICE, KV_METHODS)
     server.add_generic_rpc_handlers((_WatchStreamHandler(servicer),))
-    bound = server.add_insecure_port(f"{bind_host}:{port}")
+    addr = f"{bind_host}:{port}"
+    if tls is not None:
+        bound = server.add_secure_port(addr, tls.server_credentials())
+    else:
+        bound = server.add_insecure_port(addr)
     server.start()
     return server, bound, store
 
@@ -229,10 +267,10 @@ class _RemoteWatch(WatchHandle):
 class RemoteKV(KVStore):
     """KVStore over a MeshKV server."""
 
-    def __init__(self, target: str, timeout_s: float = 10.0):
-        self._channel = grpc.insecure_channel(
-            target, options=message_size_options()
-        )
+    def __init__(self, target: str, timeout_s: float = 10.0, tls=None):
+        from modelmesh_tpu.serving.tls import secure_channel
+
+        self._channel = secure_channel(target, tls)
         self._stub = grpc_defs.make_stub(self._channel, KV_SERVICE, KV_METHODS)
         # Transport-bound cap (headroom for the proto envelope), fixed at
         # construction so the hot put path doesn't re-read the environment.
@@ -313,8 +351,14 @@ class RemoteKV(KVStore):
         """
         handle = _RemoteWatch()
         created = threading.Event()
-        # Track delivery progress for lossless resubscription.
+        # Track delivery progress for lossless resubscription, and the live
+        # key set so a server-initiated resync can synthesize deletes for
+        # keys that vanished inside a compacted replay gap.
         state = {"last_rev": -1 if start_rev is None else start_rev}
+        try:
+            state["keys_seen"] = {kv.key for kv in self.range(prefix)}
+        except grpc.RpcError:
+            state["keys_seen"] = set()
 
         def open_stream():
             req = kpb.WatchRequest(prefix=prefix, start_rev=state["last_rev"])
@@ -330,6 +374,9 @@ class RemoteKV(KVStore):
             backoff = 0.1
             while not handle.cancelled.is_set():
                 try:
+                    # A reconnect mid-resync must not leak half a snapshot
+                    # into the next stream's resync.
+                    state["resync_pending"] = []
                     call = open_stream()
                     first = True
                     for batch_bytes in call:
@@ -351,6 +398,44 @@ class RemoteKV(KVStore):
                             )
                             for ev in batch.events
                         ]
+                        if batch.resync:
+                            # Resync state may span several batches (the
+                            # server chunks under the message cap): only
+                            # after resync_end is the full key set known
+                            # and deletes can be synthesized.
+                            pending = state.setdefault("resync_pending", [])
+                            pending.extend(events)
+                            if not batch.resync_end:
+                                continue
+                            state["resync_pending"] = []
+                            events = pending
+                            current = {ev.kv.key for ev in events}
+                            gone = state["keys_seen"] - current
+                            events = [
+                                WatchEvent(
+                                    type=EventType.DELETE,
+                                    kv=KeyValue(
+                                        key=k, value=b"", create_rev=0,
+                                        mod_rev=batch.resync_rev, version=0,
+                                    ),
+                                )
+                                for k in sorted(gone)
+                            ] + events
+                            state["keys_seen"] = current
+                            state["last_rev"] = max(
+                                state["last_rev"], batch.resync_rev
+                            )
+                            if events:
+                                try:
+                                    callback(events)
+                                except Exception:  # noqa: BLE001
+                                    log.exception("watch callback failed")
+                            continue
+                        for ev in events:
+                            if ev.type is EventType.DELETE:
+                                state["keys_seen"].discard(ev.kv.key)
+                            else:
+                                state["keys_seen"].add(ev.kv.key)
                         if events:
                             state["last_rev"] = max(
                                 state["last_rev"],
@@ -448,10 +533,26 @@ class RemoteKV(KVStore):
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=2379)
+    parser.add_argument("--bind-host", default="127.0.0.1")
+    parser.add_argument("--tls-cert", default="")
+    parser.add_argument("--tls-key", default="")
+    parser.add_argument("--tls-ca", default="")
+    parser.add_argument("--tls-client-auth", action="store_true")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
-    server, port, _ = start_kv_server(args.port)
-    log.info("mesh kv server on :%d", port)
+    tls = None
+    if args.tls_cert:
+        from modelmesh_tpu.serving.tls import TlsConfig
+
+        tls = TlsConfig.from_files(
+            args.tls_cert, args.tls_key, args.tls_ca or None,
+            require_client_auth=args.tls_client_auth,
+        )
+    server, port, _ = start_kv_server(
+        args.port, bind_host=args.bind_host, tls=tls
+    )
+    log.info("mesh kv server on %s:%d (tls=%s)", args.bind_host, port,
+             tls is not None)
     server.wait_for_termination()
 
 

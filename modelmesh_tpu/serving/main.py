@@ -26,8 +26,12 @@ import threading
 log = logging.getLogger("modelmesh_tpu.main")
 
 
-def build_store(kv_uri: str):
-    """mesh://host:port | etcd://host:port | memory:// (single process)."""
+def build_store(kv_uri: str, tls=None):
+    """mesh://host:port | etcd://host:port | memory:// (single process).
+
+    ``tls`` secures the coordination plane too — registry records carry
+    model_key credential blobs, so the KV link deserves the same mTLS as
+    the data plane."""
     scheme, _, rest = kv_uri.partition("://")
     if scheme == "memory":
         from modelmesh_tpu.kv.memory import InMemoryKV
@@ -36,11 +40,11 @@ def build_store(kv_uri: str):
     if scheme == "mesh":
         from modelmesh_tpu.kv.service import RemoteKV
 
-        return RemoteKV(rest)
+        return RemoteKV(rest, tls=tls)
     if scheme == "etcd":
         from modelmesh_tpu.kv.etcd import EtcdKV
 
-        return EtcdKV(rest)
+        return EtcdKV(rest, tls=tls)
     raise ValueError(f"unknown kv scheme {scheme!r} (mesh://, etcd://, memory://)")
 
 
@@ -122,7 +126,7 @@ def main(argv=None) -> None:
             require_client_auth=args.tls_client_auth,
         )
 
-    store = build_store(args.kv)
+    store = build_store(args.kv, tls=tls)
     loader = build_loader(args.runtime, args.capacity_mb, tls=tls)
     metrics = (
         PrometheusMetrics(

@@ -1,0 +1,83 @@
+"""TLS on the coordination plane (ADVICE round-1, medium).
+
+Registry records carry model_key credential blobs; the KV link must be
+securable like every other surface. Covers MeshKV (RemoteKV client +
+server) and the etcd wire (EtcdKV + etcd_server) under TLS, including
+watches (the stream path uses the same channel).
+"""
+
+import time
+
+import grpc
+import pytest
+
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.serving.tls import generate_self_signed
+
+
+def _wait(pred, timeout=8.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def tls():
+    return generate_self_signed()
+
+
+class TestMeshKVTls:
+    def test_roundtrip_and_watch_over_tls(self, tls):
+        from modelmesh_tpu.kv.service import RemoteKV, start_kv_server
+
+        backing = InMemoryKV(sweep_interval_s=0.05)
+        server, port, _ = start_kv_server(store=backing, tls=tls)
+        client = RemoteKV(f"127.0.0.1:{port}", tls=tls)
+        try:
+            got = []
+            client.watch("t/", lambda evs: got.extend(evs))
+            kv = client.put("t/x", b"secret")
+            assert kv.version == 1
+            assert client.get("t/x").value == b"secret"
+            assert _wait(lambda: any(e.kv.key == "t/x" for e in got))
+        finally:
+            client.close()
+            server.stop(0)
+            backing.close()
+
+    def test_plaintext_client_rejected_by_tls_server(self, tls):
+        from modelmesh_tpu.kv.service import RemoteKV, start_kv_server
+
+        backing = InMemoryKV(sweep_interval_s=0.05)
+        server, port, _ = start_kv_server(store=backing, tls=tls)
+        client = RemoteKV(f"127.0.0.1:{port}")  # no TLS
+        try:
+            with pytest.raises(grpc.RpcError):
+                client.put("t/clear", b"v")
+        finally:
+            client.close()
+            server.stop(0)
+            backing.close()
+
+
+class TestEtcdTls:
+    def test_roundtrip_and_watch_over_tls(self, tls):
+        from modelmesh_tpu.kv.etcd import EtcdKV
+        from modelmesh_tpu.kv.etcd_server import start_etcd_server
+
+        backing = InMemoryKV(sweep_interval_s=0.05)
+        server, port, _ = start_etcd_server(store=backing, tls=tls)
+        client = EtcdKV(f"127.0.0.1:{port}", tls=tls)
+        try:
+            got = []
+            client.watch("s/", lambda evs: got.extend(evs))
+            client.put("s/x", b"secret")
+            assert client.get("s/x").value == b"secret"
+            assert _wait(lambda: any(e.kv.key == "s/x" for e in got))
+        finally:
+            client.close()
+            server.stop(0)
+            backing.close()

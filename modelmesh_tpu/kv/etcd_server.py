@@ -121,13 +121,22 @@ class EtcdLiteServicer:
         # One native txn when the guard set maps to the KVStore Compare
         # shape (version EQUAL) — that covers every client in this repo;
         # other targets evaluated under the same store lock.
-        with self.store._lock:
+        with self.store.locked():
             ok = all(self._compare(c) for c in request.compare)
             branch = request.success if ok else request.failure
+            # Validate before applying ANY op: a put against a dead lease
+            # must fail the whole txn atomically, not halfway through.
+            for op in branch:
+                if op.HasField("request_put") and op.request_put.lease:
+                    if not self.store.lease_exists(op.request_put.lease):
+                        context.abort(
+                            grpc.StatusCode.FAILED_PRECONDITION,
+                            f"lease {op.request_put.lease} does not exist",
+                        )
             responses = []
             for op in branch:
                 if op.HasField("request_put"):
-                    self.store._put_locked(
+                    self.store.put_locked(
                         op.request_put.key.decode(),
                         op.request_put.value,
                         op.request_put.lease,
@@ -148,7 +157,7 @@ class EtcdLiteServicer:
                     ]
                     deleted = 0
                     for k in keys:
-                        if self.store._delete_locked(k):
+                        if self.store.delete_locked(k):
                             deleted += 1
                     responses.append(
                         epb.ResponseOp(
@@ -184,8 +193,9 @@ class EtcdLiteServicer:
 
     def _compare(self, c: epb.Compare) -> bool:
         """etcd Compare: each target reads its OWN wire field
-        (version=4, create_revision=5, mod_revision=6, value=7)."""
-        kv = self.store._data.get(c.key.decode())
+        (version=4, create_revision=5, mod_revision=6, value=7).
+        Caller holds the store lock."""
+        kv = self.store.get_locked(c.key.decode())
         if c.target == epb.Compare.VERSION:
             actual, expected = (kv.version if kv else 0), c.version
         elif c.target == epb.Compare.CREATE:
@@ -283,8 +293,13 @@ class EtcdLiteServicer:
             ))
             return
         prefix = create.key.decode()
+        exact = not create.range_end  # etcd: empty range_end = single key
 
         def on_events(events):
+            if exact:
+                events = [ev for ev in events if ev.kv.key == prefix]
+                if not events:
+                    return
             try:
                 out_q.put_nowait(epb.WatchResponse(
                     header=self._header(), watch_id=watch_id,
@@ -301,13 +316,30 @@ class EtcdLiteServicer:
                     ],
                 ))
             except queue.Full:
+                # NEVER block here: this runs on the store's single
+                # dispatcher thread — a blocking put on the full queue
+                # would freeze event delivery for every watcher of the
+                # store. Cancel and best-effort notify.
                 log.warning("etcd-lite watch backlogged; canceling %d", watch_id)
                 h = handles.pop(watch_id, None)
                 if h is not None:
                     h.cancel()
-                out_q.put(epb.WatchResponse(
+                cancel_resp = epb.WatchResponse(
                     header=self._header(), watch_id=watch_id, canceled=True,
-                ))
+                )
+                # The cancel notice MUST reach the client or its pump waits
+                # forever on a dead watch. Make room by dropping queued
+                # events (the watch is canceled; the client re-lists anyway)
+                # — never block: this runs on the store's one dispatcher.
+                while True:
+                    try:
+                        out_q.put_nowait(cancel_resp)
+                        break
+                    except queue.Full:
+                        try:
+                            out_q.get_nowait()
+                        except queue.Empty:
+                            continue
 
         handles[watch_id] = self.store.watch(
             prefix, on_events,
@@ -321,11 +353,7 @@ class EtcdLiteServicer:
         for req_bytes in request_iterator:
             req = epb.LeaseKeepAliveRequest.FromString(req_bytes)
             alive = self.store.lease_keepalive(req.ID)
-            ttl = 0
-            if alive:
-                with self.store._lock:
-                    entry = self.store._leases.get(req.ID)
-                    ttl = int(entry[1]) if entry else 0
+            ttl = int(self.store.lease_ttl(req.ID) or 0) if alive else 0
             yield epb.LeaseKeepAliveResponse(
                 header=self._header(), ID=req.ID, TTL=ttl
             ).SerializeToString()

@@ -299,6 +299,33 @@ class InMemoryKV(KVStore):
                     for key in list(entry[2]):
                         self._delete_locked(key)
 
+    # -- engine surface (wire servers layering protocols over this store) --
+
+    def locked(self):
+        """Reentrant store lock as a context manager — for multi-op atomic
+        sections (the etcd-lite Txn). Use with put_locked/delete_locked."""
+        return self._lock
+
+    def put_locked(self, key: str, value: bytes, lease: int = 0) -> KeyValue:
+        """put() variant for callers already holding locked()."""
+        return self._put_locked(key, value, lease)
+
+    def delete_locked(self, key: str) -> bool:
+        return self._delete_locked(key)
+
+    def get_locked(self, key: str) -> Optional[KeyValue]:
+        return self._data.get(key)
+
+    def lease_exists(self, lease_id: int) -> bool:
+        with self._lock:
+            return lease_id in self._leases
+
+    def lease_ttl(self, lease_id: int) -> Optional[float]:
+        """Configured TTL of a live lease, None if it doesn't exist."""
+        with self._lock:
+            entry = self._leases.get(lease_id)
+            return entry[1] if entry else None
+
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> None:

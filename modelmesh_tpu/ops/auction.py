@@ -12,9 +12,11 @@ Rounding strategy (all vectorized, one ``lax.scan``, no per-model loops):
 
 2. **Price repair.** Residual sampling variance (and anything the soft plan
    got wrong) is cleaned up by a few dozen rounds of congestion pricing:
-   instances above capacity raise their price, below-capacity prices decay,
-   with a diminishing step size so the dynamics anneal instead of limit-
-   cycling. Bertsekas-auction flavor, synchronous and batched.
+   instances above capacity raise their price, below-capacity prices decay.
+   Synchronous batched dynamics limit-cycle rather than converge (the
+   cobweb pattern), so the loop tracks the minimum-overflow price vector
+   seen and the final selection uses it — constant step + best-iterate
+   beats annealing here. Bertsekas-auction flavor.
 
 The result is *advisory*: per-instance local guards (churn age, unload buffer
 accounting — serving layer) remain authoritative, exactly as SURVEY.md
@@ -111,12 +113,11 @@ def auction(
     price_scale: float = 1.0,
     tau: float = 1.0,
 ) -> AuctionResult:
-    """Gumbel-top-k sampling + annealed congestion-price repair.
+    """Gumbel-top-k sampling + best-iterate congestion-price repair.
 
     ``price_scale`` converts prices into score units; with Sinkhorn plan
     logits the useful spread is O(1), so the default 1.0 is right — the
-    per-iteration step is ``eta * price_scale * clip(overload)`` with a
-    1/(1 + 3t/T) anneal.
+    per-iteration step is ``eta * price_scale * clip(overload)``.
     """
     num_instances = capacity.shape[0]
     seed = jnp.asarray(seed, jnp.uint32)
@@ -127,20 +128,38 @@ def auction(
     cap = jnp.maximum(capacity.astype(jnp.float32), 1e-6)
     copies = jnp.minimum(copies, MAX_COPIES)
 
-    def body(price, t):
+    # Synchronous price dynamics oscillate (every row reacts to the same
+    # prices at once, so an over-full column can empty and refill — the
+    # cobweb pattern). Rather than hoping the LAST iterate is good, track
+    # the best-overflow price vector seen and select with it at the end.
+    def body(carry, t):
+        price, best_price, best_of = carry
         idx, valid = _select(scores_f32 - price[None, :], copies)
         load = _implied_load(idx, valid, sizes, num_instances)
-        eta_t = eta * price_scale / (1.0 + 3.0 * t / iters)
-        return price_step(load, cap, price, eta_t), None
+        of = jnp.sum(jnp.maximum(load - cap, 0.0))
+        better = of < best_of
+        best_price = jnp.where(better, price, best_price)
+        best_of = jnp.minimum(of, best_of)
+        return (
+            price_step(load, cap, price, eta * price_scale),
+            best_price, best_of,
+        ), None
 
     price0 = jnp.zeros((num_instances,), jnp.float32)
-    price, _ = jax.lax.scan(
-        body, price0, jnp.arange(iters, dtype=jnp.float32)
+    init = (price0, price0, jnp.asarray(jnp.inf, jnp.float32))
+    (price, best_price, best_of), _ = jax.lax.scan(
+        body, init, jnp.arange(iters, dtype=jnp.float32)
     )
-
-    idx, valid = _select(scores_f32 - price[None, :], copies)
+    # Final candidate: whichever of (last, best-seen) overflows less.
+    idx_l, valid_l = _select(scores_f32 - price[None, :], copies)
+    load_l = _implied_load(idx_l, valid_l, sizes, num_instances)
+    of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
+    use_last = of_l <= best_of
+    final_price = jnp.where(use_last, price, best_price)
+    idx, valid = _select(scores_f32 - final_price[None, :], copies)
     load = _implied_load(idx, valid, sizes, num_instances)
     overflow = jnp.sum(jnp.maximum(load - cap, 0.0))
     return AuctionResult(
-        indices=idx, valid=valid, load=load, prices=price, overflow=overflow
+        indices=idx, valid=valid, load=load, prices=final_price,
+        overflow=overflow,
     )

@@ -33,6 +33,14 @@ class CostWeights:
     move: float = 1.0       # migration stickiness: cost of placing where not loaded
     utilization: float = 0.5  # prefer instances with more free capacity
     balance: float = 0.35   # spread high-rate models away from busy instances
+    # Soft penalty for placing a model OFF its type's preferred label set
+    # (TypeConstraintManager.java:242-248 getPreferredInstances) — a
+    # preference term, not a mask: preferred pools win under equal load but
+    # never block placement. Sized BELOW the move term (1.0) so preference
+    # steers NEW placements without migrating already-loaded copies, and
+    # far above the rounding temperature (SolveConfig.tau=0.15) so it
+    # decides ~99% of otherwise-equal draws.
+    preference: float = 0.75
     lru_age: float = 0.25   # prefer instances whose cache is oldest (easy eviction)
     zone_spread: float = 0.15  # prefer spreading copies across zones/versions
     # One-hot width for zone ids. Zone ids MUST be dense in [0, num_zones);
@@ -68,6 +76,7 @@ class PlacementProblem:
     lru_age: jax.Array      # f32[M] age (secs) of oldest cache entry; 0 = empty-ish
     busyness: jax.Array     # f32[M] request-load proxy (RPM over recent window)
     zone: jax.Array         # i32[M] zone id per instance
+    preferred: jax.Array    # bool[N, M] type-preference (all-True = none)
 
     @property
     def num_models(self) -> int:
@@ -99,6 +108,7 @@ def assemble_cost(
       + balance * rate_norm[m] * busy[i]     # hot models -> quiet instances
       - lru_age * age_norm[i]                # old caches are cheap to evict into
       + zone_spread * zone_crowding[m, i]    # spread copies across zones
+      + preference * (1 - preferred[m, i])   # prefer labeled pools
       + INFEASIBLE * (1 - feasible[m, i])
 
     used_frac counts reserved (unmanaged) units plus the mass of currently
@@ -131,6 +141,7 @@ def assemble_cost(
         + per_instance[None, :]
         + w.balance * rate[:, None] * busy[None, :]
         + w.zone_spread * crowding
+        + w.preference * (1.0 - problem.preferred.astype(jnp.float32))
         + INFEASIBLE * (1.0 - problem.feasible.astype(jnp.float32))
     )
     return cost.astype(dtype)
@@ -181,6 +192,11 @@ def random_problem(
         feasible = feasible[jnp.arange(num_models) % 4]
         # Every model keeps at least one feasible instance.
         feasible = feasible.at[:, 0].set(True)
+    # Mixed preference mask (~70% preferred) so parity/quality tests
+    # exercise the preference cost term; all-True would zero it out.
+    pkey = jax.random.fold_in(key, 101)
+    preferred = jax.random.uniform(pkey, (4, num_instances)) < 0.7
+    preferred = preferred[jnp.arange(num_models) % 4]
     return PlacementProblem(
         sizes=sizes,
         copies=copies,
@@ -192,4 +208,5 @@ def random_problem(
         lru_age=lru_age,
         busyness=busyness,
         zone=zone,
+        preferred=preferred,
     )

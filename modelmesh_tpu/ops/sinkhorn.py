@@ -49,24 +49,26 @@ def sinkhorn(
     eps: float = 0.05,
     iters: int = 12,
 ) -> SinkhornResult:
-    """Balanced log-domain Sinkhorn.
+    """Semi-unbalanced log-domain Sinkhorn: rows are equalities (every
+    model's copy-mass must place), columns are CAPS.
 
-    ``row_mass`` (f32[N]) and ``col_mass`` (f32[M]) need not sum to the same
-    total: columns are rescaled internally so the transport is balanced
-    (capacity acts as a *share*, mirroring how the reference packs by
-    free-space proportion rather than absolute bytes).
+    The column update clamps ``g <= 0``: a column whose demand at g=0 is
+    below its capacity keeps g = 0 (no subsidy to fill slack), one whose
+    demand exceeds capacity gets the usual negative potential pushing mass
+    away. Capacity-as-quota (the balanced form) would force every column to
+    absorb its proportional share even when the whole fleet prefers a
+    subset — nullifying cost-pool preferences (the `preferred` label term)
+    whenever there is slack, which is most of the time.
     """
     row_mass = row_mass.astype(jnp.float32)
     col_mass = col_mass.astype(jnp.float32)
-    total = jnp.sum(row_mass)
-    col_mass = col_mass / jnp.maximum(jnp.sum(col_mass), 1e-30) * total
     log_a = jnp.log(jnp.maximum(row_mass, 1e-30))
     log_b = jnp.log(jnp.maximum(col_mass, 1e-30))
 
     def body(carry, _):
         f, g = carry
         f = eps * (log_a - _row_lse(C, g, eps))
-        g = eps * (log_b - _col_lse(C, f, eps))
+        g = jnp.minimum(0.0, eps * (log_b - _col_lse(C, f, eps)))
         return (f, g), None
 
     f0 = jnp.zeros_like(log_a)

@@ -29,7 +29,13 @@ class SolveConfig(NamedTuple):
     sinkhorn_iters: int = 10
     auction_iters: int = 40
     eta: float = 0.5
-    # Gumbel sampling temperature for integral rounding; 0 disables sampling.
+    # Gumbel sampling temperature for integral rounding; 0 disables
+    # sampling. Scores are plan log-probs ((f+g-C)/eps), so tau=1.0 means
+    # Gumbel-top-k samples placements ~ the transport plan itself — the
+    # plan is (near-)capacity-feasible by construction, so sampled
+    # rounding inherits that and prices only mop up residuals. Cost-term
+    # gaps (move=1.0, preference=0.75) are eps-amplified to 20/15 in
+    # log-odds, so stickiness and preference dominate sampling noise.
     tau: float = 1.0
     # Placement-preference weights (static: part of the compiled program).
     weights: costs_mod.CostWeights = costs_mod.CostWeights()

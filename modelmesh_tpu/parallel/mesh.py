@@ -49,6 +49,7 @@ def problem_pspec():
     return PlacementProblem(
         sizes=row, copies=row, rates=row, loaded=mat, feasible=mat,
         capacity=col, reserved=col, lru_age=col, busyness=col, zone=col,
+        preferred=mat,
     )
 
 

@@ -71,6 +71,7 @@ def _cost_block(p: PlacementProblem, w: CostWeights, dtype) -> jax.Array:
         + per_instance[None, :]
         + w.balance * rate[:, None] * busy[None, :]
         + w.zone_spread * crowding
+        + w.preference * (1.0 - p.preferred.astype(jnp.float32))
         + INFEASIBLE * (1.0 - p.feasible.astype(jnp.float32))
     )
     return cost.astype(dtype)
@@ -86,9 +87,8 @@ def _lse(z_blk: jax.Array, axis: int, axis_name: str) -> jax.Array:
 
 
 def _sharded_sinkhorn(C, row_mass, col_mass, eps: float, iters: int):
-    total = jax.lax.psum(jnp.sum(row_mass), MODEL_AXIS)
-    col_total = jax.lax.psum(jnp.sum(col_mass), INSTANCE_AXIS)
-    col_mass = col_mass / jnp.maximum(col_total, 1e-30) * total
+    # Semi-unbalanced (rows equality, columns CAPS via g <= 0) — must match
+    # ops/sinkhorn.py exactly; the parity tests compare potentials.
     log_a = jnp.log(jnp.maximum(row_mass, 1e-30))
     log_b = jnp.log(jnp.maximum(col_mass, 1e-30))
     Cf = C.astype(jnp.float32)
@@ -96,7 +96,9 @@ def _sharded_sinkhorn(C, row_mass, col_mass, eps: float, iters: int):
     def body(carry, _):
         f, g = carry
         f = eps * (log_a - _lse((g[None, :] - Cf) / eps, 1, INSTANCE_AXIS))
-        g = eps * (log_b - _lse((f[:, None] - Cf) / eps, 0, MODEL_AXIS))
+        g = jnp.minimum(
+            0.0, eps * (log_b - _lse((f[:, None] - Cf) / eps, 0, MODEL_AXIS))
+        )
         return (f, g), None
 
     f0 = jnp.zeros_like(log_a)
@@ -105,11 +107,13 @@ def _sharded_sinkhorn(C, row_mass, col_mass, eps: float, iters: int):
 
     row_sum = jnp.exp((f + eps * _lse((g[None, :] - Cf) / eps, 1, INSTANCE_AXIS)) / eps)
     err = jax.lax.psum(jnp.sum(jnp.abs(row_sum - row_mass)), MODEL_AXIS)
+    total = jax.lax.psum(jnp.sum(row_mass), MODEL_AXIS)
     err = err / jnp.maximum(total, 1e-30)
     return f, g, err
 
 
-def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int, eta: float):
+def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
+                     eta: float):
     """scores_full: [n_blk, M] (rows sharded on mdl, full instance width).
 
     Gumbel perturbation is folded in by the caller (per-shard key) so the
@@ -132,18 +136,32 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int, eta: floa
         )
         return jax.lax.psum(local, MODEL_AXIS)
 
-    def body(price, t):
+    # Best-iterate tracking — must mirror ops.auction.auction (synchronous
+    # prices oscillate; keep the min-overflow price vector). `load` is
+    # psum'd over the model axis, so every device tracks identical state.
+    def body(carry, t):
+        price, best_price, best_of = carry
         idx, valid = select(scores_full - price[None, :])
         load = implied_load(idx, valid)
-        eta_t = eta / (1.0 + 3.0 * t / iters)
-        return price_step(load, cap, price, eta_t), None
+        of = jnp.sum(jnp.maximum(load - cap, 0.0))
+        better = of < best_of
+        best_price = jnp.where(better, price, best_price)
+        best_of = jnp.minimum(of, best_of)
+        return (price_step(load, cap, price, eta), best_price, best_of), None
 
     price0 = jnp.zeros((num_instances,), jnp.float32)
-    price, _ = jax.lax.scan(body, price0, jnp.arange(iters, dtype=jnp.float32))
-    idx, valid = select(scores_full - price[None, :])
+    init = (price0, price0, jnp.asarray(jnp.inf, jnp.float32))
+    (price, best_price, best_of), _ = jax.lax.scan(
+        body, init, jnp.arange(iters, dtype=jnp.float32)
+    )
+    idx_l, valid_l = select(scores_full - price[None, :])
+    load_l = implied_load(idx_l, valid_l)
+    of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
+    final_price = jnp.where(of_l <= best_of, price, best_price)
+    idx, valid = select(scores_full - final_price[None, :])
     load = implied_load(idx, valid)
     overflow = jnp.sum(jnp.maximum(load - cap, 0.0))
-    return idx, valid, load, price, overflow
+    return idx, valid, load, final_price, overflow
 
 
 def _solve_kernel(
@@ -177,7 +195,8 @@ def _solve_kernel(
         )
     free_full = jax.lax.all_gather(free, INSTANCE_AXIS, axis=0, tiled=True)
     idx, valid, load, _price, overflow = _sharded_auction(
-        logits_full, p.sizes, copies, free_full, config.auction_iters, config.eta
+        logits_full, p.sizes, copies, free_full, config.auction_iters,
+        config.eta,
     )
     return Placement(
         indices=idx, valid=valid, load=load, overflow=overflow, row_err=row_err

@@ -30,12 +30,28 @@ from modelmesh_tpu.records import InstanceRecord, ModelRecord
 # Shortlist thresholds (tunable analogs of the reference's proximity rules).
 FREE_SPACE_SHORTLIST_RATIO = 0.75   # candidates with >= 75% of best free
 LRU_SHORTLIST_WINDOW_MS = 5 * 60_000
-# A copy loaded less than this ago may still be warming (reference uses
-# per-type load-time stats, TimeStats; a flat floor is the simple analog).
-RECENT_LOAD_PENALTY_MS = 10_000
+# Warming fallback when no TimeStats is attached (per-type mean+3σ is the
+# reference behavior, MM/TimeStats.java used at ModelMesh.java:4351).
+# Single source of truth: timestats' no-evidence default.
+from modelmesh_tpu.serving.timestats import DEFAULT_EXPECT_MS as RECENT_LOAD_PENALTY_MS  # noqa: E501
 
 
 class GreedyStrategy(PlacementStrategy):
+    def __init__(self, time_stats=None, constraints=None):
+        # serving/timestats.TimeStats — attached by the instance so warming
+        # penalties and wait-vs-reroute decisions use per-type load times.
+        self.time_stats = time_stats
+        # serving/constraints.TypeConstraints — `preferred` labels shape the
+        # shortlist (TypeConstraintManager.java:242-248): when any shortlist
+        # member matches the type's preferred labels, only those compete;
+        # otherwise preference is moot and the full shortlist stands.
+        self.constraints = constraints
+
+    def _expect_ms(self, model_type: str) -> float:
+        if self.time_stats is not None:
+            return self.time_stats.expect_ms(model_type)
+        return float(RECENT_LOAD_PENALTY_MS)
+
     def choose_load_target(
         self, req: PlacementRequest, view: ClusterView
     ) -> Optional[str]:
@@ -60,6 +76,15 @@ class GreedyStrategy(PlacementStrategy):
             if rec.free_units >= best_free * FREE_SPACE_SHORTLIST_RATIO
             or (rec.lru_ts or 0) <= oldest_lru + LRU_SHORTLIST_WINDOW_MS
         ] or pool
+        if self.constraints is not None:
+            pref = [
+                (iid, rec) for iid, rec in shortlist
+                if self.constraints.is_preferred(
+                    req.model.model_type, rec.labels
+                )
+            ]
+            if pref:
+                shortlist = pref
         if any(iid == req.requesting_instance for iid, _ in shortlist):
             return LOAD_HERE
         # Least busy; stable tie-break on free space then id.
@@ -71,13 +96,41 @@ class GreedyStrategy(PlacementStrategy):
     ) -> Optional[str]:
         live = {iid: rec for iid, rec in view.live()}
         now = now_ms()
+        expect = self._expect_ms(model.model_type)
         candidates: list[tuple[tuple, str]] = []
         for iid, load_ts in model.instance_ids.items():
             if iid in exclude or iid not in live:
                 continue
-            warming = now - load_ts < RECENT_LOAD_PENALTY_MS
+            # Per-type warming penalty: a slow-loading type stays
+            # deprioritized longer after activation than a fast one.
+            warming = now - load_ts < expect
             candidates.append(((warming, live[iid].req_per_minute, iid), iid))
-        if not candidates:
-            return None
-        candidates.sort()
-        return candidates[0][1]
+        if candidates:
+            candidates.sort()
+            return candidates[0][1]
+        # No READY copy: wait-vs-go-elsewhere on LOADING copies (reference
+        # ModelMesh.java:4351). A copy loading for less than the type's
+        # mean+3σ is healthy — forward to it and ride its load (a second
+        # cold load elsewhere would cost the full load time again). One
+        # loading beyond the bound is probably stuck: return None so the
+        # cache-miss loop places a fresh copy elsewhere. With no per-type
+        # evidence yet, ride unconditionally — the 10s default would call
+        # every healthy slow FIRST load stuck and duplicate copies across
+        # the fleet on cold start; the target's own flat wait bound
+        # still catches genuinely dead loads.
+        no_evidence = (
+            self.time_stats is not None
+            and self.time_stats.samples(model.model_type)
+            < self.time_stats.min_samples
+        )
+        loading = [
+            (elapsed, iid)
+            for iid, claim_ts in model.loading_instances.items()
+            if iid not in exclude and iid in live
+            and ((elapsed := now - claim_ts) <= expect or no_evidence)
+        ]
+        if loading:
+            # Longest-elapsed healthy copy: closest to completion, so the
+            # forwarded request waits the least.
+            return max(loading)[1]
+        return None

@@ -98,18 +98,25 @@ def build_problem(
         zone[j] = zone_id[rec.zone]
         feasible_cols[j] = not rec.shutting_down and not rec.disabled
     feasible = np.broadcast_to(feasible_cols, (n, m)).copy()
+    preferred = np.ones((n, m), bool)
     if constraints is not None:
-        # Type-constraint mask: one row pattern per model type.
-        type_mask: dict[str, np.ndarray] = {}
+        # Type-constraint masks: one row pattern per model type. `required`
+        # is a hard mask (feasible); `preferred` a soft cost term.
+        type_mask: dict[str, tuple[np.ndarray, np.ndarray]] = {}
         for i, (mid, mr) in enumerate(models):
-            mask = type_mask.get(mr.model_type)
-            if mask is None:
-                mask = np.array([
+            masks = type_mask.get(mr.model_type)
+            if masks is None:
+                req = np.array([
                     constraints.is_candidate(mr.model_type, rec.labels)
                     for _, rec in instances
                 ])
-                type_mask[mr.model_type] = mask
-            feasible[i] &= mask
+                pref = np.array([
+                    constraints.is_preferred(mr.model_type, rec.labels)
+                    for _, rec in instances
+                ])
+                masks = type_mask[mr.model_type] = (req, pref)
+            feasible[i] &= masks[0]
+            preferred[i] = masks[1]
 
     problem = PlacementProblem(
         sizes=jnp.asarray(sizes),
@@ -122,6 +129,7 @@ def build_problem(
         lru_age=jnp.asarray(lru_age),
         busyness=jnp.asarray(busy),
         zone=jnp.asarray(zone),
+        preferred=jnp.asarray(preferred),
     )
     return problem, model_ids, instance_ids
 
@@ -185,6 +193,7 @@ def solve_plan(
     instances: Sequence[tuple[str, InstanceRecord]],
     rpm_fn: Optional[Callable[[str], int]] = None,
     seed: int = 0,
+    constraints=None,
 ) -> GlobalPlan:
     """One global solve -> GlobalPlan (blocking; runs on the JAX device)."""
     import jax
@@ -194,7 +203,9 @@ def solve_plan(
     if not models or not instances:
         return GlobalPlan({}, now_ms(), 0.0)
     t0 = time.perf_counter()
-    problem, model_ids, instance_ids = build_problem(models, instances, rpm_fn)
+    problem, model_ids, instance_ids = build_problem(
+        models, instances, rpm_fn, constraints=constraints
+    )
     sol = jax.block_until_ready(solve_placement(problem, seed=seed))
     idx = np.asarray(sol.indices)
     valid = np.asarray(sol.valid)
@@ -225,9 +236,14 @@ class JaxPlacementStrategy(PlacementStrategy):
         # cycle TTL-expired and silently serving greedy.
         plan_ttl_ms: int = 15 * 60_000,
         fallback: Optional[PlacementStrategy] = None,
+        constraints=None,
     ):
         self.plan_ttl_ms = plan_ttl_ms
         self.fallback = fallback or GreedyStrategy()
+        # serving/constraints.TypeConstraints — attached by the instance
+        # (like greedy's) so solves honor required masks and preferred
+        # labels (build_problem feasible/preferred).
+        self.constraints = constraints
         self._plan: Optional[GlobalPlan] = None
         self._seed = 0
         self._refresh_lock = threading.Lock()
@@ -244,7 +260,10 @@ class JaxPlacementStrategy(PlacementStrategy):
     ) -> GlobalPlan:
         with self._refresh_lock:
             self._seed += 1
-            plan = solve_plan(models, instances, rpm_fn, seed=self._seed)
+            plan = solve_plan(
+                models, instances, rpm_fn, seed=self._seed,
+                constraints=self.constraints,
+            )
             plan.generation = self._seed
             self._plan = plan
             log.info(

@@ -52,6 +52,7 @@ log = logging.getLogger(__name__)
 ERROR_HEADER = "mm-error"
 _ERR_NOT_HERE = "model-not-here"
 _ERR_NO_CAPACITY = "no-capacity"
+_ERR_LOAD_FAILED = "load-failed"
 
 _STATUS_MAP = {
     "NOT_FOUND": apb.NOT_FOUND,
@@ -225,6 +226,10 @@ class MeshInternalServicer:
             # fallback surface).
             context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         except ModelLoadException as e:
+            # Typed trailer so the forwarding hop can catch
+            # ModelLoadException and re-route (it forwarded to a LOADING
+            # copy whose load died) instead of failing the request.
+            context.set_trailing_metadata(((ERROR_HEADER, _ERR_LOAD_FAILED),))
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         except ApplierError as e:
             context.abort(grpc.StatusCode.UNKNOWN, str(e))
@@ -529,6 +534,8 @@ def make_grpc_peer_call(channels: Optional[PeerChannels] = None,
                 raise ModelNotHereError(ctx.dest_instance, model_id) from e
             if detail == _ERR_NO_CAPACITY:
                 raise NoCapacityError(e.details() or "") from e
+            if detail == _ERR_LOAD_FAILED:
+                raise ModelLoadException(e.details() or "load failed") from e
             if e.code() == grpc.StatusCode.NOT_FOUND:
                 raise ModelNotFoundError(model_id) from e
             if e.code() in (

@@ -208,6 +208,19 @@ class ModelMeshInstance:
             else params.load_timeout_ms / 1000.0
         )
 
+        from modelmesh_tpu.serving.timestats import TimeStats
+
+        self.time_stats = TimeStats()
+        # Strategies that accept per-type load-time stats (greedy's warming
+        # penalty and wait-vs-reroute bound) get this instance's tracker.
+        for strat in (self.strategy, getattr(self.strategy, "fallback", None)):
+            if strat is None:
+                continue
+            if hasattr(strat, "time_stats"):
+                strat.time_stats = self.time_stats
+            if hasattr(strat, "constraints") and strat.constraints is None:
+                strat.constraints = self.constraints
+
         self.cache: WeightedLRUCache[str, CacheEntry] = WeightedLRUCache(
             params.capacity_units, eviction_listener=self._on_eviction
         )
@@ -531,6 +544,13 @@ class ModelMeshInstance:
                     ctx.exclude_serve.add(target)
                     last_exc = e
                     continue
+                except ModelLoadException as e:
+                    # Serve target was a LOADING copy whose load failed (or
+                    # timed out) — exclude it on both axes and re-route.
+                    ctx.exclude_serve.add(target)
+                    ctx.exclude_load.add(target)
+                    last_exc = e
+                    continue
 
             # 3. cache-miss loop: place a new copy.
             if mr.load_exhausted():
@@ -606,7 +626,7 @@ class ModelMeshInstance:
     ) -> InvokeResult:
         if not sync and ce.state.is_loading:
             return InvokeResult(b"", self.instance_id, "LOADING")
-        if not ce.wait_active(self.load_timeout_s * 1.5):
+        if not self._wait_entry_active(ce):
             raise ModelLoadException(
                 f"{ce.model_id}: timed out waiting for load", timeout=True
             )
@@ -830,9 +850,9 @@ class ModelMeshInstance:
             self._trigger_chained_load(ce)
             self.metrics.inc(MX.LOAD_COUNT, model_id=model_id)
             if ce.load_started_ms:
-                self.metrics.observe(
-                    MX.LOAD_TIME, now_ms() - ce.load_started_ms, model_id
-                )
+                elapsed = now_ms() - ce.load_started_ms
+                self.metrics.observe(MX.LOAD_TIME, elapsed, model_id)
+                self.time_stats.record(ce.info.model_type, elapsed)
             self.publish_instance_record()
         except ModelLoadException as e:
             self._load_failed(ce, str(e))
@@ -852,6 +872,41 @@ class ModelMeshInstance:
             self.registry.update_or_create(model_id, mutate)
         except CasFailed:
             log.warning("promote-loaded CAS gave up for %s", model_id)
+
+    def _wait_entry_active(self, ce: CacheEntry) -> bool:
+        """Wait for an entry to activate, with a per-type bound on the LOAD
+        phase only (reference TimeStats at ModelMesh.java:4351).
+
+        The overall wait is capped by the flat load_timeout*1.5 bound — it
+        covers queueing behind a saturated loading pool, where per-type
+        stats say nothing. Once the runtime load actually starts
+        (load_started_ms set), a healthy load of this type should finish
+        within mean+3σ; allow twice that (floored for cold starts) from
+        the load start before declaring it stuck.
+        """
+        cap_s = self.load_timeout_s * 1.5
+        mtype = ce.info.model_type
+        if self.time_stats.samples(mtype) >= self.time_stats.min_samples:
+            expect_s = self.time_stats.expect_ms(mtype) / 1000.0
+            load_budget_s = min(cap_s, max(5.0, expect_s * 2.0))
+        else:
+            # Cold start: no per-type evidence yet — only the flat bound
+            # applies (a 10s default budget would abort healthy slow first
+            # loads and cascade duplicate copies).
+            load_budget_s = cap_s
+        deadline = _time.monotonic() + cap_s
+        while True:
+            if ce.wait_active(0.25):
+                return True
+            if ce.state.is_terminal:
+                # FAILED raises inside wait_active; REMOVED lands here.
+                return ce.state is EntryState.ACTIVE
+            now = _time.monotonic()
+            if now >= deadline:
+                return False
+            started = ce.load_started_ms
+            if started and (now_ms() - started) / 1000.0 >= load_budget_s:
+                return False
 
     def _wait_space(self, ce: CacheEntry) -> bool:
         # The entry's weight is already inserted in the cache; what we wait

@@ -239,8 +239,20 @@ class BackgroundTasks:
         # (c) scale-down when the cluster is nearly full.
         self._maybe_scale_down()
 
-    def _cluster_fullness(self) -> float:
-        views = self.instance.instances_view.items()
+    def _cluster_fullness(self, model_type: Optional[str] = None) -> float:
+        """Fullness over the candidate subset for ``model_type`` (per-label
+        subset stats, InstanceSetStatsTracker.java:17-40) — global fullness
+        is wrong in heterogeneous clusters: a full GPU-labeled pool must
+        trigger scale-down of GPU models even while CPU pools sit empty,
+        and vice versa."""
+        views = list(self.instance.instances_view.items())
+        constraints = self.instance.constraints
+        if model_type is not None and constraints is not None:
+            subset = [
+                (i, r) for i, r in views
+                if constraints.is_candidate(model_type, r.labels)
+            ]
+            views = subset or views
         cap = sum(r.capacity_units for _, r in views) or 1
         used = sum(r.used_units for _, r in views)
         return used / cap
@@ -248,13 +260,24 @@ class BackgroundTasks:
     def _maybe_scale_down(self) -> None:
         inst = self.instance
         cfg = self.config
-        if self._cluster_fullness() < CLUSTER_FULL_FRACTION:
-            return
+        # Memoize per-type subset fullness for this pass.
+        fullness: dict[Optional[str], float] = {}
+
+        def subset_full(model_type: Optional[str]) -> bool:
+            if inst.constraints is None:
+                model_type = None
+            f = fullness.get(model_type)
+            if f is None:
+                f = fullness[model_type] = self._cluster_fullness(model_type)
+            return f >= CLUSTER_FULL_FRACTION
+
         for model_id in inst.cache.keys():
             mr = inst.registry_view.get(model_id)
             # Count only READY copies: a copy still loading elsewhere must
             # not license dropping the sole active one.
             if mr is None or len(mr.instance_ids) < 2:
+                continue
+            if not subset_full(mr.model_type):
                 continue
             rpm = inst.model_rpm(model_id)
             # Our copy is surplus if OUR traffic is well under the per-copy

@@ -1,0 +1,31 @@
+# modelmesh-tpu serving instance image.
+#
+# The base image must carry the compute stack (jax/jaxlib for the target
+# accelerator, grpcio, numpy, cryptography); this layer adds only the
+# framework — mirroring how the reference ships a thin app layer over a
+# JVM base (reference Dockerfile).
+ARG BASE_IMAGE=python:3.12-slim
+FROM ${BASE_IMAGE} AS build
+
+# Native components (proto splicer) need a C++ toolchain at build time only.
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /opt/modelmesh-tpu
+COPY modelmesh_tpu/ modelmesh_tpu/
+COPY protos/ protos/
+RUN g++ -O2 -shared -fPIC -o modelmesh_tpu/native/libsplicer.so \
+        modelmesh_tpu/native/splicer.cc
+
+FROM ${BASE_IMAGE}
+RUN pip install --no-cache-dir grpcio protobuf \
+    && python -c "import grpc, google.protobuf"
+WORKDIR /opt/modelmesh-tpu
+COPY --from=build /opt/modelmesh-tpu /opt/modelmesh-tpu
+ENV PYTHONPATH=/opt/modelmesh-tpu \
+    MM_LOG_LEVEL=INFO
+# Serving (8033), lifecycle probes /ready /live /prestop (8090),
+# Prometheus metrics (2112).
+EXPOSE 8033 8090 2112
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "modelmesh_tpu.serving.main"]
+CMD ["--port", "8033", "--prestop-port", "8090", "--metrics-port", "2112"]

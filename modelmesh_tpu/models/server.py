@@ -173,6 +173,7 @@ def start_jax_runtime(
     port: int = 0,
     capacity_bytes: int = 256 << 20,
     max_workers: int = 16,
+    uds_path: str = "",
 ) -> tuple[grpc.Server, int, JaxRuntimeServicer]:
     store = JaxModelStore(capacity_bytes)
     servicer = JaxRuntimeServicer(store)
@@ -186,7 +187,12 @@ def start_jax_runtime(
     server.add_generic_rpc_handlers(
         (grpc_defs.RawFallbackHandler(servicer.predict),)
     )
-    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    if uds_path:
+        if server.add_insecure_port(f"unix://{uds_path}") == 0:
+            raise RuntimeError(f"failed to bind unix socket {uds_path}")
+        bound = 0
+    else:
+        bound = server.add_insecure_port(f"127.0.0.1:{port}")
     server.start()
     return server, bound, servicer
 
@@ -227,7 +233,7 @@ class InProcessJaxLoader(ModelLoader[ServableModel]):
 
     def call_model(
         self, model_id: str, full_method: str, payload: bytes,
-        headers=None, timeout_s=None,
+        headers=None, timeout_s=None, cancel_event=None,
     ) -> bytes:
         from modelmesh_tpu.runtime.spi import ModelNotLoadedError
 
@@ -248,10 +254,16 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=8085)
     parser.add_argument("--capacity-mb", type=int, default=256)
+    parser.add_argument(
+        "--uds", default="",
+        help="serve on unix://<path> instead of TCP (in-pod sidecar link)",
+    )
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
-    server, port, _ = start_jax_runtime(args.port, args.capacity_mb << 20)
-    log.info("jax model runtime on :%d", port)
+    server, port, _ = start_jax_runtime(
+        args.port, args.capacity_mb << 20, uds_path=args.uds
+    )
+    log.info("jax model runtime on %s", args.uds or f":{port}")
     server.wait_for_termination()
 
 

@@ -35,6 +35,10 @@ PREDICT_METHOD = "/mmtpu.example.Predictor/Predict"
 FAIL_LOAD_PREFIX = "fail-load-"
 SLOW_LOAD_PREFIX = "slow-load-"
 NOT_FOUND_SERVE_PREFIX = "vanish-"
+# max_concurrency=1 models (latency-mode / cancellation tests).
+GATED_PREFIX = "gated-"
+# predict sleeps (anywhere in the id, composable with gated-).
+SLOW_PREDICT_MARK = "slow-predict"
 
 
 class FakeRuntimeServicer:
@@ -88,7 +92,10 @@ class FakeRuntimeServicer:
         with self._lock:
             self.loaded[mid] = size
             self.load_count += 1
-        return rpb.LoadModelResponse(size_bytes=size)
+        return rpb.LoadModelResponse(
+            size_bytes=size,
+            max_concurrency=1 if mid.startswith(GATED_PREFIX) else 0,
+        )
 
     def UnloadModel(self, request, context):
         with self._lock:
@@ -123,6 +130,8 @@ class FakeRuntimeServicer:
             # The Triton/MLServer quirk: runtime lost the model
             # (reference handling at SidecarModelMesh.java:304-322, 961-988).
             context.abort(grpc.StatusCode.NOT_FOUND, f"model {mid} not loaded")
+        if SLOW_PREDICT_MARK in mid:
+            time.sleep(3.0)
         if method.endswith("/Echo"):
             # Large-payload data-plane probe: response mirrors the request,
             # exercising the send path at the same size as the receive path.
@@ -136,8 +145,12 @@ def start_fake_runtime(
     port: int = 0,
     servicer: Optional[FakeRuntimeServicer] = None,
     max_workers: int = 16,
+    uds_path: Optional[str] = None,
 ) -> tuple[grpc.Server, int, FakeRuntimeServicer]:
-    """Start on localhost; returns (server, bound_port, servicer)."""
+    """Start on localhost (or a unix socket); returns (server, bound_port,
+    servicer). With ``uds_path`` the returned port is 0 and clients dial
+    ``unix://<path>`` — the sidecar-pod transport (SidecarModelMesh.java:991
+    buildLocalChannel)."""
     servicer = servicer or FakeRuntimeServicer()
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
@@ -149,7 +162,12 @@ def start_fake_runtime(
     server.add_generic_rpc_handlers(
         (grpc_defs.RawFallbackHandler(servicer.predict),)
     )
-    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    if uds_path:
+        if server.add_insecure_port(f"unix://{uds_path}") == 0:
+            raise RuntimeError(f"failed to bind unix socket {uds_path}")
+        bound = 0
+    else:
+        bound = server.add_insecure_port(f"127.0.0.1:{port}")
     server.start()
     return server, bound, servicer
 

@@ -132,3 +132,25 @@ class RawFallbackHandler(grpc.GenericRpcHandler):
         return grpc.unary_unary_rpc_method_handler(
             unary, request_deserializer=_identity, response_serializer=_identity
         )
+
+
+def call_cancellable(callable_, request, timeout=None, metadata=None,
+                     cancel_event=None):
+    """Invoke a unary-unary multicallable, aborting early when
+    ``cancel_event`` fires (client disconnect): the in-flight RPC is
+    cancelled so the remote side's context deactivates too, and the local
+    concurrency slot frees immediately instead of riding out the call."""
+    if cancel_event is None:
+        return callable_(request, timeout=timeout, metadata=metadata)
+    import threading
+
+    from modelmesh_tpu.serving.errors import RequestCancelledError
+
+    fut = callable_.future(request, timeout=timeout, metadata=metadata)
+    done = threading.Event()
+    fut.add_done_callback(lambda _f: done.set())
+    while not done.wait(0.05):
+        if cancel_event.is_set():
+            fut.cancel()
+            raise RequestCancelledError("client disconnected")
+    return fut.result()

@@ -212,12 +212,18 @@ class SidecarRuntime(ModelLoader[str]):
         payload: bytes,
         headers: Optional[list[tuple[str, str]]] = None,
         timeout_s: Optional[float] = None,
+        cancel_event=None,
     ) -> bytes:
         """Invoke an arbitrary method on the runtime with the model id header
         (reference ExternalModel.callModel, SidecarModelMesh.java:337-510)."""
         md = [(grpc_defs.MODEL_ID_HEADER, model_id)] + (headers or [])
         call = grpc_defs.raw_method(self._channel, full_method)
-        return call(payload, metadata=md, timeout=timeout_s)
+        if cancel_event is None:
+            return call(payload, metadata=md, timeout=timeout_s)
+        return grpc_defs.call_cancellable(
+            call, payload, timeout=timeout_s, metadata=md,
+            cancel_event=cancel_event,
+        )
 
     def close(self) -> None:
         self._closed.set()

@@ -34,6 +34,7 @@ from modelmesh_tpu.proto import mesh_internal_pb2 as ipb
 from modelmesh_tpu.runtime import grpc_defs
 from modelmesh_tpu.runtime.spi import ModelInfo
 from modelmesh_tpu.serving.errors import (
+    RequestCancelledError,
     ApplierError,
     ModelLoadException,
     ModelNotFoundError,
@@ -198,6 +199,11 @@ class MeshInternalServicer:
 
     def Forward(self, request, context):
         ctx = _ctx_from_proto(request.ctx)
+        # Transitive cancellation: when the previous hop cancels its
+        # Forward RPC (because ITS client disconnected), this context
+        # terminates and the event interrupts local work here too.
+        ctx.cancel_event = threading.Event()
+        context.add_callback(ctx.cancel_event.set)
         headers = list(request.headers.items())
         try:
             result = self.instance.invoke_model(
@@ -233,6 +239,8 @@ class MeshInternalServicer:
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         except ApplierError as e:
             context.abort(grpc.StatusCode.UNKNOWN, str(e))
+        except RequestCancelledError:
+            context.abort(grpc.StatusCode.CANCELLED, "upstream cancelled")
         return ipb.ForwardResponse(
             payload=result.payload,
             served_by=result.served_by,
@@ -322,10 +330,17 @@ class InferenceFallback:
         req_id = f"{self.instance.instance_id}-{next(self._req_seq)}"
         metrics.inc(MX.API_REQUEST_COUNT, model_id=model_id)
         self._observe_payload(req_id, model_id, method, "request", request, "OK")
+        # Client-disconnect propagation (ModelMeshApi.java:709-729): gRPC
+        # fires rpc-termination callbacks on cancel; the event interrupts
+        # slot waits, runtime calls, and peer forwards downstream. (It also
+        # fires on normal completion — harmless, the request is done.)
+        cancel_event = threading.Event()
+        context.add_callback(cancel_event.set)
         t0 = _time.perf_counter()
         try:
             result = self.instance.invoke_model(
-                model_id, method, request, headers
+                model_id, method, request, headers,
+                RoutingContext(cancel_event=cancel_event),
             )
             metrics.observe(
                 MX.API_REQUEST_TIME, (_time.perf_counter() - t0) * 1e3,
@@ -335,6 +350,11 @@ class InferenceFallback:
                 req_id, model_id, method, "response", result.payload, "OK"
             )
             return result.payload
+        except RequestCancelledError:
+            # The client is gone; nothing to send. Abort with CANCELLED so
+            # the server-side bookkeeping closes out cleanly.
+            metrics.inc(MX.API_REQUEST_FAILED, model_id=model_id)
+            context.abort(grpc.StatusCode.CANCELLED, "client cancelled")
         except ModelNotFoundError:
             metrics.inc(MX.API_REQUEST_FAILED, model_id=model_id)
             self._observe_payload(
@@ -524,7 +544,10 @@ def make_grpc_peer_call(channels: Optional[PeerChannels] = None,
             ctx=_ctx_to_proto(ctx),
         )
         try:
-            resp = stub.Forward(req, timeout=timeout_s)
+            resp = grpc_defs.call_cancellable(
+                stub.Forward, req, timeout=timeout_s,
+                cancel_event=ctx.cancel_event,
+            )
         except grpc.RpcError as e:
             detail = ""
             for k, v in (e.trailing_metadata() or ()):

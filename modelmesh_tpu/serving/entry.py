@@ -172,11 +172,29 @@ class CacheEntry:
 
     # -- invocation gating ---------------------------------------------------
 
-    def before_invoke(self, timeout_s: Optional[float] = None) -> bool:
+    def before_invoke(
+        self, timeout_s: Optional[float] = None, cancel_event=None,
+    ) -> bool:
         with self._lock:
             sem = self._sem
-        if sem is not None and not sem.acquire(timeout=timeout_s or 30.0):
-            return False
+        if sem is not None:
+            if cancel_event is None:
+                if not sem.acquire(timeout=timeout_s or 30.0):
+                    return False
+            else:
+                # Interruptible acquire: a cancelled client must stop
+                # queueing for the slot immediately.
+                import time as _t
+
+                deadline = _t.monotonic() + (timeout_s or 30.0)
+                acquired = False
+                while not acquired:
+                    if cancel_event.is_set():
+                        return False
+                    remaining = deadline - _t.monotonic()
+                    if remaining <= 0:
+                        return False
+                    acquired = sem.acquire(timeout=min(0.05, remaining))
         with self._lock:
             self.inflight += 1
             self.total_invocations += 1

@@ -15,6 +15,7 @@ __all__ = [
     "ModelNotHereError",
     "NoCapacityError",
     "ApplierError",
+    "RequestCancelledError",
     "ServiceUnavailableError",
 ]
 
@@ -46,3 +47,8 @@ class ApplierError(Exception):
 
 class ServiceUnavailableError(Exception):
     """Peer instance unreachable."""
+
+
+class RequestCancelledError(Exception):
+    """Client cancelled the request; abort in-flight work and free slots
+    (reference cancellation propagation, ModelMeshApi.java:709-729)."""

@@ -58,6 +58,7 @@ from modelmesh_tpu.serving.errors import (
     ModelNotFoundError,
     ModelNotHereError,
     NoCapacityError,
+    RequestCancelledError,
     ServiceUnavailableError,
 )
 from modelmesh_tpu.observability.metrics import Metric as MX
@@ -80,7 +81,7 @@ class RoutingContext:
     __slots__ = (
         "hop", "exclude_serve", "exclude_load", "visited",
         "dest_instance", "chain_load_count", "known_size_bytes",
-        "last_used_ms",
+        "last_used_ms", "cancel_event",
     )
 
     EXTERNAL = 0
@@ -98,6 +99,7 @@ class RoutingContext:
         chain_load_count: int = 0,
         known_size_bytes: int = 0,
         last_used_ms: int = 0,
+        cancel_event=None,
     ):
         self.hop = hop
         self.exclude_serve = exclude_serve or set()
@@ -107,6 +109,14 @@ class RoutingContext:
         self.chain_load_count = chain_load_count
         self.known_size_bytes = known_size_bytes
         self.last_used_ms = last_used_ms
+        # threading.Event set when the external client disconnects; checked
+        # on routing iterations and inside blocking waits so cancelled
+        # requests stop consuming slots (ModelMeshApi.java:709-729).
+        self.cancel_event = cancel_event
+
+    @property
+    def cancelled(self) -> bool:
+        return self.cancel_event is not None and self.cancel_event.is_set()
 
 
 class InvokeResult:
@@ -166,9 +176,12 @@ class ModelMeshInstance:
     ):
         """``peer_call(endpoint, model_id, method, payload, headers, ctx)``
         forwards to a peer (gRPC in production, direct-call in tests).
-        ``runtime_call(entry, method, payload, headers)`` executes inference
-        against the local runtime (defaults to SidecarRuntime.call_model when
-        the loader is a SidecarRuntime)."""
+        ``runtime_call(entry, method, payload, headers, cancel_event=None)``
+        executes inference against the local runtime (defaults to
+        SidecarRuntime.call_model when the loader is a SidecarRuntime); a
+        callable without the cancel_event parameter is still accepted —
+        cancellation then can't interrupt the call itself, only the waits
+        around it."""
         self.config = config or InstanceConfig()
         self.instance_id = self.config.instance_id
         self.store = store
@@ -176,6 +189,15 @@ class ModelMeshInstance:
         self.strategy = strategy or GreedyStrategy()
         self._peer_call = peer_call
         self._runtime_call = runtime_call or self._default_runtime_call
+        import inspect as _inspect
+
+        try:
+            self._runtime_call_cancellable = (
+                "cancel_event"
+                in _inspect.signature(self._runtime_call).parameters
+            )
+        except (TypeError, ValueError):
+            self._runtime_call_cancellable = False
         self.shutting_down = False
         # Admin drain via dynamic config `disable` (ModelMesh.java:1008-1061):
         # stop taking NEW loads/placements; keep serving what's loaded.
@@ -488,6 +510,7 @@ class ModelMeshInstance:
             return self._invoke_local(
                 ce, method, payload, headers, sync=sync,
                 chain_count=ctx.chain_load_count,
+                cancel_event=ctx.cancel_event,
             )
 
         last_exc: Optional[Exception] = None
@@ -496,6 +519,8 @@ class ModelMeshInstance:
         # (ensureLoaded-with-exclusions, reference ModelMesh.java:3348).
         skip_local = method is None and self.instance_id in ctx.exclude_load
         for _ in range(MAX_ITERATIONS):
+            if ctx.cancelled:
+                raise RequestCancelledError(model_id)
             # 1. local fast path
             ce = None if skip_local else self.cache.get(model_id)
             if ce is not None and ce.state not in (
@@ -505,6 +530,7 @@ class ModelMeshInstance:
                     return self._invoke_local(
                         ce, method, payload, headers, sync=sync,
                         chain_count=ctx.chain_load_count,
+                        cancel_event=ctx.cancel_event,
                     )
                 except ModelNotHereError as e:
                     last_exc = e  # runtime lost it; cleanup already done
@@ -525,6 +551,7 @@ class ModelMeshInstance:
                 return self._invoke_local(
                     ce, method, payload, headers, sync=sync,
                     chain_count=ctx.chain_load_count,
+                    cancel_event=ctx.cancel_event,
                 )
 
             # 2. cache-hit loop: forward to a loaded copy
@@ -590,6 +617,7 @@ class ModelMeshInstance:
                     return self._invoke_local(
                         ce, method, payload, headers, sync=sync,
                         chain_count=ctx.chain_load_count,
+                        cancel_event=ctx.cancel_event,
                     )
                 ctx.exclude_load.add(self.instance_id)
                 last_exc = last_exc or NoCapacityError(self.instance_id)
@@ -622,7 +650,7 @@ class ModelMeshInstance:
     def _invoke_local(
         self, ce: CacheEntry, method: Optional[str], payload: bytes,
         headers: list[tuple[str, str]], sync: bool = True,
-        chain_count: int = 0,
+        chain_count: int = 0, cancel_event=None,
     ) -> InvokeResult:
         if not sync and ce.state.is_loading:
             return InvokeResult(b"", self.instance_id, "LOADING")
@@ -643,11 +671,18 @@ class ModelMeshInstance:
                 ce._chain_fired = True
                 self._spawn_chain(ce.model_id, ce.last_used, chain_count)
             return InvokeResult(b"", self.instance_id, "LOADED")
-        if not ce.before_invoke():
+        if not ce.before_invoke(cancel_event=cancel_event):
+            if cancel_event is not None and cancel_event.is_set():
+                raise RequestCancelledError(ce.model_id)
             raise ModelLoadException(f"{ce.model_id}: concurrency gate timeout")
         try:
             t0 = _time.perf_counter()
-            out = self._runtime_call(ce, method, payload, headers)
+            if self._runtime_call_cancellable:
+                out = self._runtime_call(
+                    ce, method, payload, headers, cancel_event=cancel_event
+                )
+            else:
+                out = self._runtime_call(ce, method, payload, headers)
             ce.record_latency((_time.perf_counter() - t0) * 1e3)
             self.rate.record()
             self._model_rate(ce.model_id).record()
@@ -665,7 +700,7 @@ class ModelMeshInstance:
 
     def _default_runtime_call(
         self, ce: CacheEntry, method: str, payload: bytes,
-        headers: list[tuple[str, str]],
+        headers: list[tuple[str, str]], cancel_event=None,
     ) -> bytes:
         import grpc
 
@@ -678,7 +713,10 @@ class ModelMeshInstance:
                 "loader has no call_model; pass runtime_call to the instance"
             )
         try:
-            return call_model(ce.model_id, method, payload, headers)
+            return call_model(
+                ce.model_id, method, payload, headers,
+                cancel_event=cancel_event,
+            )
         except ModelNotLoadedError as e:
             raise ModelNotHereError(self.instance_id, ce.model_id) from e
         except grpc.RpcError as e:
@@ -1042,6 +1080,7 @@ class ModelMeshInstance:
             chain_load_count=ctx.chain_load_count,
             known_size_bytes=ctx.known_size_bytes,
             last_used_ms=ctx.last_used_ms,
+            cancel_event=ctx.cancel_event,
         )
         self.metrics.inc(MX.INVOKE_FORWARD_COUNT, model_id=model_id)
         return self._peer_call(

@@ -70,7 +70,10 @@ def build_loader(runtime: str, capacity_mb: int, tls=None):
         return SidecarRuntime(
             runtime[len("sidecar:"):], startup_timeout_s=300, tls=tls
         )
-    raise ValueError(f"unknown runtime {runtime!r} (jax | fake | sidecar:addr)")
+    raise ValueError(
+        f"unknown runtime {runtime!r} "
+        "(jax | fake | sidecar:host:port | sidecar:unix:///path.sock)"
+    )
 
 
 def main(argv=None) -> None:

@@ -1,0 +1,147 @@
+"""Request cancellation propagation (VERDICT round-1 item 9).
+
+Reference behavior (ModelMeshApi.java:709-729): a client disconnect
+interrupts the in-flight worker. Here the external RPC's termination
+callback sets a cancel event that interrupts concurrency-slot waits, the
+runtime call, and peer forwards — so a cancelled request frees its
+max_concurrency=1 slot immediately instead of riding out the runtime call.
+"""
+
+import time
+
+import grpc
+import pytest
+
+from modelmesh_tpu.runtime import ModelInfo
+from modelmesh_tpu.runtime.fake import PREDICT_METHOD
+
+
+class TestSlotFreedOnCancel:
+    def test_cancelled_client_frees_concurrency_slot(self):
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=1)
+        try:
+            inst = c[0].instance
+            # gated- => runtime declares max_concurrency=1;
+            # slow-predict => each inference takes ~3 s.
+            mid = "gated-slow-predict-x"
+            inst.register_model(
+                mid, ModelInfo(model_type="example"), load_now=True, sync=True
+            )
+            ce = inst.cache.get_quietly(mid)
+            assert ce is not None and ce.max_concurrency == 1
+            ch = grpc.insecure_channel(c[0].server.endpoint)
+            call = ch.unary_unary(
+                PREDICT_METHOD,
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            md = [("mm-model-id", mid)]
+            # Request 1 takes the slot, then the client disconnects.
+            fut1 = call.future(b"one", metadata=md, timeout=30)
+            deadline = time.monotonic() + 5
+            while ce.inflight == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert ce.inflight == 1, "request 1 never took the slot"
+            fut1.cancel()
+            # Request 2 must acquire the freed slot immediately: it only
+            # waits its own ~3 s inference, not request 1's too.
+            t0 = time.monotonic()
+            out = call(b"two", metadata=md, timeout=30)
+            elapsed = time.monotonic() - t0
+            assert out.startswith(mid.encode())
+            assert elapsed < 4.5, (
+                f"slot not freed on cancel: request 2 took {elapsed:.1f}s "
+                "(waited out request 1's inference)"
+            )
+            ch.close()
+        finally:
+            c.close()
+
+    def test_cancel_while_queued_for_slot(self):
+        """A request cancelled while WAITING for the slot stops queueing:
+        after the holder finishes, the slot goes to the live request, and
+        the cancelled one never executes."""
+        from modelmesh_tpu.runtime.fake import FakeRuntimeServicer
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=1)
+        try:
+            inst = c[0].instance
+            mid = "gated-slow-predict-q"
+            inst.register_model(
+                mid, ModelInfo(model_type="example"), load_now=True, sync=True
+            )
+            ce = inst.cache.get_quietly(mid)
+            ch = grpc.insecure_channel(c[0].server.endpoint)
+            call = ch.unary_unary(
+                PREDICT_METHOD,
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            md = [("mm-model-id", mid)]
+            fut1 = call.future(b"one", metadata=md, timeout=30)
+            deadline = time.monotonic() + 5
+            while ce.inflight == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            # Request 2 queues behind request 1, then cancels while queued.
+            fut2 = call.future(b"two", metadata=md, timeout=30)
+            time.sleep(0.3)
+            fut2.cancel()
+            total_before = ce.total_invocations
+            # Request 1 completes normally.
+            assert fut1.result().startswith(mid.encode())
+            # The cancelled queued request must never execute.
+            time.sleep(0.3)
+            assert ce.total_invocations == total_before
+            assert ce.inflight == 0
+            ch.close()
+        finally:
+            c.close()
+
+
+class TestForwardedCancellation:
+    def test_cancel_propagates_through_peer_forward(self):
+        """Client cancels a request that pod A forwarded to pod B: A cancels
+        the Forward RPC, B's context terminates, and B's max_concurrency=1
+        slot frees for the next request."""
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=2)
+        try:
+            a, b = c[0], c[1]
+            mid = "gated-slow-predict-fwd"
+            # Load on B; the client talks to A (forced forward).
+            b.instance.register_model(
+                mid, ModelInfo(model_type="example"), load_now=True, sync=True
+            )
+            ce = b.instance.cache.get_quietly(mid)
+            assert ce is not None and ce.max_concurrency == 1
+            ch = grpc.insecure_channel(a.server.endpoint)
+            call = ch.unary_unary(
+                PREDICT_METHOD,
+                request_serializer=lambda x: x,
+                response_deserializer=lambda x: x,
+            )
+            md = [("mm-model-id", mid)]
+            fut1 = call.future(b"one", metadata=md, timeout=30)
+            deadline = time.monotonic() + 5
+            while ce.inflight == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert ce.inflight == 1, "forwarded request never took B's slot"
+            fut1.cancel()
+            # B's slot must free promptly (A cancels the Forward RPC; B's
+            # servicer context callback fires; B aborts its runtime call).
+            deadline = time.monotonic() + 3
+            while ce.inflight and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert ce.inflight == 0, "peer slot still held after cancel"
+            # And the model still serves.
+            t0 = time.monotonic()
+            out = call(b"two", metadata=md, timeout=30)
+            assert out.startswith(mid.encode())
+            assert time.monotonic() - t0 < 4.5
+            ch.close()
+        finally:
+            c.close()

@@ -125,3 +125,35 @@ class TestInference:
             sidecar.call_model(mid, PREDICT_METHOD, b"x")
         assert exc.value.code() == grpc.StatusCode.NOT_FOUND
         sidecar.unload(mid)
+
+
+class TestUdsTransport:
+    def test_sidecar_over_unix_socket(self, tmp_path):
+        """Runtime link over a unix domain socket — the in-pod transport
+        (reference buildLocalChannel, SidecarModelMesh.java:991)."""
+        from modelmesh_tpu.runtime import ModelInfo
+        from modelmesh_tpu.runtime.fake import (
+            FakeRuntimeServicer,
+            start_fake_runtime,
+        )
+        from modelmesh_tpu.runtime.sidecar import SidecarRuntime
+
+        sock = str(tmp_path / "runtime.sock")
+        server, _, _ = start_fake_runtime(
+            servicer=FakeRuntimeServicer(capacity_bytes=64 << 20),
+            uds_path=sock,
+        )
+        try:
+            loader = SidecarRuntime(f"unix://{sock}", startup_timeout_s=10)
+            params = loader.startup()
+            assert params.capacity_units > 0
+            loaded = loader.load("uds-m", ModelInfo(model_type="example"))
+            assert loaded.size_bytes > 0
+            out = loader.call_model(
+                "uds-m", "/mmtpu.example.Predictor/Predict", b"x"
+            )
+            assert out.startswith(b"uds-m:")
+            loader.unload("uds-m")
+            loader.close()
+        finally:
+            server.stop(0)

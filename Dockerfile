@@ -4,6 +4,8 @@
 # accelerator, grpcio, numpy, cryptography); this layer adds only the
 # framework — mirroring how the reference ships a thin app layer over a
 # JVM base (reference Dockerfile).
+# Must provide jax + numpy (and jaxlib for the target accelerator); the
+# build fails fast otherwise. python:3.12-slim alone is NOT sufficient.
 ARG BASE_IMAGE=python:3.12-slim
 FROM ${BASE_IMAGE} AS build
 
@@ -18,7 +20,10 @@ RUN g++ -O2 -shared -fPIC -o modelmesh_tpu/native/libsplicer.so \
 
 FROM ${BASE_IMAGE}
 RUN pip install --no-cache-dir grpcio protobuf \
-    && python -c "import grpc, google.protobuf"
+    && python -c "import grpc, google.protobuf" \
+    && python -c "import jax, numpy" \
+    || { echo 'BASE_IMAGE must carry the compute stack (jax, numpy)' >&2; \
+         exit 1; }
 WORKDIR /opt/modelmesh-tpu
 COPY --from=build /opt/modelmesh-tpu /opt/modelmesh-tpu
 ENV PYTHONPATH=/opt/modelmesh-tpu \

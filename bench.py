@@ -7,7 +7,10 @@ device, against the reference's serial Java janitor/reaper rebalance loop
 documents ~10 min reaper passes in production).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline = baseline_ms / measured_ms (higher is better; >1 beats ref).
+vs_baseline = baseline_ms / measured_ms (higher is better; >1 beats ref) —
+reported ONLY when the run is the tier the baseline is defined at
+(100k x 1k, BASELINE.json north_star); any other tier reports null rather
+than an apples-to-oranges ratio.
 
 Env overrides (for the smaller BASELINE.json ladder tiers / CPU smoke):
 MM_BENCH_MODELS, MM_BENCH_INSTANCES, MM_BENCH_REPS, MM_BENCH_FORCE_CPU=1.
@@ -49,11 +52,14 @@ elif not _accelerator_reachable():
     )
     jax.config.update("jax_platforms", "cpu")
 
+from modelmesh_tpu.utils import envs
+
 BASELINE_MS = 30_000.0  # reference serial rebalance loop @ 100k x 1k
-NUM_MODELS = int(os.environ.get("MM_BENCH_MODELS", 100_000))
-NUM_INSTANCES = int(os.environ.get("MM_BENCH_INSTANCES", 1_000))
+BASELINE_TIER = (100_000, 1_000)  # the ONLY tier that number applies to
+NUM_MODELS = envs.get_int("MM_BENCH_MODELS")
+NUM_INSTANCES = envs.get_int("MM_BENCH_INSTANCES")
 WARMUP = 2
-REPS = int(os.environ.get("MM_BENCH_REPS", 100))
+REPS = envs.get_int("MM_BENCH_REPS")
 
 
 def main() -> None:
@@ -83,12 +89,15 @@ def main() -> None:
     import numpy as np
 
     p99 = float(np.percentile(np.asarray(times_ms), 99))
+    at_target_tier = (NUM_MODELS, NUM_INSTANCES) == BASELINE_TIER
     result = {
         "metric": f"global-rebalance p99 latency @ {NUM_MODELS//1000}k models x "
         f"{NUM_INSTANCES} instances ({dev.platform})",
         "value": round(p99, 3),
         "unit": "ms",
-        "vs_baseline": round(BASELINE_MS / p99, 1),
+        # The 30 s reference number is defined at 100k x 1k ONLY; a ratio
+        # against a smaller tier would overstate the win (round-1 verdict).
+        "vs_baseline": round(BASELINE_MS / p99, 1) if at_target_tier else None,
     }
     print(json.dumps(result))
 

@@ -29,7 +29,7 @@ from typing import Iterable, Optional
 
 import grpc
 
-from modelmesh_tpu.utils.grpcopts import env_int, message_size_options
+from modelmesh_tpu.utils.grpcopts import message_size_options
 from modelmesh_tpu.kv.store import (
     Compare,
     EventType,
@@ -107,7 +107,9 @@ class EtcdKV(KVStore):
         # etcd enforces a server-side request quota (--max-request-bytes,
         # 1.5 MiB default); stay conservatively under it so puts fail here
         # with a clear error instead of an opaque etcdserver rejection.
-        self._max_value_bytes = env_int("MM_ETCD_MAX_VALUE_BYTES", 1 << 20)
+        from modelmesh_tpu.utils.envs import get_int
+
+        self._max_value_bytes = get_int("MM_ETCD_MAX_VALUE_BYTES")
 
     # -- reads ------------------------------------------------------------
 

@@ -187,12 +187,7 @@ def start_jax_runtime(
     server.add_generic_rpc_handlers(
         (grpc_defs.RawFallbackHandler(servicer.predict),)
     )
-    if uds_path:
-        if server.add_insecure_port(f"unix://{uds_path}") == 0:
-            raise RuntimeError(f"failed to bind unix socket {uds_path}")
-        bound = 0
-    else:
-        bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    bound = grpc_defs.bind_server(server, port, uds_path=uds_path)
     server.start()
     return server, bound, servicer
 

@@ -37,12 +37,20 @@ class Metric(enum.Enum):
     EVICT_COUNT = ("mm_evict_count", "counter", "cache evictions")
     SCALE_UP_COUNT = ("mm_scale_up_count", "counter", "copy scale-ups requested")
     SCALE_DOWN_COUNT = ("mm_scale_down_count", "counter", "surplus copies dropped")
+    CACHE_MISS_COUNT = ("mm_cache_miss_count", "counter", "requests that required a load")
+    LOAD_TIMEOUT_COUNT = ("mm_load_timeout_count", "counter", "waits that hit the load bound")
+    CANCEL_COUNT = ("mm_cancel_count", "counter", "client-cancelled requests")
+    MULTI_MODEL_COUNT = ("mm_multi_model_count", "counter", "multi-model fan-out calls")
     # histograms (ms)
     API_REQUEST_TIME = ("mm_api_request_time_ms", "histogram", "request latency")
     LOAD_TIME = ("mm_load_time_ms", "histogram", "model load time")
     QUEUE_DELAY = ("mm_queue_delay_ms", "histogram", "load queue delay")
     CACHE_MISS_DELAY = ("mm_cache_miss_delay_ms", "histogram", "wait for load on miss")
     PLACEMENT_SOLVE_TIME = ("mm_placement_solve_time_ms", "histogram", "global plan solve time")
+    SIZING_TIME = ("mm_sizing_time_ms", "histogram", "model sizing duration")
+    EVICT_AGE = ("mm_evict_age_seconds", "histogram", "entry age at eviction")
+    REQUEST_BYTES = ("mm_request_payload_bytes", "histogram", "request payload size")
+    RESPONSE_BYTES = ("mm_response_payload_bytes", "histogram", "response payload size")
     # gauges
     MODELS_LOADED = ("mm_models_loaded", "gauge", "local loaded model count")
     CACHE_USED_UNITS = ("mm_cache_used_units", "gauge", "cache units in use")
@@ -50,6 +58,13 @@ class Metric(enum.Enum):
     PENDING_UNLOAD_UNITS = ("mm_pending_unload_units", "gauge", "units awaiting unload")
     INSTANCE_RPM = ("mm_instance_rpm", "gauge", "instance requests/min")
     LRU_AGE_SECONDS = ("mm_lru_age_seconds", "gauge", "age of oldest cache entry")
+    # Leader-published cluster totals (reaper cadence; reference leader
+    # gauges, Metric.java cluster scope).
+    CLUSTER_INSTANCES = ("mm_cluster_instances", "gauge", "live instances (leader)")
+    CLUSTER_MODELS = ("mm_cluster_models", "gauge", "registered models (leader)")
+    CLUSTER_COPIES = ("mm_cluster_copies", "gauge", "total model copies (leader)")
+    CLUSTER_CAPACITY_UNITS = ("mm_cluster_capacity_units", "gauge", "fleet cache capacity (leader)")
+    CLUSTER_USED_UNITS = ("mm_cluster_used_units", "gauge", "fleet cache usage (leader)")
 
     def __init__(self, metric_name: str, kind: str, help_: str):
         self.metric_name = metric_name

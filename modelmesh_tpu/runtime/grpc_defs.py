@@ -154,3 +154,15 @@ def call_cancellable(callable_, request, timeout=None, metadata=None,
             fut.cancel()
             raise RequestCancelledError("client disconnected")
     return fut.result()
+
+
+def bind_server(server, port: int = 0, bind_host: str = "127.0.0.1",
+                uds_path: str = "") -> int:
+    """Bind a grpc.Server to TCP or a unix socket; returns the bound TCP
+    port (0 for UDS). A failed unix bind raises instead of the silent
+    0-return grpc gives."""
+    if uds_path:
+        if server.add_insecure_port(f"unix://{uds_path}") == 0:
+            raise RuntimeError(f"failed to bind unix socket {uds_path}")
+        return 0
+    return server.add_insecure_port(f"{bind_host}:{port}")

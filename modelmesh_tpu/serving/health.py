@@ -77,19 +77,14 @@ class BootstrapProbation:
     @classmethod
     def from_env(cls) -> Optional["BootstrapProbation"]:
         """MM_PROBATION_S (0 disables) / MM_PROBATION_FAILURES."""
-        try:
-            window = float(os.environ.get("MM_PROBATION_S", DEFAULT_PROBATION_WINDOW_S))
-        except ValueError:
-            window = DEFAULT_PROBATION_WINDOW_S
+        from modelmesh_tpu.utils.envs import get_float, get_int
+
+        window = get_float("MM_PROBATION_S")
         if window <= 0:
             return None
-        try:
-            max_failures = int(
-                os.environ.get("MM_PROBATION_FAILURES", DEFAULT_PROBATION_MAX_FAILURES)
-            )
-        except ValueError:
-            max_failures = DEFAULT_PROBATION_MAX_FAILURES
-        return cls(window_s=window, max_failures=max_failures)
+        return cls(
+            window_s=window, max_failures=get_int("MM_PROBATION_FAILURES")
+        )
 
     def reset_window(self) -> None:
         """Re-stamp the window start. Called after slow runtime/accelerator

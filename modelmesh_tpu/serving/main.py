@@ -101,8 +101,11 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=os.environ.get("MM_LOG_LEVEL", "INFO"),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s %(reqctx)s",
     )
+    from modelmesh_tpu.observability.logctx import install_filter
+
+    install_filter()
 
     from modelmesh_tpu.observability.metrics import NoopMetrics, PrometheusMetrics
     from modelmesh_tpu.observability.payloads import build_processor

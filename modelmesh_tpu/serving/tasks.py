@@ -38,6 +38,12 @@ SECOND_COPY_MAX_AGE_MS = 40 * 60_000
 ASSUME_INSTANCE_GONE_MS = 10 * 60_000   # reaper prune grace (reference :270)
 STALE_LOADING_CLAIM_MS = 20 * 60_000    # loading claim with no progress
 CLUSTER_FULL_FRACTION = 0.95            # scale-down trigger (reference :6197)
+# Surplus-copy lifetime bounds (reference :249-257): never shed a copy
+# younger than the min (anti-thrash with the scale-up window); a low-traffic
+# surplus copy older than the 10 h cap sheds even when the cluster isn't
+# full.
+SURPLUS_COPY_MIN_AGE_MS = 7 * 60_000
+SURPLUS_COPY_MAX_AGE_MS = 10 * 3600_000
 PROACTIVE_RESERVE_FRACTION = 0.125      # keep 12.5% free (reference :6616)
 
 
@@ -271,25 +277,38 @@ class BackgroundTasks:
                 f = fullness[model_type] = self._cluster_fullness(model_type)
             return f >= CLUSTER_FULL_FRACTION
 
+        now = now_ms()
         for model_id in inst.cache.keys():
             mr = inst.registry_view.get(model_id)
             # Count only READY copies: a copy still loading elsewhere must
             # not license dropping the sole active one.
             if mr is None or len(mr.instance_ids) < 2:
                 continue
-            if not subset_full(mr.model_type):
+            our_ts = mr.instance_ids.get(inst.instance_id)
+            if our_ts is None:
                 continue
+            age = now - our_ts
+            if age < SURPLUS_COPY_MIN_AGE_MS:
+                continue  # anti-thrash: too young to shed
             rpm = inst.model_rpm(model_id)
             # Our copy is surplus if OUR traffic is well under the per-copy
             # threshold (reference: < 2/3 of it, :6197-6379) — local rate vs
             # per-copy threshold, symmetric with scale-up.
-            if rpm < cfg.scale_up_rpm * 2 // 3:
-                # Lowest-id holder keeps the copy; others shed it so only
-                # one instance drops per pass.
-                holders = sorted(mr.instance_ids)
-                if holders and holders[-1] == inst.instance_id:
-                    log.info("scale-down: dropping surplus copy of %s", model_id)
-                    inst._remove_local(model_id)
+            if rpm >= cfg.scale_up_rpm * 2 // 3:
+                continue
+            # Fullness gates ordinary scale-down; a surplus copy past the
+            # 10 h cap sheds regardless (reference :257).
+            if not subset_full(mr.model_type) and age < SURPLUS_COPY_MAX_AGE_MS:
+                continue
+            # Shedder: the NEWEST copy's holder (tie-break id) — keeps the
+            # established copy and rotates fairly as newest changes, unlike
+            # highest-id-always-sheds which skews one instance forever.
+            shedder = max(
+                mr.instance_ids.items(), key=lambda kv: (kv[1], kv[0])
+            )[0]
+            if shedder == inst.instance_id:
+                log.info("scale-down: dropping surplus copy of %s", model_id)
+                inst._remove_local(model_id)
 
     # -- reaper (leader only) ---------------------------------------------
 
@@ -298,17 +317,20 @@ class BackgroundTasks:
         if not inst.is_leader:
             self._missing_since.clear()
             return
+        # One registry scan + one view snapshot feed the plan refresh, the
+        # gauges, the prune pass, and proactive loading below — items() is
+        # a full KV range read, unaffordable to repeat per concern at 100k
+        # models.
+        views = list(inst.instances_view.items())
+        records = list(inst.registry.items())
+        live = {iid for iid, _ in views}
         # When the instance runs the JAX global strategy, the reaper is its
         # refresh cadence: solve one global plan from current state; the
         # routing layer serves decisions from it until the next pass.
         refresh = getattr(inst.strategy, "refresh", None)
         if refresh is not None:
             try:
-                plan = refresh(
-                    list(inst.registry.items()),
-                    inst.instances_view.items(),
-                    inst.model_rpm,
-                )
+                plan = refresh(records, views, inst.model_rpm)
                 # Publish so EVERY instance's PlanFollower (instance.py)
                 # serves this solve, not just the leader's own strategy.
                 from modelmesh_tpu.placement.plan_sync import publish_plan
@@ -317,10 +339,24 @@ class BackgroundTasks:
             except Exception:  # noqa: BLE001 — plan is advisory
                 log.exception("global plan refresh/publish failed")
         now = now_ms()
-        live = {iid for iid, _ in inst.instances_view.items()}
+        # Leader-published fleet gauges (reference cluster-scope metrics).
+        from modelmesh_tpu.observability.metrics import Metric as _MX
+
+        inst.metrics.set_gauge(_MX.CLUSTER_INSTANCES, len(views))
+        inst.metrics.set_gauge(_MX.CLUSTER_MODELS, len(records))
+        inst.metrics.set_gauge(
+            _MX.CLUSTER_COPIES,
+            sum(len(mr.instance_ids) for _, mr in records),
+        )
+        inst.metrics.set_gauge(
+            _MX.CLUSTER_CAPACITY_UNITS,
+            sum(r.capacity_units for _, r in views),
+        )
+        inst.metrics.set_gauge(
+            _MX.CLUSTER_USED_UNITS, sum(r.used_units for _, r in views)
+        )
         # Track how long each referenced instance has been missing.
         referenced: set[str] = set()
-        records = list(inst.registry.items())
         for _, mr in records:
             referenced |= mr.all_placements
         for iid in referenced - live:

@@ -1,0 +1,109 @@
+"""Central registry of every MM_* environment knob.
+
+The reference concentrates its ~45 env vars in one class
+(ModelMeshEnvVars.java) so operators have a single authoritative list;
+round 1 left ours scattered across modules. Each entry documents name,
+type, default, and consumer. Typed accessors read through the registry so
+a typo'd name fails loudly at the call site instead of silently defaulting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    name: str
+    kind: str          # str | int | float | json | path | list
+    default: str
+    help: str
+    consumer: str      # module that reads it
+
+
+REGISTRY: dict[str, EnvVar] = {
+    e.name: e
+    for e in [
+        EnvVar("MM_LOG_LEVEL", "str", "INFO",
+               "process log level", "serving/main.py"),
+        EnvVar("MM_ZONE", "str", "",
+               "placement zone advertised in the instance record",
+               "serving/main.py"),
+        EnvVar("MM_LABELS", "list", "",
+               "comma-separated placement labels (type constraints)",
+               "serving/main.py"),
+        EnvVar("MM_STATIC_MODELS", "json", "",
+               "models/vmodels registered at startup",
+               "serving/bootstrap.py"),
+        EnvVar("MM_TYPE_CONSTRAINTS", "path", "",
+               "live-watched type-constraints JSON file",
+               "serving/main.py"),
+        EnvVar("MM_PAYLOAD_PROCESSORS", "list", "",
+               "payload processor URIs", "serving/main.py"),
+        EnvVar("MM_MAX_MSG_BYTES", "int", str(16 << 20),
+               "gRPC message cap on every server/channel",
+               "utils/grpcopts.py"),
+        EnvVar("MM_MAX_PLAN_BYTES", "int", str(12 << 20),
+               "published placement-plan byte budget",
+               "placement/plan_sync.py"),
+        EnvVar("MM_ETCD_MAX_VALUE_BYTES", "int", str(1 << 20),
+               "etcd value budget (server --max-request-bytes quota)",
+               "kv/etcd.py"),
+        EnvVar("MM_PROBATION_S", "float", "360",
+               "bootstrap fail-fast window seconds (0 disables)",
+               "serving/health.py"),
+        EnvVar("MM_PROBATION_FAILURES", "int", "3",
+               "early load failures that abort bootstrap",
+               "serving/health.py"),
+        EnvVar("MM_LOG_REQUEST_HEADERS", "list", "",
+               "headers copied into the per-request log context "
+               "(header or header=field)", "observability/logctx.py"),
+        EnvVar("MM_BENCH_MODELS", "int", "100000",
+               "benchmark tier override (models)", "bench.py"),
+        EnvVar("MM_BENCH_INSTANCES", "int", "1000",
+               "benchmark tier override (instances)", "bench.py"),
+        EnvVar("MM_BENCH_REPS", "int", "100",
+               "benchmark repetitions", "bench.py"),
+        EnvVar("MM_BENCH_FORCE_CPU", "int", "0",
+               "force the benchmark onto CPU", "bench.py"),
+    ]
+}
+
+
+def get(name: str) -> Optional[str]:
+    """Raw read; raises KeyError for unregistered names."""
+    spec = REGISTRY[name]
+    return os.environ.get(name, spec.default or None)
+
+
+def get_int(name: str) -> int:
+    spec = REGISTRY[name]
+    try:
+        return int(os.environ.get(name, spec.default))
+    except ValueError:
+        return int(spec.default)
+
+
+def get_float(name: str) -> float:
+    spec = REGISTRY[name]
+    try:
+        return float(os.environ.get(name, spec.default))
+    except ValueError:
+        return float(spec.default)
+
+
+def get_list(name: str) -> list[str]:
+    raw = get(name) or ""
+    return [s.strip() for s in raw.split(",") if s.strip()]
+
+
+def describe() -> str:
+    """Operator help: one line per knob."""
+    width = max(len(n) for n in REGISTRY)
+    return "\n".join(
+        f"{e.name:<{width}}  [{e.kind}] default={e.default!r}  "
+        f"{e.help} ({e.consumer})"
+        for e in REGISTRY.values()
+    )

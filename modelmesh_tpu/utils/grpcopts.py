@@ -10,20 +10,13 @@ same default under ``MM_MAX_MSG_BYTES``.
 
 from __future__ import annotations
 
-import os
-
 DEFAULT_MAX_MESSAGE_BYTES = 16 << 20
 
 
-def env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
 def max_message_bytes() -> int:
-    return env_int("MM_MAX_MSG_BYTES", DEFAULT_MAX_MESSAGE_BYTES)
+    from modelmesh_tpu.utils.envs import get_int
+
+    return get_int("MM_MAX_MSG_BYTES")
 
 
 def message_size_options() -> list[tuple[str, int]]:

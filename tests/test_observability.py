@@ -161,3 +161,94 @@ class TestMeshMetricsEndToEnd:
             inst.shutdown()
             metrics.close()
             store.close()
+
+
+class TestLogRequestHeaders:
+    def test_headers_bound_into_log_records(self, caplog):
+        import logging
+
+        from modelmesh_tpu.observability.logctx import (
+            HeaderLogContext,
+            LogContextFilter,
+            current,
+        )
+
+        hlc = HeaderLogContext("x-request-id, x-user=user")
+        with hlc.bind([("X-Request-Id", "r-1"), ("x-user", "alice"),
+                       ("other", "ignored")]):
+            assert current() == {"x-request-id": "r-1", "user": "alice"}
+            rec = logging.LogRecord("t", logging.INFO, "f", 1, "msg", (), None)
+            assert LogContextFilter().filter(rec)
+            assert "x-request-id=r-1" in rec.reqctx
+            assert "user=alice" in rec.reqctx
+        assert current() == {}
+
+    def test_empty_config_is_zero_cost(self):
+        from modelmesh_tpu.observability.logctx import HeaderLogContext
+
+        hlc = HeaderLogContext("")
+        with hlc.bind([("x", "y")]):
+            from modelmesh_tpu.observability.logctx import current
+
+            assert current() == {}
+
+    def test_fallback_binds_from_env(self, monkeypatch):
+        """End to end: MM_LOG_REQUEST_HEADERS + a request through the
+        fallback surface lands the header in serving log records."""
+        import logging
+
+        monkeypatch.setenv("MM_LOG_REQUEST_HEADERS", "x-txn-id=txn")
+        from modelmesh_tpu.observability.logctx import LogContextFilter
+        from modelmesh_tpu.runtime import ModelInfo
+        from modelmesh_tpu.runtime.fake import PREDICT_METHOD
+        from tests.cluster_util import Cluster
+
+        import grpc
+
+        c = Cluster(n=1)
+        try:
+            inst = c[0].instance
+            inst.log_each_invocation = True
+            inst.register_model("logctx-m", ModelInfo(model_type="example"))
+            records = []
+
+            class Capture(logging.Handler):
+                def emit(self, rec):
+                    LogContextFilter().filter(rec)
+                    records.append(rec)
+
+            lg = logging.getLogger("modelmesh_tpu.serving.instance")
+            prev_level = lg.level
+            lg.setLevel(logging.INFO)
+            h = Capture(level=logging.INFO)
+            lg.addHandler(h)
+            try:
+                ch = grpc.insecure_channel(c[0].server.endpoint)
+                ch.unary_unary(
+                    PREDICT_METHOD,
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b,
+                )(b"x", metadata=[("mm-model-id", "logctx-m"),
+                                  ("x-txn-id", "t-42")], timeout=20)
+            finally:
+                lg.removeHandler(h)
+                lg.setLevel(prev_level)
+            assert any("txn=t-42" in getattr(r, "reqctx", "")
+                       for r in records), [getattr(r, "reqctx", "") for r in records]
+        finally:
+            c.close()
+
+
+class TestEnvRegistry:
+    def test_registry_reads_and_describe(self, monkeypatch):
+        from modelmesh_tpu.utils import envs
+
+        monkeypatch.setenv("MM_MAX_MSG_BYTES", "1048576")
+        assert envs.get_int("MM_MAX_MSG_BYTES") == 1048576
+        monkeypatch.setenv("MM_MAX_MSG_BYTES", "garbage")
+        assert envs.get_int("MM_MAX_MSG_BYTES") == 16 << 20  # default
+        assert envs.get_list("MM_LABELS") == []
+        with __import__("pytest").raises(KeyError):
+            envs.get("MM_NOT_A_KNOB")
+        text = envs.describe()
+        assert "MM_PROBATION_S" in text and "serving/health.py" in text

@@ -139,9 +139,13 @@ class TestSubsetFullness:
             ("cpu-0", InstanceRecord(capacity_units=1000, used_units=0,
                                      labels=[])),
         ]
+        from modelmesh_tpu.cache.lru import now_ms
+
         mr = ModelRecord(model_type=model_type)
-        mr.promote_loaded("gpu-0", 1000)
-        mr.promote_loaded("gpu-1", 2000)
+        # Recent-but-sheddable ages: past the 7 min anti-thrash floor,
+        # under the 10 h everywhere-cap.
+        mr.promote_loaded("gpu-0", now_ms() - 30 * 60_000)
+        mr.promote_loaded("gpu-1", now_ms() - 20 * 60_000)
         dropped = []
         inst = types.SimpleNamespace(
             instance_id="gpu-1",

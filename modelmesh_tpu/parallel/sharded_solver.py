@@ -27,10 +27,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from modelmesh_tpu.ops.auction import (
+    K_CAND,
     MAX_COPIES,
+    RESHORTLIST_EVERY,
     _NEG_INF,
     _select,
     price_step,
+    select_from_candidates,
+    shortlist,
 )
 from modelmesh_tpu.ops.costs import INFEASIBLE, CostWeights, PlacementProblem
 from modelmesh_tpu.ops.solve import Placement, SolveConfig
@@ -124,8 +128,7 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
     cap = jnp.maximum(cap_full, 1e-6)
     copies = jnp.minimum(copies, MAX_COPIES)
 
-    def select(s):
-        return _select(s, copies)
+    kc = min(K_CAND, num_instances)
 
     def implied_load(idx, valid):
         contrib = sizes[:, None] * valid.astype(jnp.float32)
@@ -136,32 +139,55 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
         )
         return jax.lax.psum(local, MODEL_AXIS)
 
-    # Best-iterate tracking — must mirror ops.auction.auction (synchronous
-    # prices oscillate; keep the min-overflow price vector). `load` is
-    # psum'd over the model axis, so every device tracks identical state.
-    def body(carry, t):
-        price, best_price, best_of = carry
-        idx, valid = select(scores_full - price[None, :])
-        load = implied_load(idx, valid)
-        of = jnp.sum(jnp.maximum(load - cap, 0.0))
-        better = of < best_of
-        best_price = jnp.where(better, price, best_price)
-        best_of = jnp.minimum(of, best_of)
-        return (price_step(load, cap, price, eta), best_price, best_of), None
+    # Best-ASSIGNMENT tracking + round-based re-shortlisting — must mirror
+    # ops.auction.auction (shared helpers; `load`/overflow are psum'd over
+    # the model axis so every device tracks identical best/price state and
+    # takes the same where() branches).
+    n_blk = scores_full.shape[0]
+
+    def narrow_round(carry, length):
+        price, best_idx, best_valid, best_of = carry
+        cand_vals, cand_idx = shortlist(scores_full, price, kc)
+
+        def body(carry, _):
+            price, bi, bv, bo = carry
+            idx, valid = select_from_candidates(
+                cand_vals, cand_idx, copies, price
+            )
+            load = implied_load(idx, valid)
+            of = jnp.sum(jnp.maximum(load - cap, 0.0))
+            better = of < bo
+            bi = jnp.where(better, idx, bi)
+            bv = jnp.where(better, valid, bv)
+            bo = jnp.minimum(of, bo)
+            return (price_step(load, cap, price, eta), bi, bv, bo), None
+
+        carry, _ = jax.lax.scan(
+            body, (price, best_idx, best_valid, best_of), None, length=length
+        )
+        return carry
 
     price0 = jnp.zeros((num_instances,), jnp.float32)
-    init = (price0, price0, jnp.asarray(jnp.inf, jnp.float32))
-    (price, best_price, best_of), _ = jax.lax.scan(
-        body, init, jnp.arange(iters, dtype=jnp.float32)
+    carry = (
+        price0,
+        jnp.zeros((n_blk, MAX_COPIES), jnp.int32),
+        jnp.zeros((n_blk, MAX_COPIES), bool),
+        jnp.asarray(jnp.inf, jnp.float32),
     )
-    idx_l, valid_l = select(scores_full - price[None, :])
+    for length in [RESHORTLIST_EVERY] * (iters // RESHORTLIST_EVERY) + (
+        [iters % RESHORTLIST_EVERY] if iters % RESHORTLIST_EVERY else []
+    ):
+        carry = narrow_round(carry, length)
+    price, best_idx, best_valid, best_of = carry
+    idx_l, valid_l = _select(scores_full - price[None, :], copies)
     load_l = implied_load(idx_l, valid_l)
     of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
-    final_price = jnp.where(of_l <= best_of, price, best_price)
-    idx, valid = select(scores_full - final_price[None, :])
+    use_last = of_l <= best_of
+    idx = jnp.where(use_last, idx_l, best_idx)
+    valid = jnp.where(use_last, valid_l, best_valid)
     load = implied_load(idx, valid)
     overflow = jnp.sum(jnp.maximum(load - cap, 0.0))
-    return idx, valid, load, final_price, overflow
+    return idx, valid, load, price, overflow
 
 
 def _solve_kernel(

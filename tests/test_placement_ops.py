@@ -160,3 +160,43 @@ class TestSolveEndToEnd:
         b = ops.solve_placement(p, seed=2)
         assert ops.solve_placement._cache_size() == n0
         assert not np.array_equal(np.asarray(a.indices), np.asarray(b.indices))
+
+
+class TestCandidateShortlist:
+    def test_spill_reaches_beyond_shortlist_under_herding(self):
+        """num_instances > K_CAND with herded demand: every model's raw
+        top-32 is the same overloaded pool, and feasible spill capacity
+        lives only at ranks > K_CAND. Re-shortlisting at current prices
+        must route the spill there (a static shortlist would converge to a
+        permanently overflowing assignment)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from modelmesh_tpu.ops.auction import K_CAND, auction
+
+        n, m = 512, 64
+        assert m > K_CAND
+        # Scores: every row prefers columns [0, K_CAND) strongly (herding);
+        # columns beyond rank K_CAND are mildly scored but feasible.
+        base = jnp.where(
+            jnp.arange(m)[None, :] < K_CAND, 2.0, 0.0
+        ) + jax.random.uniform(jax.random.PRNGKey(0), (n, m)) * 0.1
+        sizes = jnp.ones((n,), jnp.float32)
+        copies = jnp.ones((n,), jnp.int32)
+        # Preferred pool holds only a quarter of the demand; the rest MUST
+        # spill past rank K_CAND.
+        cap = jnp.where(jnp.arange(m) < K_CAND, n / (4 * K_CAND), n / 16.0)
+        feasible = jnp.ones((n, m), bool)
+        sol = auction(
+            base, sizes, copies, cap, feasible, seed=3, tau=0.0, iters=40
+        )
+        overflow = float(sol.overflow)
+        total = float(jnp.sum(sizes))
+        assert overflow <= 0.02 * total, (
+            f"herded overflow {overflow} of {total} — spill never escaped "
+            "the static shortlist"
+        )
+        # And spill actually landed beyond the preferred pool.
+        idx = np.asarray(sol.indices)[np.asarray(sol.valid)]
+        assert (idx >= K_CAND).sum() > 0

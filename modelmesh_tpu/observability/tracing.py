@@ -1,0 +1,123 @@
+"""Distributed request tracing — the "real tracing" the reference lacks.
+
+The reference makes do with thread renaming, MDC headers, and stage metrics
+(SURVEY §5.1, explicitly flagged "give the new framework real tracing").
+Here every external request gets a trace: a trace id minted at the API
+surface (or adopted from an incoming ``mm-trace-id`` header), propagated to
+peers through the normal forward headers, with named spans recorded around
+each stage (route, load-wait, runtime call, peer forward). No external
+collector dependency (the image carries none): finished traces land in a
+bounded in-memory ring, retrievable through the ``***TRACES***`` diagnostic
+id on GetModelStatus — the same secret-id channel as the state dump — and
+the trace id rides the per-request log context (observability/logctx).
+
+Mechanics mirror logctx: a contextvar carries (trace_id, span stack) along
+the handler thread; spans are cheap dataclasses; the ring drops oldest.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+from typing import Optional
+
+TRACE_HEADER = "mm-trace-id"
+TRACE_DUMP_ID = "***TRACES***"
+
+_current: contextvars.ContextVar[Optional["_Trace"]] = contextvars.ContextVar(
+    "mm_trace", default=None
+)
+
+
+class _Trace:
+    __slots__ = ("trace_id", "spans", "start")
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+        self.spans: list[dict] = []
+        self.start = time.time()
+
+
+class Tracer:
+    """Per-instance trace collector (bounded ring of finished traces)."""
+
+    def __init__(self, instance_id: str = "", capacity: int = 256):
+        self.instance_id = instance_id
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._ring: list[dict] = []
+
+    # -- request lifecycle --------------------------------------------------
+
+    @contextlib.contextmanager
+    def trace(self, trace_id: str = "", model_id: str = "", method: str = ""):
+        """Open a trace for one request; finishes into the ring."""
+        t = _Trace(trace_id or uuid.uuid4().hex[:16])
+        token = _current.set(t)
+        t0 = time.perf_counter()
+        try:
+            yield t.trace_id
+        finally:
+            _current.reset(token)
+            record = {
+                "trace_id": t.trace_id,
+                "instance": self.instance_id,
+                "model_id": model_id,
+                "method": method,
+                "start": t.start,
+                "duration_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                "spans": t.spans,
+            }
+            with self._lock:
+                self._ring.append(record)
+                if len(self._ring) > self.capacity:
+                    del self._ring[: len(self._ring) - self.capacity]
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Record a named stage; no-op when no trace is open (background
+        work stays untraced rather than allocating orphan spans)."""
+        t = _current.get()
+        if t is None:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            span = {
+                "name": name,
+                "at_ms": round((time.time() - t.start) * 1e3, 3),
+                "duration_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            }
+            if attrs:
+                span.update(attrs)
+            t.spans.append(span)
+
+    # -- introspection ------------------------------------------------------
+
+    @staticmethod
+    def current_trace_id() -> str:
+        t = _current.get()
+        return t.trace_id if t is not None else ""
+
+    def recent(self, n: int = 50) -> list[dict]:
+        with self._lock:
+            return list(self._ring[-n:])
+
+
+def incoming_trace_id(headers) -> str:
+    """Extract the propagated trace id from a header list without
+    materializing a dict on the hot path."""
+    return next((v for k, v in headers if k == TRACE_HEADER), "")
+
+
+def outgoing_headers(headers: list[tuple[str, str]]) -> list[tuple[str, str]]:
+    """Headers for a peer/runtime hop with the trace id attached (once)."""
+    tid = Tracer.current_trace_id()
+    if not tid or any(k == TRACE_HEADER for k, _ in headers):
+        return headers
+    return headers + [(TRACE_HEADER, tid)]

@@ -151,6 +151,17 @@ class MeshApiServicer:
                 model_id=STATE_DUMP_ID,
                 errors=[_json.dumps(debug_dump(self.instance))],
             )
+        from modelmesh_tpu.observability.tracing import TRACE_DUMP_ID
+
+        if request.model_id == TRACE_DUMP_ID:
+            import json as _json
+
+            tracer = self.instance.tracer
+            return apb.ModelStatusInfo(
+                status=apb.UNKNOWN,
+                model_id=TRACE_DUMP_ID,
+                errors=[_json.dumps(tracer.recent(tracer.capacity))],
+            )
         self._require_id(request.model_id, context)
         return self._status_info(request.model_id)
 
@@ -205,14 +216,20 @@ class MeshInternalServicer:
         ctx.cancel_event = threading.Event()
         context.add_callback(ctx.cancel_event.set)
         headers = list(request.headers.items())
+        from modelmesh_tpu.observability.tracing import incoming_trace_id
+
+        incoming_tid = incoming_trace_id(headers)
         try:
-            result = self.instance.invoke_model(
-                request.model_id,
-                request.method_name or None,
-                request.payload,
-                headers,
-                ctx,
-            )
+            with self.instance.tracer.trace(
+                incoming_tid, request.model_id, request.method_name
+            ):
+                result = self.instance.invoke_model(
+                    request.model_id,
+                    request.method_name or None,
+                    request.payload,
+                    headers,
+                    ctx,
+                )
         except ModelNotHereError:
             context.set_trailing_metadata(((ERROR_HEADER, _ERR_NOT_HERE),))
             context.abort(
@@ -343,8 +360,12 @@ class InferenceFallback:
         context.add_callback(cancel_event.set)
         t0 = _time.perf_counter()
         metrics.observe(MX.REQUEST_BYTES, len(request), model_id)
+        from modelmesh_tpu.observability.tracing import TRACE_HEADER
+
         try:
-            with self.log_headers.bind(md.items()):
+            with self.log_headers.bind(md.items()), self.instance.tracer.trace(
+                md.get(TRACE_HEADER, ""), model_id, method
+            ):
                 result = self.instance.invoke_model(
                     model_id, method, request, headers,
                     RoutingContext(cancel_event=cancel_event),
@@ -406,13 +427,23 @@ class InferenceFallback:
         cancel_event = threading.Event()
         context.add_callback(cancel_event.set)
         t0 = _time.perf_counter()
-        futs = [
-            self._multi_pool.submit(
-                self.instance.invoke_model, mid, method, request, headers,
-                RoutingContext(cancel_event=cancel_event),
-            )
-            for mid in ids
-        ]
+        from modelmesh_tpu.observability.tracing import TRACE_HEADER
+
+        import uuid as _uuid
+
+        trace_id = md.get(TRACE_HEADER, "") or _uuid.uuid4().hex[:16]
+
+        def run_member(mid):
+            # Pool threads don't inherit the handler's trace contextvar:
+            # each member records under the SHARED trace id so the fan-out
+            # appears as one trace across instances.
+            with self.instance.tracer.trace(trace_id, mid, method):
+                return self.instance.invoke_model(
+                    mid, method, request, headers,
+                    RoutingContext(cancel_event=cancel_event),
+                )
+
+        futs = [self._multi_pool.submit(run_member, mid) for mid in ids]
         out = bytearray()
         # Per-model budget tied to the LOAD timeout (a fan-out member may
         # legitimately cold-load), not a flat wall unrelated to it — the

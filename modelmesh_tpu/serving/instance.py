@@ -230,8 +230,10 @@ class ModelMeshInstance:
             else params.load_timeout_ms / 1000.0
         )
 
+        from modelmesh_tpu.observability.tracing import Tracer
         from modelmesh_tpu.serving.timestats import TimeStats
 
+        self.tracer = Tracer(self.instance_id)
         self.time_stats = TimeStats()
         # Strategies that accept per-type load-time stats (greedy's warming
         # penalty and wait-vs-reroute bound) get this instance's tracker.
@@ -696,12 +698,14 @@ class ModelMeshInstance:
             raise ModelLoadException(f"{ce.model_id}: concurrency gate timeout")
         try:
             t0 = _time.perf_counter()
-            if self._runtime_call_cancellable:
-                out = self._runtime_call(
-                    ce, method, payload, headers, cancel_event=cancel_event
-                )
-            else:
-                out = self._runtime_call(ce, method, payload, headers)
+            with self.tracer.span("runtime-call", model=ce.model_id):
+                if self._runtime_call_cancellable:
+                    out = self._runtime_call(
+                        ce, method, payload, headers,
+                        cancel_event=cancel_event,
+                    )
+                else:
+                    out = self._runtime_call(ce, method, payload, headers)
             ce.record_latency((_time.perf_counter() - t0) * 1e3)
             self.rate.record()
             self._model_rate(ce.model_id).record()
@@ -973,7 +977,39 @@ class ModelMeshInstance:
                 started and (now_ms() - started) / 1000.0 >= load_budget_s
             ):
                 self.metrics.inc(MX.LOAD_TIMEOUT_COUNT, model_id=ce.model_id)
+                self._log_loader_stacks(ce.model_id)
                 return False
+
+    def _log_loader_stacks(self, model_id: str) -> None:
+        """On a load timeout, capture the loading-pool threads' live stacks
+        (the reference captures the stuck thread's stacktrace on load
+        timeout, ModelMesh.java:2313-2318) — the single most useful
+        artifact for diagnosing a wedged runtime."""
+        ce = self.cache.get_quietly(model_id)
+        if ce is not None and getattr(ce, "_stacks_logged", False):
+            return  # N waiters timing out on one load: dump once
+        if ce is not None:
+            ce._stacks_logged = True
+        import sys
+        import traceback
+
+        frames = sys._current_frames()
+        stacks = []
+        for t in threading.enumerate():
+            if not t.name.startswith("loader-") or t.ident not in frames:
+                continue
+            frame = frames[t.ident]
+            # Idle pool threads park in threading's cv.wait — only busy
+            # (potentially stuck) threads are diagnostic signal.
+            if frame.f_code.co_filename.endswith("threading.py"):
+                continue
+            stack = "".join(traceback.format_stack(frame))
+            stacks.append(f"--- {t.name} ---\n{stack}")
+        if stacks:
+            log.warning(
+                "load timeout for %s; loading-thread stacks:\n%s",
+                model_id, "\n".join(stacks),
+            )
 
     def _wait_space(self, ce: CacheEntry) -> bool:
         # The entry's weight is already inserted in the cache; what we wait
@@ -1116,9 +1152,13 @@ class ModelMeshInstance:
             cancel_event=ctx.cancel_event,
         )
         self.metrics.inc(MX.INVOKE_FORWARD_COUNT, model_id=model_id)
-        return self._peer_call(
-            rec.endpoint or target, model_id, method, payload, headers, fwd_ctx
-        )
+        from modelmesh_tpu.observability.tracing import outgoing_headers
+
+        with self.tracer.span("forward", target=target, hop=hop):
+            return self._peer_call(
+                rec.endpoint or target, model_id, method, payload,
+                outgoing_headers(headers), fwd_ctx,
+            )
 
     # ------------------------------------------------------------------ #
     # shutdown                                                           #

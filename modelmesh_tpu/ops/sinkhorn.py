@@ -40,7 +40,7 @@ def _col_lse(C: jax.Array, f: jax.Array, eps: float) -> jax.Array:
     return jax.nn.logsumexp(z, axis=0)
 
 
-@partial(jax.jit, static_argnames=("eps", "iters"))
+@partial(jax.jit, static_argnames=("eps", "iters", "lse_impl"))
 def sinkhorn(
     C: jax.Array,
     row_mass: jax.Array,
@@ -48,6 +48,7 @@ def sinkhorn(
     *,
     eps: float = 0.05,
     iters: int = 12,
+    lse_impl: str = "auto",
 ) -> SinkhornResult:
     """Semi-unbalanced log-domain Sinkhorn: rows are equalities (every
     model's copy-mass must place), columns are CAPS.
@@ -65,10 +66,37 @@ def sinkhorn(
     log_a = jnp.log(jnp.maximum(row_mass, 1e-30))
     log_b = jnp.log(jnp.maximum(col_mass, 1e-30))
 
+    # LSE backend: the Pallas kernels (ops/pallas_lse.py) pin the online
+    # reduction in VMEM on TPU; XLA's fused reduction everywhere else.
+    # Explicit "pallas" off-TPU runs the kernels under the interpreter
+    # (slow, for testing the REAL selection path) rather than crashing in
+    # Mosaic lowering for a backend that doesn't exist.
+    if lse_impl not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"lse_impl={lse_impl!r} (expected auto | xla | pallas)"
+        )
+    on_tpu = jax.default_backend() == "tpu"
+    use_pallas = lse_impl == "pallas" or (lse_impl == "auto" and on_tpu)
+    if use_pallas:
+        from modelmesh_tpu.ops import pallas_lse
+
+        interp = not on_tpu
+        n_rows, n_cols = C.shape
+        Cp = pallas_lse.pad_cost(C)  # ONCE, outside the scan
+        row_fn = lambda _C, g_: pallas_lse.row_lse(   # noqa: E731
+            Cp, g_, eps, interpret=interp, valid_rows=n_rows
+        )
+        col_fn = lambda _C, f_: pallas_lse.col_lse(   # noqa: E731
+            Cp, f_, eps, interpret=interp, valid_cols=n_cols
+        )
+    else:
+        row_fn = lambda C_, g_: _row_lse(C_, g_, eps)  # noqa: E731
+        col_fn = lambda C_, f_: _col_lse(C_, f_, eps)  # noqa: E731
+
     def body(carry, _):
         f, g = carry
-        f = eps * (log_a - _row_lse(C, g, eps))
-        g = jnp.minimum(0.0, eps * (log_b - _col_lse(C, f, eps)))
+        f = eps * (log_a - row_fn(C, g))
+        g = jnp.minimum(0.0, eps * (log_b - col_fn(C, f)))
         return (f, g), None
 
     f0 = jnp.zeros_like(log_a)
@@ -76,7 +104,7 @@ def sinkhorn(
     (f, g), _ = jax.lax.scan(body, (f0, g0), None, length=iters)
 
     # Diagnostic: row-marginal violation of the implied plan.
-    row_sum = jnp.exp((f + eps * _row_lse(C, g, eps)) / eps)
+    row_sum = jnp.exp((f + eps * row_fn(C, g)) / eps)
     row_err = jnp.mean(jnp.abs(row_sum - row_mass)) / jnp.maximum(
         jnp.mean(row_mass), 1e-30
     )

@@ -39,6 +39,8 @@ class SolveConfig(NamedTuple):
     tau: float = 1.0
     # Placement-preference weights (static: part of the compiled program).
     weights: costs_mod.CostWeights = costs_mod.CostWeights()
+    # Sinkhorn LSE backend: "auto" = Pallas kernels on TPU, XLA elsewhere.
+    lse_impl: str = "auto"
     dtype: jnp.dtype = jnp.bfloat16
 
 
@@ -68,7 +70,8 @@ def solve_placement(
     row_mass = problem.sizes * copies.astype(jnp.float32)
     free = jnp.maximum(problem.capacity - problem.reserved, 0.0)
     sk = _sinkhorn(
-        C, row_mass, free, eps=config.eps, iters=config.sinkhorn_iters
+        C, row_mass, free, eps=config.eps, iters=config.sinkhorn_iters,
+        lse_impl=config.lse_impl,
     )
     logits = _plan_logits(C, sk.f, sk.g, config.eps)
     res = _auction(

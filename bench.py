@@ -66,10 +66,17 @@ def main() -> None:
     from modelmesh_tpu import ops
 
     dev = jax.devices()[0]
-    global NUM_MODELS, NUM_INSTANCES, REPS
-    if dev.platform == "cpu" and "MM_BENCH_MODELS" not in os.environ:
-        # CPU fallback: run the ladder's mid tier so the bench finishes.
-        NUM_MODELS, NUM_INSTANCES, REPS = 10_000, 128, min(REPS, 10)
+    global NUM_MODELS, NUM_INSTANCES, REPS, WARMUP
+    if (
+        dev.platform == "cpu"
+        and "MM_BENCH_MODELS" not in os.environ
+        and "MM_BENCH_REPS" not in os.environ
+    ):
+        # CPU fallback: still measure the TARGET tier (a full 100k x 1k
+        # solve runs ~22 s on one CPU core — already faster than the
+        # reference's 30 s serial loop), just with few repetitions so the
+        # bench finishes. vs_baseline stays honest: same tier.
+        WARMUP, REPS = 1, min(REPS, 2)
     problem = ops.random_problem(
         jax.random.PRNGKey(0), NUM_MODELS, NUM_INSTANCES, capacity_slack=2.0
     )
@@ -90,9 +97,11 @@ def main() -> None:
 
     p99 = float(np.percentile(np.asarray(times_ms), 99))
     at_target_tier = (NUM_MODELS, NUM_INSTANCES) == BASELINE_TIER
+    # With < 10 samples "p99" would be a dressed-up max — label honestly.
+    stat = "p99" if REPS >= 10 else f"max-of-{REPS}"
     result = {
-        "metric": f"global-rebalance p99 latency @ {NUM_MODELS//1000}k models x "
-        f"{NUM_INSTANCES} instances ({dev.platform})",
+        "metric": f"global-rebalance {stat} latency @ {NUM_MODELS//1000}k "
+        f"models x {NUM_INSTANCES} instances ({dev.platform})",
         "value": round(p99, 3),
         "unit": "ms",
         # The 30 s reference number is defined at 100k x 1k ONLY; a ratio

@@ -75,7 +75,15 @@ def sinkhorn(
         raise ValueError(
             f"lse_impl={lse_impl!r} (expected auto | xla | pallas)"
         )
-    on_tpu = jax.default_backend() == "tpu"
+    # "auto" heuristic: the default backend AND any explicit default-device
+    # override must both point at TPU (a CPU default_device on a TPU host —
+    # a real debugging pattern here — would compile the program for CPU,
+    # where a Mosaic kernel cannot lower). lse_impl="xla" remains the
+    # explicit escape hatch for exotic placements.
+    dd = jax.config.jax_default_device
+    on_tpu = jax.default_backend() == "tpu" and (
+        dd is None or getattr(dd, "platform", "tpu") == "tpu"
+    )
     use_pallas = lse_impl == "pallas" or (lse_impl == "auto" and on_tpu)
     if use_pallas:
         from modelmesh_tpu.ops import pallas_lse

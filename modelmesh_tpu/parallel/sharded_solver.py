@@ -234,6 +234,15 @@ def make_sharded_solver(
     config: SolveConfig = SolveConfig(),
     weights: CostWeights = CostWeights(),
 ):
+    if config.lse_impl == "pallas":
+        # The sharded sinkhorn combines per-shard partial reductions with
+        # psum (parallel/_lse); a per-shard Pallas LSE needs a partial
+        # (max, sum) kernel variant — not yet implemented. Reject rather
+        # than silently running XLA under a knob claiming otherwise.
+        raise NotImplementedError(
+            "lse_impl='pallas' is single-device only; the sharded solver "
+            "uses its psum-based XLA LSE (use lse_impl='auto' or 'xla')"
+        )
     """Build a jitted sharded solver bound to ``mesh``.
 
     The returned callable is ``solver(problem, seed=...)`` — seed is traced,

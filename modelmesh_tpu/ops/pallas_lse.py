@@ -39,41 +39,6 @@ _TM = 512   # cols per block
 _NEG_BIG = -1.0e30  # padding shift value: exp() underflows to exactly 0
 
 
-def _lse_kernel(shift_ref, c_ref, out_ref, m_scr, s_scr, *, inv_eps, axis):
-    """One (row-block, col-block) step of the online LSE.
-
-    axis=1: reduce over columns (row update; grid dim 1 iterates col tiles).
-    axis=0: reduce over rows (column update; grid dim 1 iterates row tiles).
-    The reduced-axis tile index is always grid dim 1 so the scratch
-    accumulators persist across it and finalize on its last step.
-    """
-    step = pl.program_id(1)
-
-    @pl.when(step == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
-        s_scr[:] = jnp.zeros_like(s_scr)
-
-    c = c_ref[:].astype(jnp.float32)             # [TN, TM]
-    if axis == 1:
-        z = (shift_ref[:] - c) * inv_eps         # shift [1, TM] broadcasts
-        m_tile = jnp.max(z, axis=1, keepdims=True)           # [TN, 1]
-    else:
-        z = (shift_ref[:] - c) * inv_eps         # shift [TN, 1] broadcasts
-        m_tile = jnp.max(z, axis=0, keepdims=True)           # [1, TM]
-    m_old = m_scr[:]
-    m_new = jnp.maximum(m_old, m_tile)
-    # Rescale the running sum to the new max, then fold this tile in.
-    s_scr[:] = s_scr[:] * jnp.exp(m_old - m_new) + jnp.sum(
-        jnp.exp(z - m_new), axis=axis, keepdims=True
-    )
-    m_scr[:] = m_new
-
-    @pl.when(step == pl.num_programs(1) - 1)
-    def _finalize():
-        out_ref[:] = jnp.log(jnp.maximum(s_scr[:], 1e-30)) + m_scr[:]
-
-
 def _pad_to(x, mult, axis, value):
     n = x.shape[axis]
     rem = (-n) % mult
@@ -102,27 +67,10 @@ def row_lse(C: jax.Array, g: jax.Array, eps: float,
 
     ``g`` has the ORIGINAL column count; pass ``valid_rows`` with a
     pre-padded C (pad_cost) to slice the live rows."""
-    n = valid_rows if valid_rows is not None else C.shape[0]
-    Cp = pad_cost(C)
-    # Padded columns get shift -BIG so exp underflows to exactly 0.
-    gp = _pad_to(g.astype(jnp.float32), _TM, 0, _NEG_BIG).reshape(1, -1)
-    np_, mp = Cp.shape
-    out = pl.pallas_call(
-        functools.partial(_lse_kernel, inv_eps=1.0 / eps, axis=1),
-        grid=(np_ // _TN, mp // _TM),
-        in_specs=[
-            pl.BlockSpec((1, _TM), lambda i, j: (0, j)),
-            pl.BlockSpec((_TN, _TM), lambda i, j: (i, j)),
-        ],
-        out_specs=pl.BlockSpec((_TN, 1), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((np_, 1), jnp.float32),
-        scratch_shapes=[
-            pltpu.VMEM((_TN, 1), jnp.float32),
-            pltpu.VMEM((_TN, 1), jnp.float32),
-        ],
-        interpret=interpret,
-    )(gp, Cp)
-    return out[:n, 0]
+    m, s = row_lse_partial(
+        C, g, eps, interpret=interpret, valid_rows=valid_rows
+    )
+    return jnp.log(jnp.maximum(s, 1e-30)) + m
 
 
 @functools.partial(
@@ -132,24 +80,117 @@ def col_lse(C: jax.Array, f: jax.Array, eps: float,
             interpret: bool = False,
             valid_cols: int | None = None) -> jax.Array:
     """logsumexp_n (f[n] - C[n, m]) / eps  -> f32[valid_cols or M]."""
+    m, s = col_lse_partial(
+        C, f, eps, interpret=interpret, valid_cols=valid_cols
+    )
+    return jnp.log(jnp.maximum(s, 1e-30)) + m
+
+
+def _partial_kernel(shift_ref, c_ref, m_out, s_out, m_scr, s_scr, *,
+                    inv_eps, axis):
+    """THE online-LSE kernel: one (out-block, reduce-tile) step emitting the
+    raw (running max, rescaled sum) pair. Single source of the
+    accumulation math — the full LSE is ``log(max(s, eps0)) + m``
+    (row_lse/col_lse wrappers), and the sharded combine is
+    ``M = pmax(m); lse = log(psum(s * exp(m - M))) + M``.
+
+    axis=1: reduce over columns (grid dim 1 iterates column tiles);
+    axis=0: reduce over rows (grid dim 1 iterates row tiles). The reduced
+    axis is always grid dim 1 so the scratch persists across it."""
+    step = pl.program_id(1)
+
+    @pl.when(step == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        s_scr[:] = jnp.zeros_like(s_scr)
+
+    c = c_ref[:].astype(jnp.float32)
+    if axis == 1:
+        z = (shift_ref[:] - c) * inv_eps
+        m_tile = jnp.max(z, axis=1, keepdims=True)
+    else:
+        z = (shift_ref[:] - c) * inv_eps
+        m_tile = jnp.max(z, axis=0, keepdims=True)
+    m_old = m_scr[:]
+    m_new = jnp.maximum(m_old, m_tile)
+    s_scr[:] = s_scr[:] * jnp.exp(m_old - m_new) + jnp.sum(
+        jnp.exp(z - m_new), axis=axis, keepdims=True
+    )
+    m_scr[:] = m_new
+
+    @pl.when(step == pl.num_programs(1) - 1)
+    def _finalize():
+        m_out[:] = m_scr[:]
+        s_out[:] = s_scr[:]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("eps", "interpret", "valid_rows")
+)
+def row_lse_partial(C: jax.Array, g: jax.Array, eps: float,
+                    interpret: bool = False,
+                    valid_rows: int | None = None):
+    """Per-shard partial row reduction -> (m, s) f32[valid_rows] pair.
+
+    ``logsumexp = log(s) + m`` after combining shards (pmax/psum)."""
+    n = valid_rows if valid_rows is not None else C.shape[0]
+    Cp = pad_cost(C)
+    gp = _pad_to(g.astype(jnp.float32), _TM, 0, _NEG_BIG).reshape(1, -1)
+    np_, mp = Cp.shape
+    m_out, s_out = pl.pallas_call(
+        functools.partial(_partial_kernel, inv_eps=1.0 / eps, axis=1),
+        grid=(np_ // _TN, mp // _TM),
+        in_specs=[
+            pl.BlockSpec((1, _TM), lambda i, j: (0, j)),
+            pl.BlockSpec((_TN, _TM), lambda i, j: (i, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_TN, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((_TN, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((np_, 1), jnp.float32),
+            jax.ShapeDtypeStruct((np_, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_TN, 1), jnp.float32),
+            pltpu.VMEM((_TN, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(gp, Cp)
+    return m_out[:n, 0], s_out[:n, 0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("eps", "interpret", "valid_cols")
+)
+def col_lse_partial(C: jax.Array, f: jax.Array, eps: float,
+                    interpret: bool = False,
+                    valid_cols: int | None = None):
+    """Per-shard partial column reduction -> (m, s) f32[valid_cols] pair."""
     m = valid_cols if valid_cols is not None else C.shape[1]
     Cp = pad_cost(C)
     fp = _pad_to(f.astype(jnp.float32), _TN, 0, _NEG_BIG).reshape(-1, 1)
     np_, mp = Cp.shape
-    out = pl.pallas_call(
-        functools.partial(_lse_kernel, inv_eps=1.0 / eps, axis=0),
-        # Reduced axis (rows) must be grid dim 1 so scratch persists over it.
+    m_out, s_out = pl.pallas_call(
+        functools.partial(_partial_kernel, inv_eps=1.0 / eps, axis=0),
         grid=(mp // _TM, np_ // _TN),
         in_specs=[
             pl.BlockSpec((_TN, 1), lambda j, i: (i, 0)),
             pl.BlockSpec((_TN, _TM), lambda j, i: (i, j)),
         ],
-        out_specs=pl.BlockSpec((1, _TM), lambda j, i: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((1, mp), jnp.float32),
+        out_specs=[
+            pl.BlockSpec((1, _TM), lambda j, i: (0, j)),
+            pl.BlockSpec((1, _TM), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, mp), jnp.float32),
+            jax.ShapeDtypeStruct((1, mp), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((1, _TM), jnp.float32),
             pltpu.VMEM((1, _TM), jnp.float32),
         ],
         interpret=interpret,
     )(fp, Cp)
-    return out[0, :m]
+    return m_out[0, :m], s_out[0, :m]

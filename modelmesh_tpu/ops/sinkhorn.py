@@ -40,6 +40,24 @@ def _col_lse(C: jax.Array, f: jax.Array, eps: float) -> jax.Array:
     return jax.nn.logsumexp(z, axis=0)
 
 
+def resolve_lse_impl(lse_impl: str) -> str:
+    """Validate + resolve "auto": pallas only when BOTH the default
+    backend and any explicit default-device override point at TPU (a CPU
+    default_device on a TPU host compiles the program for CPU, where a
+    Mosaic kernel cannot lower). Shared with the sharded solver."""
+    if lse_impl not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"lse_impl={lse_impl!r} (expected auto | xla | pallas)"
+        )
+    if lse_impl != "auto":
+        return lse_impl
+    dd = jax.config.jax_default_device
+    on_tpu = jax.default_backend() == "tpu" and (
+        dd is None or getattr(dd, "platform", "tpu") == "tpu"
+    )
+    return "pallas" if on_tpu else "xla"
+
+
 @partial(jax.jit, static_argnames=("eps", "iters", "lse_impl"))
 def sinkhorn(
     C: jax.Array,
@@ -71,24 +89,11 @@ def sinkhorn(
     # Explicit "pallas" off-TPU runs the kernels under the interpreter
     # (slow, for testing the REAL selection path) rather than crashing in
     # Mosaic lowering for a backend that doesn't exist.
-    if lse_impl not in ("auto", "xla", "pallas"):
-        raise ValueError(
-            f"lse_impl={lse_impl!r} (expected auto | xla | pallas)"
-        )
-    # "auto" heuristic: the default backend AND any explicit default-device
-    # override must both point at TPU (a CPU default_device on a TPU host —
-    # a real debugging pattern here — would compile the program for CPU,
-    # where a Mosaic kernel cannot lower). lse_impl="xla" remains the
-    # explicit escape hatch for exotic placements.
-    dd = jax.config.jax_default_device
-    on_tpu = jax.default_backend() == "tpu" and (
-        dd is None or getattr(dd, "platform", "tpu") == "tpu"
-    )
-    use_pallas = lse_impl == "pallas" or (lse_impl == "auto" and on_tpu)
+    use_pallas = resolve_lse_impl(lse_impl) == "pallas"
     if use_pallas:
         from modelmesh_tpu.ops import pallas_lse
 
-        interp = not on_tpu
+        interp = jax.default_backend() != "tpu"
         n_rows, n_cols = C.shape
         Cp = pallas_lse.pad_cost(C)  # ONCE, outside the scan
         row_fn = lambda _C, g_: pallas_lse.row_lse(   # noqa: E731

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from modelmesh_tpu.ops.sinkhorn import resolve_lse_impl
 from modelmesh_tpu.ops.auction import (
     K_CAND,
     MAX_COPIES,
@@ -90,26 +91,57 @@ def _lse(z_blk: jax.Array, axis: int, axis_name: str) -> jax.Array:
     return jnp.log(jnp.maximum(s, 1e-30)) + m
 
 
-def _sharded_sinkhorn(C, row_mass, col_mass, eps: float, iters: int):
+def _sharded_sinkhorn(C, row_mass, col_mass, eps: float, iters: int,
+                      lse_impl: str = "xla"):
     # Semi-unbalanced (rows equality, columns CAPS via g <= 0) — must match
     # ops/sinkhorn.py exactly; the parity tests compare potentials.
     log_a = jnp.log(jnp.maximum(row_mass, 1e-30))
     log_b = jnp.log(jnp.maximum(col_mass, 1e-30))
     Cf = C.astype(jnp.float32)
 
+    if lse_impl == "pallas":
+        # Per-shard Pallas partial reductions (ops/pallas_lse.py) combined
+        # with pmax/psum — each device streams only ITS C block through
+        # VMEM; the collective carries just the (m, s) vectors.
+        from modelmesh_tpu.ops import pallas_lse
+
+        interp = jax.default_backend() != "tpu"
+        Cp = pallas_lse.pad_cost(C)  # per-shard block, padded ONCE
+        n_blk, m_blk = C.shape
+
+        def row_lse(g):
+            m_l, s_l = pallas_lse.row_lse_partial(
+                Cp, g, eps, interpret=interp, valid_rows=n_blk
+            )
+            m_g = jax.lax.pmax(m_l, INSTANCE_AXIS)
+            s_g = jax.lax.psum(s_l * jnp.exp(m_l - m_g), INSTANCE_AXIS)
+            return jnp.log(jnp.maximum(s_g, 1e-30)) + m_g
+
+        def col_lse(f):
+            m_l, s_l = pallas_lse.col_lse_partial(
+                Cp, f, eps, interpret=interp, valid_cols=m_blk
+            )
+            m_g = jax.lax.pmax(m_l, MODEL_AXIS)
+            s_g = jax.lax.psum(s_l * jnp.exp(m_l - m_g), MODEL_AXIS)
+            return jnp.log(jnp.maximum(s_g, 1e-30)) + m_g
+    else:
+        def row_lse(g):
+            return _lse((g[None, :] - Cf) / eps, 1, INSTANCE_AXIS)
+
+        def col_lse(f):
+            return _lse((f[:, None] - Cf) / eps, 0, MODEL_AXIS)
+
     def body(carry, _):
         f, g = carry
-        f = eps * (log_a - _lse((g[None, :] - Cf) / eps, 1, INSTANCE_AXIS))
-        g = jnp.minimum(
-            0.0, eps * (log_b - _lse((f[:, None] - Cf) / eps, 0, MODEL_AXIS))
-        )
+        f = eps * (log_a - row_lse(g))
+        g = jnp.minimum(0.0, eps * (log_b - col_lse(f)))
         return (f, g), None
 
     f0 = jnp.zeros_like(log_a)
     g0 = jnp.zeros_like(log_b)
     (f, g), _ = jax.lax.scan(body, (f0, g0), None, length=iters)
 
-    row_sum = jnp.exp((f + eps * _lse((g[None, :] - Cf) / eps, 1, INSTANCE_AXIS)) / eps)
+    row_sum = jnp.exp((f + eps * row_lse(g)) / eps)
     err = jax.lax.psum(jnp.sum(jnp.abs(row_sum - row_mass)), MODEL_AXIS)
     total = jax.lax.psum(jnp.sum(row_mass), MODEL_AXIS)
     err = err / jnp.maximum(total, 1e-30)
@@ -198,7 +230,8 @@ def _solve_kernel(
     row_mass = p.sizes * copies.astype(jnp.float32)
     free = jnp.maximum(p.capacity - p.reserved, 0.0)
     f, g, row_err = _sharded_sinkhorn(
-        C, row_mass, free, config.eps, config.sinkhorn_iters
+        C, row_mass, free, config.eps, config.sinkhorn_iters,
+        lse_impl=resolve_lse_impl(config.lse_impl),
     )
     # Quantize to the cost dtype exactly like ops.sinkhorn.plan_logits does,
     # so single-device and sharded rounding see identical scores.
@@ -234,15 +267,8 @@ def make_sharded_solver(
     config: SolveConfig = SolveConfig(),
     weights: CostWeights = CostWeights(),
 ):
-    if config.lse_impl == "pallas":
-        # The sharded sinkhorn combines per-shard partial reductions with
-        # psum (parallel/_lse); a per-shard Pallas LSE needs a partial
-        # (max, sum) kernel variant — not yet implemented. Reject rather
-        # than silently running XLA under a knob claiming otherwise.
-        raise NotImplementedError(
-            "lse_impl='pallas' is single-device only; the sharded solver "
-            "uses its psum-based XLA LSE (use lse_impl='auto' or 'xla')"
-        )
+    # lse_impl: "auto" resolves at trace time inside the kernel (pallas on
+    # TPU backends, XLA elsewhere) exactly like the single-device path.
     """Build a jitted sharded solver bound to ``mesh``.
 
     The returned callable is ``solver(problem, seed=...)`` — seed is traced,

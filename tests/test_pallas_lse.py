@@ -72,3 +72,28 @@ def test_bad_impl_rejected():
     cm = jnp.maximum(problem.capacity - problem.reserved, 0.0)
     with pytest.raises(ValueError, match="lse_impl"):
         sinkhorn(C, rm, cm, eps=0.05, iters=2, lse_impl="palas")
+
+
+def test_sharded_pallas_matches_xla_on_cpu_mesh():
+    """The sharded solver with lse_impl='pallas' (interpreted per shard,
+    pmax/psum combine) must match its XLA path on the 8-device CPU mesh."""
+    from modelmesh_tpu import ops
+    from modelmesh_tpu.ops.solve import SolveConfig
+    from modelmesh_tpu.parallel import (
+        make_mesh,
+        make_sharded_solver,
+        shard_problem,
+    )
+
+    mesh = make_mesh((4, 2), devices=jax.devices()[:8])
+    problem = ops.random_problem(jax.random.PRNGKey(9), 256, 64)
+    pp = shard_problem(problem, mesh)
+    ref = make_sharded_solver(mesh, config=SolveConfig(lse_impl="xla"))(pp)
+    got = make_sharded_solver(mesh, config=SolveConfig(lse_impl="pallas"))(pp)
+    np.testing.assert_allclose(
+        np.asarray(got.row_err), np.asarray(ref.row_err), atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.indices), np.asarray(ref.indices)
+    )
+    np.testing.assert_array_equal(np.asarray(got.valid), np.asarray(ref.valid))

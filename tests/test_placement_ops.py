@@ -229,7 +229,7 @@ class TestQualityVsGreedyOracle:
                         if C[i, j] < best_c:
                             best, best_c = j, C[i, j]
                     if best < 0:
-                        continue
+                        break  # nothing changed; further copies can't fit
                     load[best] += sizes[i]
                     chosen.add(best)
                     total += best_c

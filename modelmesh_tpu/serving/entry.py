@@ -268,6 +268,19 @@ class UnloadTracker:
     the eviction lock; here the cache reports its own weight and we track
     the pending-unload units beside it — same invariant:
         cache_weight + pending_unload_units <= capacity_units.
+
+    The reference's borrow/repay weight adjustment
+    (ModelCacheUnloadBufManager.adjustNewEntrySpaceRequest:152 — revising a
+    loading entry's space claim when sizing changes the estimate) has no
+    separate mechanism here because the decomposition covers it: a mid-load
+    grow goes through WeightedLRUCache.update_weight, which evicts others to
+    keep cache_weight <= capacity; those evictions enter pending-unload
+    accounting; and every later load re-checks ``wait_for_space`` at its own
+    WAITING stage, so new work blocks until the displaced space is actually
+    released. The transient accounting catch-up after an
+    underestimated-then-loaded model is unavoidable in ANY design — the
+    runtime has already physically allocated the real size by the time it is
+    known — and the runtime's own capacity enforcement backstops it.
     """
 
     def __init__(self, capacity_units: int):

@@ -176,3 +176,93 @@ class TestInstancePublishing:
         mr = inst.registry.get("m-mig")
         assert "i-test" not in mr.instance_ids
         assert inst.shutting_down
+
+
+class TestSizingBorrowRepay:
+    def test_midload_grow_blocks_next_load_until_unload_drains(self):
+        """The borrow/repay equivalence (ModelCacheUnloadBufManager:152):
+        a model whose real size exceeds its estimate evicts others on
+        sizing; the NEXT load must wait for those unloads to drain (the
+        cache+pending<=capacity invariant), not overcommit."""
+        import threading
+        import time as _t
+
+        from modelmesh_tpu.kv import InMemoryKV
+        from modelmesh_tpu.runtime import ModelInfo
+        from modelmesh_tpu.runtime.spi import LoadedModel, ModelLoader
+        from modelmesh_tpu.serving.entry import bytes_to_units
+        from modelmesh_tpu.serving.instance import (
+            InstanceConfig,
+            ModelMeshInstance,
+        )
+
+        from modelmesh_tpu.runtime.spi import CACHE_UNIT_BYTES
+
+        UNIT = CACHE_UNIT_BYTES
+
+        class SlowUnloadLoader(ModelLoader):
+            """Predicts small, loads BIG for 'grow-*' ids; unloads take a
+            while and are signaled."""
+
+            def __init__(self):
+                self.unloaded = threading.Event()
+
+            def startup(self):
+                from modelmesh_tpu.runtime.spi import LocalInstanceParams
+
+                return LocalInstanceParams(
+                    capacity_bytes=100 * UNIT, load_concurrency=2,
+                    load_timeout_ms=10_000, default_model_size_bytes=30 * UNIT,
+                )
+
+            def load(self, model_id, info):
+                size = 80 * UNIT if model_id.startswith("grow-") else 30 * UNIT
+                return LoadedModel(handle=model_id, size_bytes=size)
+
+            def model_size(self, model_id, handle):
+                return 80 * UNIT if model_id.startswith("grow-") else 30 * UNIT
+
+            def predict_size(self, model_id, info):
+                return 30 * UNIT  # underestimates grow-* on purpose
+
+            def unload(self, model_id):
+                _t.sleep(0.8)
+                self.unloaded.set()
+
+            @property
+            def requires_unload(self):
+                return True
+
+        kv = InMemoryKV(sweep_interval_s=0.05)
+        loader = SlowUnloadLoader()
+        inst = ModelMeshInstance(
+            kv, loader,
+            InstanceConfig(instance_id="i-size", load_timeout_s=10,
+                           space_wait_s=5.0, min_churn_age_ms=0),
+        )
+        try:
+            # Fill: two 30u models (60/100 used).
+            for k in ("base-0", "base-1"):
+                inst.register_model(k, ModelInfo(model_type="t"))
+                inst.ensure_loaded(k, sync=True)
+            # grow-x predicted 30u (fits: 90/100) but sizes to 80u -> the
+            # cache must evict a base model; its unload takes ~0.8s.
+            inst.register_model("grow-x", ModelInfo(model_type="t"))
+            inst.ensure_loaded("grow-x", sync=True)
+            assert inst.cache.weight <= 100
+            assert inst.unload_tracker.pending_units > 0
+            # Next load must WAIT for the pending unload (30u pending +
+            # 80u grow-x + 30u new = 140 > 100 until the unload drains).
+            t0 = _t.monotonic()
+            inst.register_model("after", ModelInfo(model_type="t"))
+            inst.ensure_loaded("after", sync=True)
+            waited = _t.monotonic() - t0
+            assert loader.unloaded.is_set()
+            assert inst.cache.weight + inst.unload_tracker.pending_units <= 100
+            assert waited >= 0.3, (
+                f"load proceeded in {waited:.2f}s without waiting for the "
+                "pending unload"
+            )
+        finally:
+            inst.shutdown()
+            kv.close()

@@ -21,11 +21,13 @@ from modelmesh_tpu.runtime import grpc_defs
 from modelmesh_tpu.runtime.fake import PREDICT_METHOD
 
 
-def _spawn_instance(kv_port: int, iid: str) -> tuple[subprocess.Popen, str]:
+def _spawn_instance(
+    kv_port: int, iid: str, scheme: str = "mesh"
+) -> tuple[subprocess.Popen, str]:
     proc = subprocess.Popen(
         [
             sys.executable, "-m", "modelmesh_tpu.serving.main",
-            "--kv", f"mesh://127.0.0.1:{kv_port}",
+            "--kv", f"{scheme}://127.0.0.1:{kv_port}",
             "--instance-id", iid,
             "--runtime", "fake",
             "--capacity-mb", "64",
@@ -51,13 +53,26 @@ def _spawn_instance(kv_port: int, iid: str) -> tuple[subprocess.Popen, str]:
     return proc, endpoint
 
 
-@pytest.fixture(scope="module")
-def procs():
-    server, kv_port, store = start_kv_server()
+@pytest.fixture(scope="module", params=["mesh", "etcd"])
+def procs(request):
+    """The forked-process cluster tier runs against BOTH coordination
+    protocols: MeshKV and the etcd v3 wire (kv/etcd_server.py) — the
+    reference runs every suite against a real etcd child process
+    (AbstractModelMeshTest.java:83-192); the zero-egress CI image has no
+    etcd binary, so the in-repo etcd-wire server stands in."""
+    scheme = request.param
+    if scheme == "mesh":
+        server, kv_port, store = start_kv_server()
+    else:
+        from modelmesh_tpu.kv.etcd_server import start_etcd_server
+
+        server, kv_port, store = start_etcd_server()
     spawned = []
     try:
         for i in range(2):
-            spawned.append(_spawn_instance(kv_port, f"proc-{i}"))
+            spawned.append(
+                _spawn_instance(kv_port, f"{scheme}-proc-{i}", scheme)
+            )
         yield spawned, kv_port
     finally:
         for proc, _ in spawned:

@@ -88,6 +88,7 @@ class RoutingContext:
     INTERNAL = 1
     HIT_ONLY = 2
     LOAD_LOCAL_ONLY = 3
+    HOP_NAMES = ("external", "internal", "hit-only", "load-local")
 
     def __init__(
         self,
@@ -504,6 +505,34 @@ class ModelMeshInstance:
     ) -> InvokeResult:
         ctx = ctx or RoutingContext()
         ctx.visited.add(self.instance_id)
+        # Per-request thread renaming (reference names handler threads
+        # invoke-<hoptype>-<modelId>, ModelMesh.java:3462) — makes py-spy /
+        # faulthandler / load-timeout stack dumps self-describing. Restored
+        # on exit: gRPC server threads are pooled.
+        _thread = threading.current_thread()
+        _prev_name = _thread.name
+        hop_name = (
+            RoutingContext.HOP_NAMES[ctx.hop]
+            if 0 <= ctx.hop < len(RoutingContext.HOP_NAMES)
+            else str(ctx.hop)
+        )
+        _thread.name = f"invoke-{hop_name}-{model_id}"
+        try:
+            return self._invoke_model_inner(
+                model_id, method, payload, headers, ctx, sync
+            )
+        finally:
+            _thread.name = _prev_name
+
+    def _invoke_model_inner(
+        self,
+        model_id: str,
+        method: Optional[str],
+        payload: bytes,
+        headers: list[tuple[str, str]],
+        ctx: RoutingContext,
+        sync: bool,
+    ) -> InvokeResult:
         if self.log_each_invocation:
             log.info(
                 "invoke model=%s method=%s bytes=%d hop=%d visited=%s",

@@ -176,3 +176,33 @@ class TestMultiModelTracing:
             ch.close()
         finally:
             c.close()
+
+
+class TestThreadNaming:
+    def test_handler_thread_named_during_invoke_and_restored(self):
+        """Reference names handler threads invoke-<hop>-<model>
+        (ModelMesh.java:3462); the name must restore after (pooled)."""
+        import threading
+
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=1)
+        try:
+            inst = c[0].instance
+            inst.register_model("tn-m", ModelInfo(model_type="example"))
+            seen = {}
+            orig = inst._runtime_call
+
+            def spy(ce, method, payload, headers, cancel_event=None):
+                seen["name"] = threading.current_thread().name
+                return orig(ce, method, payload, headers,
+                            cancel_event=cancel_event)
+
+            inst._runtime_call = spy
+            inst._runtime_call_cancellable = True
+            before = threading.current_thread().name
+            inst.invoke_model("tn-m", PREDICT_METHOD, b"x", [])
+            assert seen["name"] == "invoke-external-tn-m"
+            assert threading.current_thread().name == before
+        finally:
+            c.close()

@@ -81,21 +81,31 @@ class EtcdLiteServicer:
 
     # -- KV -----------------------------------------------------------------
 
-    def _range_kvs(self, req: epb.RangeRequest) -> list[KeyValue]:
-        kvs = self.store.range_interval(
-            req.key.decode(), req.range_end.decode() if req.range_end else ""
-        )
-        if req.limit:
-            kvs = kvs[: req.limit]
-        return kvs
+    def _range_response(self, req: epb.RangeRequest) -> epb.RangeResponse:
+        """Build a RangeResponse under the store lock so header.revision is
+        the revision the kvs reflect — EtcdKV's compaction resync resumes
+        its watch from header.revision and would lose a write that landed
+        between an unlocked range and header read. etcd contract: ``count``
+        is the TOTAL in-range key count regardless of limit (clients
+        paginate on it); ``more`` flags truncation. Callers may hold the
+        (reentrant) lock already — the Txn branch does."""
+        with self.store.locked():
+            kvs = self._range_locked(
+                req.key.decode(),
+                req.range_end.decode() if req.range_end else "",
+            )
+            total = len(kvs)
+            if req.limit:
+                kvs = kvs[: req.limit]
+            return epb.RangeResponse(
+                header=self._header(),
+                kvs=[_to_mvcc(kv) for kv in kvs],
+                count=total,
+                more=total > len(kvs),
+            )
 
     def Range(self, request, context):
-        kvs = self._range_kvs(request)
-        return epb.RangeResponse(
-            header=self._header(),
-            kvs=[_to_mvcc(kv) for kv in kvs],
-            count=len(kvs),
-        )
+        return self._range_response(request)
 
     def Put(self, request, context):
         try:
@@ -106,16 +116,28 @@ class EtcdLiteServicer:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         return epb.PutResponse(header=self._header())
 
-    def DeleteRange(self, request, context):
-        keys = [
-            kv.key
-            for kv in self.store.range_interval(
-                request.key.decode(),
-                request.range_end.decode() if request.range_end else "",
+    def _delete_range_response(
+        self, req: epb.DeleteRangeRequest
+    ) -> epb.DeleteRangeResponse:
+        """List + delete under one store lock: etcd's DeleteRange is atomic —
+        a key re-put mid-operation must not be deleted, a key created
+        in-range mid-operation must not survive. Shared by the unary RPC
+        and the Txn branch (reentrant lock)."""
+        with self.store.locked():
+            keys = [
+                kv.key
+                for kv in self._range_locked(
+                    req.key.decode(),
+                    req.range_end.decode() if req.range_end else "",
+                )
+            ]
+            deleted = sum(1 for k in keys if self.store.delete_locked(k))
+            return epb.DeleteRangeResponse(
+                header=self._header(), deleted=deleted
             )
-        ]
-        deleted = sum(1 for k in keys if self.store.delete(k))
-        return epb.DeleteRangeResponse(header=self._header(), deleted=deleted)
+
+    def DeleteRange(self, request, context):
+        return self._delete_range_response(request)
 
     def Txn(self, request, context):
         # One native txn when the guard set maps to the KVStore Compare
@@ -147,38 +169,18 @@ class EtcdLiteServicer:
                         )
                     )
                 elif op.HasField("request_delete_range"):
-                    rng = op.request_delete_range
-                    keys = [
-                        kv.key
-                        for kv in self._range_locked(
-                            rng.key.decode(),
-                            rng.range_end.decode() if rng.range_end else "",
-                        )
-                    ]
-                    deleted = 0
-                    for k in keys:
-                        if self.store.delete_locked(k):
-                            deleted += 1
                     responses.append(
                         epb.ResponseOp(
-                            response_delete_range=epb.DeleteRangeResponse(
-                                header=self._header(), deleted=deleted
+                            response_delete_range=self._delete_range_response(
+                                op.request_delete_range
                             )
                         )
                     )
                 elif op.HasField("request_range"):
-                    kvs = self._range_locked(
-                        op.request_range.key.decode(),
-                        op.request_range.range_end.decode()
-                        if op.request_range.range_end
-                        else "",
-                    )
                     responses.append(
                         epb.ResponseOp(
-                            response_range=epb.RangeResponse(
-                                header=self._header(),
-                                kvs=[_to_mvcc(kv) for kv in kvs],
-                                count=len(kvs),
+                            response_range=self._range_response(
+                                op.request_range
                             )
                         )
                     )
@@ -282,16 +284,6 @@ class EtcdLiteServicer:
         watch_id = next_watch_id[0]
         next_watch_id[0] += 1
         start = create.start_revision
-        floor = self.store.compact_rev
-        if 0 < start <= floor:
-            out_q.put(epb.WatchResponse(
-                header=self._header(), watch_id=watch_id, created=True,
-            ))
-            out_q.put(epb.WatchResponse(
-                header=self._header(), watch_id=watch_id, canceled=True,
-                compact_revision=floor + 1,
-            ))
-            return
         prefix = create.key.decode()
         exact = not create.range_end  # etcd: empty range_end = single key
 
@@ -341,10 +333,31 @@ class EtcdLiteServicer:
                         except queue.Empty:
                             continue
 
-        handles[watch_id] = self.store.watch(
-            prefix, on_events,
-            start_rev=(start - 1) if start > 0 else None,
-        )
+        # Floor check + registration must be ATOMIC: a compaction (or
+        # history-cap trim) between reading compact_rev and registering
+        # would route the watch into InMemoryKV's PUT-only full-state
+        # fallback with no canceled+compact_revision response — a silently
+        # stale watch view. The store lock is reentrant, so store.watch()
+        # is safe to call inside it.
+        with self.store.locked():
+            floor = self.store.compact_rev
+            if 0 < start <= floor:
+                handle = None
+            else:
+                handle = self.store.watch(
+                    prefix, on_events,
+                    start_rev=(start - 1) if start > 0 else None,
+                )
+        if handle is None:
+            out_q.put(epb.WatchResponse(
+                header=self._header(), watch_id=watch_id, created=True,
+            ))
+            out_q.put(epb.WatchResponse(
+                header=self._header(), watch_id=watch_id, canceled=True,
+                compact_revision=floor + 1,
+            ))
+            return
+        handles[watch_id] = handle
         out_q.put(epb.WatchResponse(
             header=self._header(), watch_id=watch_id, created=True,
         ))

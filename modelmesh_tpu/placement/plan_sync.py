@@ -30,7 +30,8 @@ from modelmesh_tpu.placement.jax_engine import GlobalPlan
 log = logging.getLogger(__name__)
 
 PLAN_KEY = "plan"
-DEFAULT_MAX_PLAN_BYTES = 12 << 20  # headroom under the 16 MiB data plane
+# The plan byte budget lives in the MM_MAX_PLAN_BYTES env registration
+# (utils/envs.py, default 12 MiB — headroom under the 16 MiB data plane).
 # Absolute staleness bound on ADOPTION, judged by the publisher's solve
 # timestamp (generous to tolerate clock skew — plans are advisory). Without
 # it, an instance starting hours after the leader died would resurrect the
@@ -46,16 +47,22 @@ def publish_plan(
     store: KVStore,
     prefix: str,
     plan: GlobalPlan,
-    max_bytes: int = DEFAULT_MAX_PLAN_BYTES,
+    max_bytes: Optional[int] = None,
 ) -> int:
     """Serialize + put the plan; returns the published byte size.
 
-    If the serialized plan exceeds ``max_bytes``, the placement map is
+    ``max_bytes`` defaults from the MM_MAX_PLAN_BYTES env knob
+    (utils/envs.py) so operators can tune the plan byte budget without a
+    code change. If the serialized plan exceeds it, the placement map is
     truncated from the TAIL. This relies on solve_plan emitting placements
     hottest-first (jax_engine.py sorts by problem rates precisely so this
     truncation sheds the coldest models); reordering the placement dict
     breaks that invariant. Dropped models serve greedy at followers.
     """
+    if max_bytes is None:
+        from modelmesh_tpu.utils import envs
+
+        max_bytes = envs.get_int("MM_MAX_PLAN_BYTES")
     store_cap = store.max_value_bytes()
     if store_cap is not None:
         max_bytes = min(max_bytes, store_cap)

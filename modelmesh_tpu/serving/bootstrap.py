@@ -159,8 +159,10 @@ class PreStopServer:
 
     - GET /prestop — blocks until migration completes (k8s preStop hook).
     - GET /ready — 200 only when the ReadinessGate passes: not shutting
-      down AND no peer draining (holds a rolling update while migrations
-      are in flight; reference isReady(), ModelMesh.java:1310-1331).
+      down, cluster view synced, and (until first-ready LATCHES, reference
+      reportReady) no peer draining — holds a rolling update at not-yet-
+      ready pods while migrations are in flight without 503ing established
+      pods (reference isReady(), ModelMesh.java:1310-1331).
     - GET /live — 200 while the process serves HTTP at all.
     """
 

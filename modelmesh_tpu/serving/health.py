@@ -2,11 +2,14 @@
 
 Reference behaviors re-derived (not transcribed):
 
-- Readiness (ModelMesh.java:1310-1331): an instance answers NOT ready while
-  any peer in the fleet advertises shutting-down. A rolling update's
-  readiness probe then holds the rollout — the next pod isn't torn down
-  until migrations off the draining pod finish (its record disappears when
-  its session lease is revoked).
+- Readiness (ModelMesh.java:1310-1331): an instance that has NEVER yet
+  reported ready holds while any peer in the fleet advertises
+  shutting-down; once an instance reports ready the state LATCHES
+  (reference reportReady) and only a local shutdown un-readies it. A
+  rolling update's readiness probe then holds the rollout at the new pod —
+  the next pod isn't torn down until migrations off the draining pod
+  finish (its record disappears when its session lease is revoked) —
+  without flipping established pods out of the Service.
 - Bootstrap probation (ModelMesh.java:1335-1419): during a startup window,
   repeated early load failures with zero successful loads mean the runtime
   or image is poisoned; the process aborts non-zero so the rollout FAILS at
@@ -29,18 +32,39 @@ DEFAULT_PROBATION_MAX_FAILURES = 3
 
 
 class ReadinessGate:
-    """Answers the /ready probe from live cluster state."""
+    """Answers the /ready probe from live cluster state.
+
+    Readiness LATCHES after the first successful report, mirroring the
+    reference's one-way ``reportReady`` flag (ModelMesh.java:1310-1331):
+    only pods that have never been ready are held back by a draining
+    peer. Without the latch, one draining pod would flip every
+    established pod to 503 and Kubernetes would empty the Service's
+    endpoints — a fleet-wide outage on every rolling-update step.
+    A local shutdown still un-readies this pod regardless of the latch.
+    """
 
     def __init__(self, instance) -> None:
         self.instance = instance
+        self._latched = False
 
     def is_ready(self) -> tuple[bool, str]:
         inst = self.instance
         if inst.shutting_down:
             return False, "shutting down"
+        if self._latched:
+            return True, "ok (latched)"
+        # Don't latch off an UNSYNCED view: at bootstrap the kubelet can
+        # probe before the KV watch has populated instances_view — an
+        # empty view shows no draining peer and would latch ready while a
+        # migration off a draining pod is still in flight. Our own record
+        # appearing proves the view has caught up to at least our own
+        # registration, which pre_start publishes before serving.
+        if inst.instance_id not in inst.instances_view:
+            return False, "cluster view not yet synced"
         for iid, rec in inst.instances_view.items():
             if iid != inst.instance_id and rec.shutting_down:
                 return False, f"peer {iid} draining (rolling update in flight)"
+        self._latched = True
         return True, "ok"
 
 

@@ -1,0 +1,233 @@
+"""etcd v3 wire-contract conformance for kv/etcd_server.py.
+
+The reference validates its coordination clients against a real forked
+etcd per suite (AbstractModelMeshTest.java:83-192); this image has no etcd
+binary and zero egress, so the in-repo wire server must EARN trust by
+conforming to the public etcd v3 contract at the raw-stub level — not just
+against the repo's own client. Round-2 ADVICE items pinned here:
+
+- RangeResponse.count is the TOTAL in-range key count regardless of limit
+  (clients paginate on it), with ``more`` set when truncated.
+- DeleteRange is atomic: list+delete under one store lock, no interleaved
+  writer effects.
+- Watch floor check + registration is atomic: a create whose
+  start_revision is at/below the compact floor is answered
+  created + canceled(compact_revision) — never the PUT-only full-state
+  fallback with no cancel notice.
+"""
+
+import queue
+import threading
+import time
+
+import grpc
+import pytest
+
+from modelmesh_tpu.kv.etcd_server import (
+    _KV_METHODS,
+    _KV_SERVICE,
+    _LEASE_METHODS,
+    _LEASE_SERVICE,
+    start_etcd_server,
+)
+from modelmesh_tpu.kv.memory import InMemoryKV
+from modelmesh_tpu.proto import etcd_rpc_pb2 as epb
+from modelmesh_tpu.runtime import grpc_defs
+
+
+@pytest.fixture()
+def wire():
+    backing = InMemoryKV(sweep_interval_s=0.05)
+    server, port, store = start_etcd_server(store=backing)
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    kv = grpc_defs.make_stub(channel, _KV_SERVICE, _KV_METHODS)
+    lease = grpc_defs.make_stub(channel, _LEASE_SERVICE, _LEASE_METHODS)
+    yield kv, lease, channel, store
+    channel.close()
+    server.stop(0)
+    backing.close()
+
+
+def _prefix_end(prefix: bytes) -> bytes:
+    return prefix[:-1] + bytes([prefix[-1] + 1])
+
+
+class TestRangePagination:
+    def test_count_is_total_regardless_of_limit(self, wire):
+        kv, _, _, _ = wire
+        for i in range(10):
+            kv.Put(epb.PutRequest(key=f"p/{i:02d}".encode(), value=b"v"))
+        r = kv.Range(epb.RangeRequest(
+            key=b"p/", range_end=_prefix_end(b"p/"), limit=3
+        ))
+        assert len(r.kvs) == 3
+        assert r.count == 10, "count must be the unlimited total"
+        assert r.more is True
+        r2 = kv.Range(epb.RangeRequest(key=b"p/", range_end=_prefix_end(b"p/")))
+        assert len(r2.kvs) == 10 and r2.count == 10 and r2.more is False
+
+    def test_paginate_to_completion_via_count(self, wire):
+        kv, _, _, _ = wire
+        for i in range(7):
+            kv.Put(epb.PutRequest(key=f"q/{i}".encode(), value=b"v"))
+        seen: list[bytes] = []
+        start = b"q/"
+        while True:
+            r = kv.Range(epb.RangeRequest(
+                key=start, range_end=_prefix_end(b"q/"), limit=2
+            ))
+            seen.extend(k.key for k in r.kvs)
+            if not r.more:
+                break
+            start = r.kvs[-1].key + b"\x00"
+        assert seen == [f"q/{i}".encode() for i in range(7)]
+
+
+class TestDeleteRangeAtomicity:
+    def test_deleted_count_and_revision(self, wire):
+        kv, _, _, store = wire
+        for i in range(5):
+            kv.Put(epb.PutRequest(key=f"d/{i}".encode(), value=b"v"))
+        rev_before = store.revision
+        r = kv.DeleteRange(epb.DeleteRangeRequest(
+            key=b"d/", range_end=_prefix_end(b"d/")
+        ))
+        assert r.deleted == 5
+        assert r.header.revision == rev_before + 5
+
+    def test_concurrent_writer_cannot_interleave(self, wire):
+        """Hammer DeleteRange against a writer re-putting in-range keys.
+        Atomic DeleteRange means: after each delete response, every key it
+        reported deleting was gone at one instant — a key observed right
+        after the response is one the writer re-created AFTER the
+        linearization point, so its create_revision must exceed the
+        delete's header revision."""
+        kv, _, _, _ = wire
+        stop = threading.Event()
+
+        def writer():
+            j = 0
+            while not stop.is_set():
+                kv.Put(epb.PutRequest(key=b"x/k", value=str(j).encode()))
+                j += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            for _ in range(50):
+                r = kv.DeleteRange(epb.DeleteRangeRequest(
+                    key=b"x/", range_end=_prefix_end(b"x/")
+                ))
+                after = kv.Range(epb.RangeRequest(
+                    key=b"x/", range_end=_prefix_end(b"x/")
+                ))
+                for item in after.kvs:
+                    assert item.create_revision > r.header.revision, (
+                        "key surviving an atomic DeleteRange must have been "
+                        "re-created after it"
+                    )
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+
+class TestWatchCompactFloor:
+    def _watch_stream(self, channel):
+        req_q: "queue.Queue" = queue.Queue()
+
+        def req_iter():
+            while True:
+                item = req_q.get()
+                if item is None:
+                    return
+                yield item.SerializeToString()
+
+        call = channel.stream_stream(
+            "/etcdserverpb.Watch/Watch",
+            request_serializer=lambda b: b,
+            response_deserializer=epb.WatchResponse.FromString,
+        )(req_iter())
+        return req_q, call
+
+    def test_create_below_floor_gets_canceled_with_compact_revision(self, wire):
+        kv, _, channel, store = wire
+        for i in range(5):
+            kv.Put(epb.PutRequest(key=b"w/k", value=str(i).encode()))
+        kv.Compact(epb.CompactionRequest(revision=store.revision))
+        floor = store.compact_rev
+        req_q, call = self._watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"w/", range_end=_prefix_end(b"w/"), start_revision=1,
+        )))
+        created = next(iter(call))
+        assert created.created is True
+        canceled = next(iter(call))
+        assert canceled.canceled is True
+        assert canceled.compact_revision == floor + 1
+        req_q.put(None)
+
+    def test_create_at_floor_plus_one_streams_normally(self, wire):
+        kv, _, channel, store = wire
+        kv.Put(epb.PutRequest(key=b"w2/k", value=b"v0"))
+        kv.Compact(epb.CompactionRequest(revision=store.revision))
+        req_q, call = self._watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"w2/", range_end=_prefix_end(b"w2/"),
+            start_revision=store.compact_rev + 1,
+        )))
+        it = iter(call)
+        assert next(it).created is True
+        kv.Put(epb.PutRequest(key=b"w2/k", value=b"v1"))
+        resp = next(it)
+        assert resp.events and resp.events[0].kv.value == b"v1"
+        req_q.put(None)
+
+    def test_floor_check_and_registration_are_atomic(self, wire):
+        """Race compactions against watch creates: every create must be
+        answered either with a live stream that replays correctly or with
+        canceled+compact_revision — NEVER a silent full-state fallback
+        (which InMemoryKV would take if registration slipped past a
+        concurrent floor advance)."""
+        kv, _, channel, store = wire
+        kv.Put(epb.PutRequest(key=b"w3/k", value=b"seed"))
+        stop = threading.Event()
+
+        def compactor():
+            while not stop.is_set():
+                kv.Put(epb.PutRequest(key=b"w3/churn", value=b"x"))
+                kv.Compact(epb.CompactionRequest(revision=store.revision))
+
+        t = threading.Thread(target=compactor, daemon=True)
+        t.start()
+        try:
+            for _ in range(30):
+                start_rev = max(1, store.compact_rev)  # hover near the floor
+                req_q, call = self._watch_stream(channel)
+                req_q.put(epb.WatchRequest(
+                    create_request=epb.WatchCreateRequest(
+                        key=b"w3/", range_end=_prefix_end(b"w3/"),
+                        start_revision=start_rev,
+                    )
+                ))
+                it = iter(call)
+                first = next(it)
+                assert first.created is True
+                # Either outcome is conformant; a cancel MUST carry the
+                # compact_revision hint.
+                deadline = time.monotonic() + 5
+                outcome = None
+                while time.monotonic() < deadline:
+                    resp = next(it)
+                    if resp.canceled:
+                        assert resp.compact_revision > 0
+                        outcome = "canceled"
+                        break
+                    if resp.events:
+                        outcome = "streaming"
+                        break
+                assert outcome is not None
+                req_q.put(None)
+                call.cancel()
+        finally:
+            stop.set()
+            t.join(timeout=5)

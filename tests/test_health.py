@@ -62,30 +62,58 @@ class TestProbationUnit:
 
 
 class TestReadinessGateCluster:
-    def test_not_ready_while_peer_drains_then_recovers(self):
+    def test_unready_pods_hold_while_peer_drains_then_recover(self):
+        """Only pods that have NEVER reported ready hold during a drain;
+        established pods stay ready (the latch). Mirrors the reference's
+        one-way reportReady flag — without the latch, one draining pod
+        would 503 every pod and empty the Service's endpoints."""
         from tests.cluster_util import Cluster
 
         c = Cluster(n=3)
         try:
-            gates = [ReadinessGate(p.instance) for p in c.pods]
-            for g in gates:
-                ok, reason = g.is_ready()
-                assert ok, reason
+            # gate 0 reports ready once (latches); gate 1 never probes yet.
+            latched = ReadinessGate(c[0].instance)
+            ok, reason = latched.is_ready()
+            assert ok, reason
+            fresh = ReadinessGate(c[1].instance)
             # Pod 2 starts draining (what SIGTERM's pre_shutdown publishes
-            # first): peers must flip to not-ready.
+            # first): the un-latched gate must hold; the latched one must
+            # keep reporting ready.
             draining = c[2].instance
             draining.shutting_down = True
             draining.publish_instance_record(force=True)
-            assert _wait(lambda: not gates[0].is_ready()[0])
-            assert not gates[1].is_ready()[0]
-            assert "draining" in gates[0].is_ready()[1]
-            # Its own gate reports shutting down, not peer-draining.
-            assert gates[2].is_ready() == (False, "shutting down")
+            # Wait for the drain record to reach pod 1's view WITHOUT
+            # probing (a premature probe would latch ready).
+            assert _wait(lambda: any(
+                rec.shutting_down
+                for iid, rec in c[1].instance.instances_view.items()
+                if iid != c[1].instance.instance_id
+            ))
+            assert not fresh.is_ready()[0]
+            assert "draining" in fresh.is_ready()[1]
+            assert latched.is_ready()[0], "latched gate must not flip"
+            # Its own gate reports shutting down, not peer-draining —
+            # and local shutdown overrides any latch.
+            own = ReadinessGate(draining)
+            assert own.is_ready() == (False, "shutting down")
             # Migration completes and the pod exits: record disappears,
-            # peers become ready again.
+            # the fresh gate becomes ready (and latches).
             c[2].stop()
-            assert _wait(lambda: gates[0].is_ready()[0], timeout=15)
-            assert gates[1].is_ready()[0]
+            assert _wait(lambda: fresh.is_ready()[0], timeout=15)
+            assert "latched" in fresh.is_ready()[1]
+        finally:
+            c.close()
+
+    def test_latch_does_not_mask_local_shutdown(self):
+        from tests.cluster_util import Cluster
+
+        c = Cluster(n=1)
+        try:
+            g = ReadinessGate(c[0].instance)
+            assert g.is_ready()[0]
+            c[0].instance.shutting_down = True
+            assert g.is_ready() == (False, "shutting down")
+            c[0].instance.shutting_down = False
         finally:
             c.close()
 

@@ -53,6 +53,23 @@ class TestPlanWire:
         finally:
             kv.close()
 
+    def test_max_plan_bytes_env_knob_is_honored(self, monkeypatch):
+        """MM_MAX_PLAN_BYTES (round-2 ADVICE low: the registered knob was
+        silently ignored) defaults publish_plan's budget."""
+        monkeypatch.setenv("MM_MAX_PLAN_BYTES", "2048")
+        placements = {
+            f"model-{i}": [f"inst-{j}" for j in range(8)] for i in range(5000)
+        }
+        plan = GlobalPlan(placements, now_ms(), 1.0, generation=1)
+        kv = InMemoryKV()
+        try:
+            n = publish_plan(kv, "mm", plan)  # no explicit max_bytes
+            assert n <= 2048
+            stored = GlobalPlan.from_bytes(kv.get(plan_key("mm")).value)
+            assert 0 < len(stored.placements) < 5000
+        finally:
+            kv.close()
+
 
 class TestFollower:
     def test_initial_read_then_watch_updates_then_clear(self):

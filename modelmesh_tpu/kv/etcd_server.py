@@ -97,12 +97,16 @@ class EtcdLiteServicer:
             total = len(kvs)
             if req.limit:
                 kvs = kvs[: req.limit]
-            return epb.RangeResponse(
-                header=self._header(),
-                kvs=[_to_mvcc(kv) for kv in kvs],
-                count=total,
-                more=total > len(kvs),
-            )
+            revision = self.store.revision
+        # Protobuf construction happens OUTSIDE the lock — a large range
+        # (full registry scan) must not stall every put/lease-sweep/watch
+        # behind message serialization.
+        return epb.RangeResponse(
+            header=epb.ResponseHeader(revision=revision),
+            kvs=[_to_mvcc(kv) for kv in kvs],
+            count=total,
+            more=total > len(kvs),
+        )
 
     def Range(self, request, context):
         return self._range_response(request)

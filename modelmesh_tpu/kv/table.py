@@ -82,6 +82,11 @@ class KVTable(Generic[R]):
     def _key(self, id_: str) -> str:
         return self.prefix + id_
 
+    def raw_key(self, id_: str) -> str:
+        """Fully-qualified store key for ``id_`` — for callers composing
+        multi-key store.txn()s across tables (e.g. vmodel promotion)."""
+        return self._key(id_)
+
     def get(self, id_: str) -> Optional[R]:
         kv = self.store.get(self._key(id_))
         if kv is None:

@@ -103,6 +103,52 @@ class TestMultiProcess:
         ch0.close()
         ch1.close()
 
+    def test_vmodel_rollover_across_processes(self, procs):
+        """VModel create -> version rollover -> delete, through a real
+        process over the networked KV. Regression: the transition's
+        registry re-read raced the loader thread's promote CAS (entry goes
+        ACTIVE before the registry write lands over the wire) and parked
+        EVERY transition as FAILED on the etcd tier; the transition now
+        polls for registry progress."""
+        spawned, _ = procs
+        (_, ep0), _ = spawned
+        ch = grpc.insecure_channel(ep0)
+        api = grpc_defs.make_stub(
+            ch, grpc_defs.API_SERVICE, grpc_defs.API_METHODS
+        )
+
+        def set_vm(target):
+            return api.SetVModel(apb.SetVModelRequest(
+                vmodel_id="mp-vm", target_model_id=target,
+                info=apb.ModelInfo(model_type="example", model_path="mem://v"),
+                load_now=True, sync=True, auto_delete_target=True,
+            ), timeout=90)
+
+        st = set_vm("mp-vm-1")
+        assert st.active_model_id == "mp-vm-1"
+        st = set_vm("mp-vm-2")
+        assert st.active_model_id == "mp-vm-2", (
+            f"transition parked: {st.transition}"
+        )
+        # Old version auto-deleted in the same promotion txn.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            s = api.GetModelStatus(
+                apb.GetModelStatusRequest(model_id="mp-vm-1"), timeout=10
+            )
+            if s.status == apb.NOT_FOUND:
+                break
+            time.sleep(0.1)
+        assert s.status == apb.NOT_FOUND
+        api.DeleteVModel(
+            apb.DeleteVModelRequest(vmodel_id="mp-vm"), timeout=10
+        )
+        s2 = api.GetModelStatus(
+            apb.GetModelStatusRequest(model_id="mp-vm-2"), timeout=10
+        )
+        assert s2.status == apb.NOT_FOUND
+        ch.close()
+
     def test_sigterm_migration_between_processes(self, procs):
         spawned, kv_port = procs
         (proc0, ep0), (proc1, ep1) = spawned

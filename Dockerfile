@@ -15,7 +15,12 @@ RUN apt-get update && apt-get install -y --no-install-recommends g++ \
 WORKDIR /opt/modelmesh-tpu
 COPY modelmesh_tpu/ modelmesh_tpu/
 COPY protos/ protos/
-RUN g++ -O2 -shared -fPIC -o modelmesh_tpu/native/libsplicer.so \
+# Output path MUST match native/proto_splicer.py's _SO_PATH — the runtime
+# image has no g++ (and runs as USER 65532), so an on-demand rebuild fails
+# silently into the slow Python fallback if this lands anywhere else.
+# Pinned by tests/test_splicer.py::TestImageContract.
+RUN mkdir -p modelmesh_tpu/native/_build \
+    && g++ -O2 -shared -fPIC -o modelmesh_tpu/native/_build/libmmsplicer.so \
         modelmesh_tpu/native/splicer.cc
 
 FROM ${BASE_IMAGE}

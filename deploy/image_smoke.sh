@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Image smoke test: build the serving image and prove the native splicer
+# loads INSIDE the container (no g++, USER 65532) — the round-2 failure
+# mode was the image building the .so to a path the loader never checks,
+# silently degrading every in-body id extraction to the Python fallback.
+#
+# Usage: deploy/image_smoke.sh BASE_IMAGE   (an image carrying jax+numpy)
+# Requires docker (or podman via DOCKER=podman). The CI image used for the
+# unit suite has no container runtime; there the same contract is pinned by
+# tests/test_splicer.py::TestImageContract.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DOCKER="${DOCKER:-docker}"
+# No default: the Dockerfile's compute-stack gate (import jax, numpy)
+# fails on bare python:3.12-slim by design — the base must carry jax.
+if [[ $# -lt 1 ]]; then
+    echo "usage: $0 BASE_IMAGE   (an image carrying jax+numpy)" >&2
+    exit 2
+fi
+BASE_IMAGE="$1"
+TAG=modelmesh-tpu-smoke
+
+"$DOCKER" build --build-arg "BASE_IMAGE=$BASE_IMAGE" -t "$TAG" .
+
+# 1. The native splicer must load in the runtime image (no toolchain).
+#    (-i: the heredoc rides stdin into `python -`.)
+"$DOCKER" run --rm -i --entrypoint python "$TAG" - <<'EOF'
+from modelmesh_tpu.native import proto_splicer
+assert proto_splicer._ensure_native(), "native splicer failed to load"
+assert proto_splicer.backend == "native", proto_splicer.backend
+print("SMOKE: native splicer OK")
+EOF
+
+# 2. The entrypoint must come up with the fake runtime and answer /live.
+CID=$("$DOCKER" run -d "$TAG" --runtime fake --port 8033 --prestop-port 8090)
+trap '"$DOCKER" rm -f "$CID" >/dev/null' EXIT
+for _ in $(seq 1 60); do
+    if "$DOCKER" exec "$CID" python -c \
+        "import urllib.request as u; u.urlopen('http://127.0.0.1:8090/live', timeout=2)" \
+        2>/dev/null; then
+        echo "SMOKE: entrypoint live OK"
+        exit 0
+    fi
+    sleep 1
+done
+echo "SMOKE FAILED: entrypoint never became live" >&2
+"$DOCKER" logs "$CID" >&2
+exit 1

@@ -41,11 +41,27 @@ def _ensure_native():
             if not os.path.exists(_SO_PATH) or (
                 os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC)
             ):
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-o", _SO_PATH, _SRC],
-                    check=True, capture_output=True, timeout=120,
-                )
+                try:
+                    os.makedirs(_BUILD_DIR, exist_ok=True)
+                    subprocess.run(
+                        ["g++", "-O2", "-shared", "-fPIC", "-o", _SO_PATH,
+                         _SRC],
+                        check=True, capture_output=True, timeout=120,
+                    )
+                except (FileNotFoundError, PermissionError):
+                    # No toolchain / read-only install (the container ships
+                    # a prebuilt .so and no g++): a stale-looking prebuilt
+                    # is still the native scanner — load it rather than
+                    # silently dropping to the slow Python fallback. A
+                    # genuine COMPILE failure (CalledProcessError) must NOT
+                    # be swallowed here: loading a stale .so over edited
+                    # source would silently diverge native from Python.
+                    if not os.path.exists(_SO_PATH):
+                        raise
+                    log.info(
+                        "splicer rebuild unavailable; loading prebuilt %s",
+                        _SO_PATH,
+                    )
             lib = ctypes.CDLL(_SO_PATH)
             lib.mm_find_path.restype = ctypes.c_int
             lib.mm_find_path.argtypes = [

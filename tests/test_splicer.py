@@ -4,6 +4,11 @@ The property that matters: extraction/splicing must agree with an actual
 protobuf library parse (the reference's ProtoSplicerTest strategy).
 """
 
+import os
+import subprocess
+import sys
+import time
+
 import pytest
 
 from modelmesh_tpu.native import proto_splicer
@@ -123,3 +128,69 @@ class TestBackends:
             == proto_splicer._find_path_py(data, (2, 1))
         )
         assert proto_splicer.backend == "native"
+
+
+def _run_without_toolchain(assert_msg):
+    """Spawn a fresh interpreter with PATH='' (no g++ findable) that loads
+    the splicer and asserts the NATIVE backend engaged."""
+    code = (
+        "import os; os.environ['PATH']=''\n"
+        "from modelmesh_tpu.native import proto_splicer as ps\n"
+        f"assert ps._ensure_native(), {assert_msg!r}\n"
+        "assert ps.backend == 'native', ps.backend\n"
+        "print('NATIVE-OK')\n"
+    )
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=60,
+        env={k: v for k, v in os.environ.items() if k != "PATH"},
+    )
+
+
+class TestImageContract:
+    """Round-2 VERDICT weak #5: the image built the .so to a path the
+    loader never looks at, so every containerized id-extraction silently
+    ran the slow Python fallback (no g++ at runtime, USER 65532). Pin the
+    Dockerfile<->loader path contract and the no-toolchain load path."""
+
+    def test_dockerfile_builds_to_loader_path(self):
+        import re
+
+        repo_root = os.path.dirname(os.path.dirname(proto_splicer._HERE))
+        dockerfile = os.path.join(repo_root, "Dockerfile")
+        text = open(dockerfile).read()
+        m = re.search(r"g\+\+ .*-shared.*-o\s+(\S+)", text)
+        assert m, "no g++ build line in Dockerfile"
+        built = m.group(1)
+        expected = os.path.relpath(proto_splicer._SO_PATH, repo_root)
+        assert built == expected, (
+            f"Dockerfile builds {built}; loader expects {expected}"
+        )
+
+    def test_prebuilt_so_loads_without_toolchain(self):
+        """The runtime-image scenario: .so prebuilt, g++ absent. The loader
+        must pick up the prebuilt native backend, not fall back to python."""
+        lib = proto_splicer._ensure_native()
+        if not lib:
+            pytest.skip("no toolchain to prebuild with")
+        out = _run_without_toolchain("prebuilt .so did not load")
+        assert out.returncode == 0, out.stderr
+        assert "NATIVE-OK" in out.stdout
+
+    def test_stale_looking_prebuilt_still_loads_without_toolchain(self):
+        """Container COPY can land source mtimes AFTER the .so's: with no
+        g++ the loader must load the 'stale' prebuilt anyway."""
+        lib = proto_splicer._ensure_native()
+        if not lib:
+            pytest.skip("no toolchain to prebuild with")
+        # Make the source look newer than the .so, as a COPY might.
+        src_mtime = os.path.getmtime(proto_splicer._SRC)
+        os.utime(proto_splicer._SO_PATH,
+                 (src_mtime - 3600, src_mtime - 3600))
+        try:
+            out = _run_without_toolchain("stale prebuilt did not load")
+            assert out.returncode == 0, out.stderr
+            assert "NATIVE-OK" in out.stdout
+        finally:
+            now = time.time()
+            os.utime(proto_splicer._SO_PATH, (now, now))

@@ -127,6 +127,27 @@ class EtcdKV(KVStore):
         )
         return sorted((_to_kv(kv) for kv in resp.kvs), key=lambda kv: kv.key)
 
+    def range_from(self, prefix: str, start_key: str, limit: int):
+        # Server-side limited read: [start_key, end(prefix)) with limit —
+        # the etcd pagination idiom (count/more are ignored here; the
+        # base-class range_paged stops on a short page). start_key is
+        # clamped INTO the prefix: a start below it would make etcd scan
+        # [start, end) across unrelated prefixes — a cross-prefix leak the
+        # in-memory tier's startswith filter never exhibits.
+        start = max(start_key, prefix)
+        resp = self._kv.Range(
+            epb.RangeRequest(
+                key=start.encode(),
+                range_end=_prefix_range_end(prefix.encode()),
+                limit=limit,
+            ),
+            timeout=self._timeout,
+        )
+        return sorted(
+            (_to_kv(kv) for kv in resp.kvs if kv.key.decode().startswith(prefix)),
+            key=lambda kv: kv.key,
+        )
+
     # -- writes -----------------------------------------------------------
 
     def max_value_bytes(self):

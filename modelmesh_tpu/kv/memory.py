@@ -62,6 +62,9 @@ class InMemoryKV(KVStore):
         self._history: list[WatchEvent] = []
         self._history_cap = max(16, history_cap)
         self._compact_rev = 0
+        # Sorted key index for range_from, rebuilt lazily when stale.
+        self._sorted_keys: list[str] = []
+        self._sorted_keys_rev = -1
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="kv-dispatch", daemon=True
         )
@@ -86,6 +89,28 @@ class InMemoryKV(KVStore):
                 (kv for k, kv in self._data.items() if k.startswith(prefix)),
                 key=lambda kv: kv.key,
             )
+
+    def range_from(self, prefix: str, start_key: str, limit: int) -> list[KeyValue]:
+        # Bisect over a revision-cached sorted key index: paged scans (the
+        # bucketed registry issues >=128 of these per full iteration, and
+        # janitor cycles repeat them) must not re-scan and re-sort the
+        # whole keyspace per page.
+        import bisect
+
+        with self._lock:
+            if self._sorted_keys_rev != self._rev:
+                self._sorted_keys = sorted(self._data)
+                self._sorted_keys_rev = self._rev
+            keys = self._sorted_keys
+            i = bisect.bisect_left(keys, max(start_key, prefix))
+            out = []
+            while i < len(keys) and len(out) < limit:
+                k = keys[i]
+                if not k.startswith(prefix):
+                    break  # sorted + start>=prefix: past the prefix block
+                out.append(self._data[k])
+                i += 1
+            return out
 
     def range_interval(self, start: str, end: str) -> list[KeyValue]:
         """Keys in [start, end) — etcd Range semantics; end "" = exact key."""

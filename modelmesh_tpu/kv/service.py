@@ -78,9 +78,15 @@ class MeshKVServicer:
         return kpb.GetResponse(kv=_to_proto(kv), found=True)
 
     def RangePrefix(self, request, context):
-        return kpb.RangeResponse(
-            kvs=[_to_proto(kv) for kv in self.store.range(request.prefix)]
-        )
+        if request.start_key or request.limit:
+            kvs = self.store.range_from(
+                request.prefix,
+                request.start_key or request.prefix,
+                request.limit or (1 << 31),
+            )
+        else:
+            kvs = self.store.range(request.prefix)
+        return kpb.RangeResponse(kvs=[_to_proto(kv) for kv in kvs])
 
     def Put(self, request, context):
         # Server-side limit enforcement: the client's env may disagree with
@@ -285,6 +291,13 @@ class RemoteKV(KVStore):
     def range(self, prefix: str) -> list[KeyValue]:
         resp = self._stub.RangePrefix(
             kpb.RangeRequest(prefix=prefix), timeout=self._timeout
+        )
+        return [_from_proto(kv) for kv in resp.kvs]
+
+    def range_from(self, prefix: str, start_key: str, limit: int):
+        resp = self._stub.RangePrefix(
+            kpb.RangeRequest(prefix=prefix, start_key=start_key, limit=limit),
+            timeout=self._timeout,
         )
         return [_from_proto(kv) for kv in resp.kvs]
 

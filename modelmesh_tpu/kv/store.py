@@ -85,6 +85,33 @@ class KVStore(abc.ABC):
     @abc.abstractmethod
     def range(self, prefix: str) -> list[KeyValue]: ...
 
+    def range_from(
+        self, prefix: str, start_key: str, limit: int
+    ) -> list[KeyValue]:
+        """Up to ``limit`` keys under ``prefix`` with key >= ``start_key``,
+        sorted. The pagination primitive behind range_paged; backends
+        override with a server-side limited read (base impl slices a full
+        range — correct but unbounded on the wire)."""
+        kvs = [kv for kv in self.range(prefix) if kv.key >= start_key]
+        return kvs[:limit]
+
+    def range_paged(self, prefix: str, page_size: int = 1000):
+        """Stream a prefix in bounded pages (generator of KeyValue).
+
+        At registry scale (100k+ records) a single range() response blows
+        the 16 MiB message cap and holds tens of MB of protos at once;
+        start-key pagination keeps every RPC and the client's working set
+        bounded. Not a snapshot: concurrent writes may or may not appear,
+        like iterating a live dict.
+        """
+        start = prefix
+        while True:
+            page = self.range_from(prefix, start, page_size)
+            yield from page
+            if len(page) < page_size:
+                return
+            start = page[-1].key + "\x00"
+
     # -- writes -----------------------------------------------------------
 
     @abc.abstractmethod

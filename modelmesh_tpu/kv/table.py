@@ -6,10 +6,14 @@ ModelMesh.java:582-628, 783-791): JSON-serialized records with versioned CAS
 (conditionalSetAndGet idiom, e.g. ModelMesh.java:5200-5255), and a local
 cache view maintained by a prefix watch with add/update/delete listeners.
 
-The reference shards its registry watch over 128 fixed buckets
-(ModelMesh.java:169) as an etcd watch-fanout optimization; our store watch
-is a single prefix stream, so bucketing is unnecessary — key layout stays
-flat `<prefix>/<id>`.
+The reference shards its registry over 128 fixed buckets
+(ModelMesh.java:169) for watch-fanout and scan scalability.
+BucketedKVTable mirrors that for the registry: keys live under
+`<prefix>/<bucket-hex>/<id>` so scans proceed bucket-by-bucket in bounded
+pages (a flat 100k-record range() response would blow the 16 MiB message
+cap); the single prefix watch still covers every bucket, so TableView
+needs no fan-in. Other tables (instances, vmodels) stay flat
+`<prefix>/<id>` — their cardinality is bounded by fleet size.
 """
 
 from __future__ import annotations
@@ -118,9 +122,16 @@ class KVTable(Generic[R]):
     def delete(self, id_: str) -> bool:
         return self.store.delete(self._key(id_))
 
-    def items(self) -> Iterator[tuple[str, R]]:
-        for kv in self.store.range(self.prefix):
-            yield kv.key[len(self.prefix):], self.record_cls.from_bytes(
+    def key_to_id(self, key: str) -> str:
+        """Store key -> record id (inverse of _key). Overridden by
+        BucketedKVTable; TableView routes every watch event through it."""
+        return key[len(self.prefix):]
+
+    def items(self, page_size: int = 1000) -> Iterator[tuple[str, R]]:
+        """Stream all records in bounded pages — safe at registry scale
+        (one flat range() of 100k records would blow the message cap)."""
+        for kv in self.store.range_paged(self.prefix, page_size):
+            yield self.key_to_id(kv.key), self.record_cls.from_bytes(
                 kv.value, kv.version
             )
 
@@ -149,6 +160,85 @@ class KVTable(Generic[R]):
             except CasFailed:
                 continue
         raise CasFailed(f"update_or_create({id_}): too many CAS conflicts")
+
+
+class BucketedKVTable(KVTable[R]):
+    """KVTable sharded over fixed hash buckets (reference: 128 registry
+    buckets, ModelMesh.java:169).
+
+    Key layout: ``<prefix><bucket-hex>/<id>`` (prefix already ends in "/").
+    Point ops stay O(1) — the bucket derives from the id hash (stable
+    crc32, identical across processes/restarts; NEVER change n_buckets on
+    a live table, existing keys would become unreachable). Scans iterate
+    bucket-by-bucket so no single range RPC carries more than one bucket
+    (~1/n_buckets of the table) per page. The whole table still nests
+    under one prefix, so a TableView's single prefix watch covers every
+    bucket without fan-in.
+
+    Legacy FLAT keys (``<prefix><id>`` from pre-bucketing versions) are
+    lazily migrated: a get() that misses the bucketed key falls back to
+    the flat key and, on a hit, atomically moves the record into its
+    bucket (txn: create-bucketed + delete-flat) so subsequent CAS ops see
+    one canonical key. During a mixed-version rolling update old pods
+    keep finding records via their flat reads until they restart; scans
+    (items()) see only migrated records, so run the upgrade before
+    relying on scan-driven features at scale.
+    """
+
+    def __init__(
+        self, store: KVStore, prefix: str, record_cls: Type[R],
+        n_buckets: int = 128,
+    ):
+        super().__init__(store, prefix, record_cls)
+        self.n_buckets = n_buckets
+
+    def _bucket(self, id_: str) -> int:
+        import zlib
+
+        return zlib.crc32(id_.encode()) % self.n_buckets
+
+    def _key(self, id_: str) -> str:
+        return f"{self.prefix}{self._bucket(id_):02x}/{id_}"
+
+    def key_to_id(self, key: str) -> str:
+        rest = key[len(self.prefix):]
+        _, _, id_ = rest.partition("/")
+        return id_ or rest  # tolerate stray un-bucketed keys
+
+    def get(self, id_: str) -> Optional[R]:
+        rec = super().get(id_)
+        if rec is not None:
+            return rec
+        # Flat-layout fallback + lazy migration (see class docstring).
+        flat = self.store.get(self.prefix + id_)
+        if flat is None:
+            return None
+        from modelmesh_tpu.kv.store import Compare, Op
+
+        ok, _ = self.store.txn(
+            [Compare(self._key(id_), 0), Compare(flat.key, flat.version)],
+            [Op(self._key(id_), flat.value), Op(flat.key)],
+        )
+        if not ok:
+            # Concurrent migration or write won; canonical key authoritative.
+            return super().get(id_) or self.record_cls.from_bytes(
+                flat.value, flat.version
+            )
+        return super().get(id_)
+
+    def delete(self, id_: str) -> bool:
+        bucketed = super().delete(id_)
+        flat = self.store.delete(self.prefix + id_)
+        return bucketed or flat
+
+    def items(self, page_size: int = 1000) -> Iterator[tuple[str, R]]:
+        for b in range(self.n_buckets):
+            for kv in self.store.range_paged(
+                f"{self.prefix}{b:02x}/", page_size
+            ):
+                yield self.key_to_id(kv.key), self.record_cls.from_bytes(
+                    kv.value, kv.version
+                )
 
 
 class TableView(Generic[R]):
@@ -181,7 +271,7 @@ class TableView(Generic[R]):
 
     def _on_events(self, events: list[WatchEvent]) -> None:
         for ev in events:
-            id_ = ev.kv.key[len(self.table.prefix):]
+            id_ = self.table.key_to_id(ev.kv.key)
             with self._lock:
                 if ev.type is EventType.DELETE:
                     existed = self._cache.pop(id_, None)

@@ -33,7 +33,12 @@ from typing import Callable, Optional
 from modelmesh_tpu.cache.lru import WeightedLRUCache, now_ms
 from modelmesh_tpu.kv.session import LeaderElection, SessionNode
 from modelmesh_tpu.kv.store import CasFailed, KVStore
-from modelmesh_tpu.kv.table import KVTable, TableEvent, TableView
+from modelmesh_tpu.kv.table import (
+    BucketedKVTable,
+    KVTable,
+    TableEvent,
+    TableView,
+)
 from modelmesh_tpu.placement.greedy import GreedyStrategy
 from modelmesh_tpu.placement.strategy import (
     LOAD_HERE,
@@ -258,7 +263,9 @@ class ModelMeshInstance:
         self._kv_failfast: dict[str, int] = {}
 
         prefix = self.config.kv_prefix
-        self.registry: KVTable[ModelRecord] = KVTable(
+        # Bucketed (128): scans page bucket-by-bucket so no range RPC
+        # carries the whole 100k-model registry (reference ModelMesh.java:169).
+        self.registry: KVTable[ModelRecord] = BucketedKVTable(
             store, f"{prefix}/registry", ModelRecord
         )
         self.registry_view: TableView[ModelRecord] = TableView(self.registry)

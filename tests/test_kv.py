@@ -75,6 +75,34 @@ class TestStore:
             kv.put(k, b"v")
         assert [x.key for x in kv.range("p/")] == ["p/a", "p/b", "p/c"]
 
+    def test_range_paged_streams_everything_in_bounded_pages(self, kv):
+        """Registry-scale scans ride start-key pagination on every tier —
+        no single RPC may return more than a page."""
+        for i in range(57):
+            kv.put(f"pg/{i:03d}", str(i).encode())
+        kv.put("pz/outside", b"x")  # prefix boundary respected
+        calls = []
+        real = kv.range_from
+
+        def spy(prefix, start_key, limit):
+            out = real(prefix, start_key, limit)
+            calls.append(len(out))
+            return out
+
+        kv.range_from = spy
+        try:
+            keys = [x.key for x in kv.range_paged("pg/", page_size=10)]
+        finally:
+            kv.range_from = real
+        assert keys == [f"pg/{i:03d}" for i in range(57)]
+        assert max(calls) <= 10 and len(calls) == 6
+
+    def test_range_from_respects_start_and_limit(self, kv):
+        for i in range(9):
+            kv.put(f"rf/{i}", b"v")
+        page = kv.range_from("rf/", "rf/3", 4)
+        assert [x.key for x in page] == ["rf/3", "rf/4", "rf/5", "rf/6"]
+
     def test_cas_put(self, kv):
         kv.put_if_version("a", b"1", 0)  # create
         with pytest.raises(CasFailed):

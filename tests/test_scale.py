@@ -1,0 +1,229 @@
+"""Coordination-plane scale: 10k models through registry, TableView,
+janitor reconcile, and plan publish (round-2 VERDICT missing #2 / next #3).
+
+The registry is bucketed (128 hash buckets, reference ModelMesh.java:169)
+and every scan pages: no single range RPC may carry the whole table — a
+flat 100k-record response would blow the 16 MiB message cap — and cycle
+time and working memory must stay bounded as the registry grows.
+"""
+
+import time
+import tracemalloc
+
+import pytest
+
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.kv.table import BucketedKVTable, TableView
+from modelmesh_tpu.records import ModelRecord
+
+N_MODELS = 10_000
+
+
+@pytest.fixture(scope="module")
+def mesh10k():
+    """One instance + 10k registered models (registered once per module —
+    registration itself is part of the measurement)."""
+    from modelmesh_tpu.runtime import ModelInfo
+    from modelmesh_tpu.runtime.fake import (
+        FakeRuntimeServicer,
+        start_fake_runtime,
+    )
+    from modelmesh_tpu.runtime.sidecar import SidecarRuntime
+    from modelmesh_tpu.serving.instance import (
+        InstanceConfig,
+        ModelMeshInstance,
+    )
+
+    store = InMemoryKV(sweep_interval_s=0.5, history_cap=64 << 10)
+    server, port, servicer = start_fake_runtime(
+        servicer=FakeRuntimeServicer(capacity_bytes=256 << 20)
+    )
+    loader = SidecarRuntime(f"127.0.0.1:{port}", startup_timeout_s=10)
+    inst = ModelMeshInstance(
+        store, loader,
+        InstanceConfig(instance_id="scale-1", load_timeout_s=10,
+                       min_churn_age_ms=0),
+    )
+    info = ModelInfo(model_type="example", model_path="mem://s")
+    t0 = time.perf_counter()
+    for i in range(N_MODELS):
+        inst.register_model(f"sm-{i:05d}", info)
+    register_s = time.perf_counter() - t0
+    yield inst, store, servicer, register_s
+    inst.shutdown()
+    server.stop(0)
+    store.close()
+
+
+class TestRegistryScale:
+    def test_registration_rate(self, mesh10k):
+        _, _, _, register_s = mesh10k
+        # ~0.1 ms/model on the in-memory tier; 10x headroom for slow CI.
+        assert register_s < 30, f"10k registrations took {register_s:.1f}s"
+
+    def test_items_pages_are_bounded(self, mesh10k):
+        """No single range read may return more than a page: spy on the
+        pagination primitive."""
+        inst, store, _, _ = mesh10k
+        calls = []
+        real = store.range_from
+
+        def spy(prefix, start_key, limit):
+            out = real(prefix, start_key, limit)
+            calls.append((len(out), limit))
+            return out
+
+        store.range_from = spy
+        try:
+            n = sum(1 for _ in inst.registry.items(page_size=500))
+        finally:
+            store.range_from = real
+        assert n == N_MODELS
+        assert calls, "items() did not use paged ranges"
+        assert max(c[0] for c in calls) <= 500
+
+    def test_bucketed_layout_and_point_ops(self, mesh10k):
+        inst, store, _, _ = mesh10k
+        reg = inst.registry
+        assert isinstance(reg, BucketedKVTable)
+        # Point read resolves through the bucketed key in O(1) KV gets.
+        mr = reg.get("sm-00042")
+        assert mr is not None and mr.model_type == "example"
+        key = reg.raw_key("sm-00042")
+        assert key.startswith(reg.prefix)
+        bucket_seg = key[len(reg.prefix):].split("/")[0]
+        assert len(bucket_seg) == 2  # two-hex bucket
+        assert reg.key_to_id(key) == "sm-00042"
+        # Buckets are populated reasonably evenly (crc32 over 10k ids:
+        # expect every bucket non-empty, max within ~3x of mean).
+        counts = {}
+        for i in range(N_MODELS):
+            b = reg._bucket(f"sm-{i:05d}")
+            counts[b] = counts.get(b, 0) + 1
+        assert len(counts) == reg.n_buckets
+        assert max(counts.values()) < 3 * (N_MODELS / reg.n_buckets)
+
+    def test_tableview_converges_and_reads_fast(self, mesh10k):
+        inst, _, _, _ = mesh10k
+        inst.registry_view.wait_for(
+            lambda v: len(v) >= N_MODELS, timeout=60
+        )
+        t0 = time.perf_counter()
+        n = len(inst.registry_view.items())
+        lookup = inst.registry_view.get("sm-09999")
+        elapsed = time.perf_counter() - t0
+        assert n >= N_MODELS and lookup is not None
+        assert elapsed < 1.0, f"view reads took {elapsed:.2f}s"
+
+    def test_scan_memory_stays_bounded(self, mesh10k):
+        """Paged iteration must not materialize the table: peak extra
+        memory during a full scan stays far below the table's total
+        footprint (10k records ~ several MB as python objects)."""
+        inst, _, _, _ = mesh10k
+        tracemalloc.start()
+        count = 0
+        for _id, _rec in inst.registry.items(page_size=500):
+            count += 1
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert count == N_MODELS
+        assert peak < 8 << 20, f"scan peaked at {peak / 1e6:.1f} MB"
+
+
+class TestJanitorScale:
+    def test_janitor_cycle_time_bounded(self, mesh10k):
+        """A full janitor reconcile over 10k registered models (cache
+        nearly empty — the common shape: instances hold a slice, the
+        registry holds everything) must complete in seconds, not the
+        cycle interval."""
+        from modelmesh_tpu.serving.tasks import BackgroundTasks
+
+        inst, _, _, _ = mesh10k
+        tasks = BackgroundTasks(inst)
+        t0 = time.perf_counter()
+        tasks._janitor_tick()
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 15, f"janitor cycle took {elapsed:.1f}s at 10k"
+
+
+class TestPlanPublishScale:
+    def test_solve_publish_adopt_10k(self, mesh10k):
+        """Leader-path plan refresh on the real 10k registry: snapshot via
+        paged scan, solve, publish under the byte budget, watch-fed
+        follower adopts."""
+        from modelmesh_tpu.placement.jax_engine import (
+            JaxPlacementStrategy,
+            solve_plan,
+        )
+        from modelmesh_tpu.placement.plan_sync import (
+            PlanFollower,
+            publish_plan,
+        )
+        from modelmesh_tpu.records import InstanceRecord
+
+        inst, store, _, _ = mesh10k
+        t0 = time.perf_counter()
+        records = list(inst.registry.items())
+        snapshot_s = time.perf_counter() - t0
+        assert len(records) == N_MODELS
+        assert snapshot_s < 10, f"paged registry snapshot took {snapshot_s:.1f}s"
+        instances = [
+            (f"i{j}", InstanceRecord(
+                capacity_units=500_000, used_units=100, zone=f"z{j % 3}",
+                lru_ts=1_000,
+            ))
+            for j in range(16)
+        ]
+        plan = solve_plan(records, instances)
+        assert len(plan.placements) == N_MODELS
+        follower = JaxPlacementStrategy()
+        pf = PlanFollower(store, "scale-plan", follower)
+        try:
+            n_bytes = publish_plan(store, "scale-plan", plan)
+            assert n_bytes <= 12 << 20
+            deadline = time.monotonic() + 30
+            while follower.plan is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert follower.plan is not None
+            assert len(follower.plan.placements) > 0
+        finally:
+            pf.close()
+
+
+class TestFlatLayoutMigration:
+    """Pre-bucketing (flat `<prefix><id>`) records must stay reachable and
+    migrate lazily into their bucket on first get()."""
+
+    def test_get_migrates_flat_key(self):
+        store = InMemoryKV(sweep_interval_s=0.5)
+        try:
+            table = BucketedKVTable(store, "mig/registry", ModelRecord)
+            # Simulate a record written by a pre-bucketing version.
+            legacy = ModelRecord(model_type="legacy")
+            store.put("mig/registry/old-model", legacy.to_bytes())
+            got = table.get("old-model")
+            assert got is not None and got.model_type == "legacy"
+            # Migrated: canonical bucketed key exists, flat key gone.
+            assert store.get(table.raw_key("old-model")) is not None
+            assert store.get("mig/registry/old-model") is None
+            # CAS ops work against the canonical key post-migration.
+            got.model_type = "updated"
+            table.conditional_set("old-model", got)
+            assert table.get("old-model").model_type == "updated"
+            # Scans see it now.
+            assert dict(table.items())["old-model"].model_type == "updated"
+        finally:
+            store.close()
+
+    def test_delete_covers_both_layouts(self):
+        store = InMemoryKV(sweep_interval_s=0.5)
+        try:
+            table = BucketedKVTable(store, "mig2/registry", ModelRecord)
+            store.put("mig2/registry/flat-only", ModelRecord().to_bytes())
+            assert table.delete("flat-only") is True
+            assert store.get("mig2/registry/flat-only") is None
+            table.put("bucketed", ModelRecord())
+            assert table.delete("bucketed") is True
+            assert table.get("bucketed") is None
+        finally:
+            store.close()

@@ -37,9 +37,11 @@ class CostWeights:
     # (TypeConstraintManager.java:242-248 getPreferredInstances) — a
     # preference term, not a mask: preferred pools win under equal load but
     # never block placement. Sized BELOW the move term (1.0) so preference
-    # steers NEW placements without migrating already-loaded copies, and
-    # far above the rounding temperature (SolveConfig.tau=0.15) so it
-    # decides ~99% of otherwise-equal draws.
+    # steers NEW placements without migrating already-loaded copies. In the
+    # sampled rounding, cost gaps are amplified by 1/eps (=20 at the
+    # default SolveConfig.eps=0.05) into plan-logit units: this 0.75 gap
+    # becomes 15 logits against Gumbel(0, tau=1.0) noise (std ~1.3), so
+    # preference decides effectively every otherwise-equal draw.
     preference: float = 0.75
     lru_age: float = 0.25   # prefer instances whose cache is oldest (easy eviction)
     zone_spread: float = 0.15  # prefer spreading copies across zones/versions

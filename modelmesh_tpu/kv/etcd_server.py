@@ -129,7 +129,9 @@ class EtcdLiteServicer:
         a key re-put mid-operation must not be deleted, a key created
         in-range mid-operation must not survive. Shared by the unary RPC
         and the Txn branch (reentrant lock)."""
-        with self.store.locked():
+        # batch(): all deletions share ONE revision, like etcd's atomic
+        # DeleteRange (it also holds the store lock for the atomicity).
+        with self.store.batch():
             keys = [
                 kv.key
                 for kv in self._range_locked(
@@ -148,8 +150,9 @@ class EtcdLiteServicer:
     def Txn(self, request, context):
         # One native txn when the guard set maps to the KVStore Compare
         # shape (version EQUAL) — that covers every client in this repo;
-        # other targets evaluated under the same store lock.
-        with self.store.locked():
+        # other targets evaluated under the same store lock. batch():
+        # every write op of the txn shares ONE revision (etcd semantics).
+        with self.store.batch():
             ok = all(self._compare(c) for c in request.compare)
             branch = request.success if ok else request.failure
             # Validate before applying ANY op: a put against a dead lease

@@ -13,6 +13,7 @@ for instance liveness, reference: SessionNode usage at ModelMesh.java:788).
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import queue
 import threading
@@ -62,9 +63,24 @@ class InMemoryKV(KVStore):
         self._history: list[WatchEvent] = []
         self._history_cap = max(16, history_cap)
         self._compact_rev = 0
-        # Sorted key index for range_from, rebuilt lazily when stale.
+        # Sorted key index for range_from, keyed on a MUTATION counter
+        # (not the revision — batched writes reuse one revision, so _rev
+        # cannot uniquely identify keyspace state).
         self._sorted_keys: list[str] = []
-        self._sorted_keys_rev = -1
+        self._sorted_keys_mut = -1
+        self._mutations = 0
+        # Revision batching (etcd txn semantics): all writes inside one
+        # batch() share a single global revision — real etcd stamps every
+        # op of a txn / DeleteRange / lease-revoke with ONE revision, and
+        # clients fence on txn header revisions.
+        self._batch_depth = 0
+        self._batch_rev_allocated = False
+        # Events produced inside a batch buffer here and flush as ONE
+        # delivery per watcher at batch exit: resume fencing everywhere is
+        # strictly-greater on mod_rev, so splitting same-revision events
+        # across deliveries would let a mid-batch disconnect permanently
+        # drop the tail (etcd ships one revision as one WatchResponse).
+        self._batch_events: list[WatchEvent] = []
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="kv-dispatch", daemon=True
         )
@@ -91,16 +107,16 @@ class InMemoryKV(KVStore):
             )
 
     def range_from(self, prefix: str, start_key: str, limit: int) -> list[KeyValue]:
-        # Bisect over a revision-cached sorted key index: paged scans (the
+        # Bisect over a mutation-cached sorted key index: paged scans (the
         # bucketed registry issues >=128 of these per full iteration, and
         # janitor cycles repeat them) must not re-scan and re-sort the
         # whole keyspace per page.
         import bisect
 
         with self._lock:
-            if self._sorted_keys_rev != self._rev:
+            if self._sorted_keys_mut != self._mutations:
                 self._sorted_keys = sorted(self._data)
-                self._sorted_keys_rev = self._rev
+                self._sorted_keys_mut = self._mutations
             keys = self._sorted_keys
             i = bisect.bisect_left(keys, max(start_key, prefix))
             out = []
@@ -156,16 +172,50 @@ class InMemoryKV(KVStore):
         with self._lock:
             return self._put_locked(key, value, lease)
 
+    def _next_rev(self) -> int:
+        """Allocate (or reuse, inside a batch) the next global revision."""
+        if self._batch_depth and self._batch_rev_allocated:
+            return self._rev
+        self._rev += 1
+        if self._batch_depth:
+            self._batch_rev_allocated = True
+        return self._rev
+
+    @contextlib.contextmanager
+    def batch(self):
+        """Context manager: writes inside share ONE global revision (etcd
+        txn/DeleteRange/lease-revoke semantics) and flush to watchers as
+        ONE delivery at exit. Acquires the store lock; nests reentrantly
+        (the outermost batch owns the revision and the flush)."""
+        with self._lock:
+            self._batch_depth += 1
+            try:
+                yield self
+            finally:
+                self._batch_depth -= 1
+                if self._batch_depth == 0:
+                    self._batch_rev_allocated = False
+                    if self._batch_events:
+                        events, self._batch_events = self._batch_events, []
+                        for w in list(self._watchers):
+                            matched = [
+                                ev for ev in events
+                                if ev.kv.key.startswith(w.prefix)
+                            ]
+                            if matched:
+                                self._events.put((w, matched))
+
     def _put_locked(self, key: str, value: bytes, lease: int) -> KeyValue:
         if lease and lease not in self._leases:
             raise ValueError(f"lease {lease} does not exist")
-        self._rev += 1
+        rev = self._next_rev()
+        self._mutations += 1
         prev = self._data.get(key)
         kv = KeyValue(
             key=key,
             value=value,
-            create_rev=prev.create_rev if prev else self._rev,
-            mod_rev=self._rev,
+            create_rev=prev.create_rev if prev else rev,
+            mod_rev=rev,
             version=(prev.version + 1) if prev else 1,
             lease=lease,
         )
@@ -187,14 +237,15 @@ class InMemoryKV(KVStore):
         prev = self._data.pop(key, None)
         if prev is None:
             return False
-        self._rev += 1
+        rev = self._next_rev()
+        self._mutations += 1
         if prev.lease:
             attached = self._leases.get(prev.lease)
             if attached:
                 attached[2].discard(key)
         tomb = KeyValue(
             key=key, value=b"", create_rev=prev.create_rev,
-            mod_rev=self._rev, version=0, lease=0,
+            mod_rev=rev, version=0, lease=0,
         )
         self._emit(WatchEvent(EventType.DELETE, tomb, prev))
         return True
@@ -205,7 +256,7 @@ class InMemoryKV(KVStore):
         on_success: Iterable[Op],
         on_failure: Iterable[Op] = (),
     ) -> tuple[bool, list[KeyValue]]:
-        with self._lock:
+        with self.batch():  # one revision for the whole txn (etcd semantics)
             ok = all(
                 (self._data.get(c.key).version if self._data.get(c.key) else 0)
                 == c.version
@@ -268,6 +319,10 @@ class InMemoryKV(KVStore):
             drop = len(self._history) - self._history_cap // 2
             self._compact_rev = self._history[drop - 1].kv.mod_rev
             del self._history[:drop]
+        if self._batch_depth:
+            # Same-revision events deliver TOGETHER at batch exit.
+            self._batch_events.append(event)
+            return
         for w in list(self._watchers):
             if event.kv.key.startswith(w.prefix):
                 self._events.put((w, [event]))
@@ -305,7 +360,7 @@ class InMemoryKV(KVStore):
             return True
 
     def lease_revoke(self, lease_id: int) -> None:
-        with self._lock:
+        with self.batch():  # all attached keys drop at ONE revision
             entry = self._leases.pop(lease_id, None)
             if entry is None:
                 return
@@ -321,8 +376,9 @@ class InMemoryKV(KVStore):
                 ]
                 for lid in expired:
                     entry = self._leases.pop(lid)
-                    for key in list(entry[2]):
-                        self._delete_locked(key)
+                    with self.batch():  # one revision per expired lease
+                        for key in list(entry[2]):
+                            self._delete_locked(key)
 
     # -- engine surface (wire servers layering protocols over this store) --
 

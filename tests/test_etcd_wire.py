@@ -52,6 +52,27 @@ def _prefix_end(prefix: bytes) -> bytes:
     return prefix[:-1] + bytes([prefix[-1] + 1])
 
 
+def _watch_stream(channel, timeout=30):
+    """Open a raw Watch stream; returns (request_queue, call). The call
+    carries a deadline so a dropped event fails the test instead of
+    wedging it on a blocking next()."""
+    req_q: "queue.Queue" = queue.Queue()
+
+    def req_iter():
+        while True:
+            item = req_q.get()
+            if item is None:
+                return
+            yield item.SerializeToString()
+
+    call = channel.stream_stream(
+        "/etcdserverpb.Watch/Watch",
+        request_serializer=lambda b: b,
+        response_deserializer=epb.WatchResponse.FromString,
+    )(req_iter(), timeout=timeout)
+    return req_q, call
+
+
 class TestRangePagination:
     def test_count_is_total_regardless_of_limit(self, wire):
         kv, _, _, _ = wire
@@ -93,7 +114,9 @@ class TestDeleteRangeAtomicity:
             key=b"d/", range_end=_prefix_end(b"d/")
         ))
         assert r.deleted == 5
-        assert r.header.revision == rev_before + 5
+        # etcd contract: one atomic DeleteRange = ONE revision, however
+        # many keys it removes.
+        assert r.header.revision == rev_before + 1
 
     def test_concurrent_writer_cannot_interleave(self, wire):
         """Hammer DeleteRange against a writer re-putting in-range keys.
@@ -133,21 +156,7 @@ class TestDeleteRangeAtomicity:
 
 class TestWatchCompactFloor:
     def _watch_stream(self, channel):
-        req_q: "queue.Queue" = queue.Queue()
-
-        def req_iter():
-            while True:
-                item = req_q.get()
-                if item is None:
-                    return
-                yield item.SerializeToString()
-
-        call = channel.stream_stream(
-            "/etcdserverpb.Watch/Watch",
-            request_serializer=lambda b: b,
-            response_deserializer=epb.WatchResponse.FromString,
-        )(req_iter())
-        return req_q, call
+        return _watch_stream(channel)
 
     def test_create_below_floor_gets_canceled_with_compact_revision(self, wire):
         kv, _, channel, store = wire
@@ -231,3 +240,307 @@ class TestWatchCompactFloor:
         finally:
             stop.set()
             t.join(timeout=5)
+
+
+class TestTxnCompareEdgeCases:
+    """etcd Compare semantics beyond the version-EQUAL happy path: each
+    target reads its own wire field; absent keys compare as zero-values;
+    the failure branch executes atomically."""
+
+    def test_create_and_mod_revision_targets(self, wire):
+        kv, _, _, _ = wire
+        kv.Put(epb.PutRequest(key=b"t/k", value=b"v1"))
+        r = kv.Range(epb.RangeRequest(key=b"t/k"))
+        create_rev, mod_rev = r.kvs[0].create_revision, r.kvs[0].mod_revision
+        kv.Put(epb.PutRequest(key=b"t/k", value=b"v2"))
+        r2 = kv.Range(epb.RangeRequest(key=b"t/k"))
+        assert r2.kvs[0].create_revision == create_rev
+        assert r2.kvs[0].mod_revision > mod_rev
+        # CREATE target: matches the original create revision.
+        t = kv.Txn(epb.TxnRequest(
+            compare=[epb.Compare(
+                target=epb.Compare.CREATE, key=b"t/k",
+                create_revision=create_rev, result=epb.Compare.EQUAL,
+            )],
+            success=[epb.RequestOp(request_put=epb.PutRequest(
+                key=b"t/ok", value=b"create-matched"))],
+        ))
+        assert t.succeeded is True
+        # MOD target GREATER: current mod_rev > first mod_rev.
+        t2 = kv.Txn(epb.TxnRequest(
+            compare=[epb.Compare(
+                target=epb.Compare.MOD, key=b"t/k",
+                mod_revision=mod_rev, result=epb.Compare.GREATER,
+            )],
+            success=[epb.RequestOp(request_put=epb.PutRequest(
+                key=b"t/ok2", value=b"mod-greater"))],
+        ))
+        assert t2.succeeded is True
+
+    def test_value_compare_and_not_equal(self, wire):
+        kv, _, _, _ = wire
+        kv.Put(epb.PutRequest(key=b"t/v", value=b"abc"))
+        t = kv.Txn(epb.TxnRequest(
+            compare=[epb.Compare(
+                target=epb.Compare.VALUE, key=b"t/v", value=b"abc",
+                result=epb.Compare.EQUAL,
+            )],
+            success=[epb.RequestOp(request_put=epb.PutRequest(
+                key=b"t/v", value=b"xyz"))],
+        ))
+        assert t.succeeded is True
+        t2 = kv.Txn(epb.TxnRequest(
+            compare=[epb.Compare(
+                target=epb.Compare.VALUE, key=b"t/v", value=b"abc",
+                result=epb.Compare.NOT_EQUAL,
+            )],
+            success=[epb.RequestOp(request_put=epb.PutRequest(
+                key=b"t/seen", value=b"ne"))],
+        ))
+        assert t2.succeeded is True
+
+    def test_absent_key_compares_as_zero(self, wire):
+        kv, _, _, _ = wire
+        # version EQUAL 0 on an absent key = etcd's create guard.
+        t = kv.Txn(epb.TxnRequest(
+            compare=[epb.Compare(
+                target=epb.Compare.VERSION, key=b"t/absent", version=0,
+                result=epb.Compare.EQUAL,
+            )],
+            success=[epb.RequestOp(request_put=epb.PutRequest(
+                key=b"t/absent", value=b"created"))],
+        ))
+        assert t.succeeded is True
+        t2 = kv.Txn(epb.TxnRequest(
+            compare=[epb.Compare(
+                target=epb.Compare.VERSION, key=b"t/absent", version=0,
+                result=epb.Compare.EQUAL,
+            )],
+            success=[epb.RequestOp(request_put=epb.PutRequest(
+                key=b"t/absent", value=b"clobbered"))],
+            failure=[epb.RequestOp(request_put=epb.PutRequest(
+                key=b"t/fail-branch", value=b"ran"))],
+        ))
+        assert t2.succeeded is False
+        r = kv.Range(epb.RangeRequest(key=b"t/absent"))
+        assert r.kvs[0].value == b"created"
+        r2 = kv.Range(epb.RangeRequest(key=b"t/fail-branch"))
+        assert r2.kvs and r2.kvs[0].value == b"ran"
+
+    def test_txn_nested_range_honors_limit_and_count(self, wire):
+        kv, _, _, _ = wire
+        for i in range(6):
+            kv.Put(epb.PutRequest(key=f"t/r/{i}".encode(), value=b"v"))
+        t = kv.Txn(epb.TxnRequest(
+            success=[epb.RequestOp(request_range=epb.RangeRequest(
+                key=b"t/r/", range_end=_prefix_end(b"t/r/"), limit=2,
+            ))],
+        ))
+        rr = t.responses[0].response_range
+        assert len(rr.kvs) == 2 and rr.count == 6 and rr.more is True
+
+    def test_txn_mixed_ops_one_revision_batch(self, wire):
+        """All ops in one txn land atomically: reads inside the txn see
+        the txn's own prior writes; header revisions are consistent."""
+        kv, _, _, store = wire
+        rev0 = store.revision
+        t = kv.Txn(epb.TxnRequest(
+            success=[
+                epb.RequestOp(request_put=epb.PutRequest(
+                    key=b"t/m1", value=b"a")),
+                epb.RequestOp(request_range=epb.RangeRequest(key=b"t/m1")),
+                epb.RequestOp(request_delete_range=epb.DeleteRangeRequest(
+                    key=b"t/m1")),
+            ],
+        ))
+        assert t.succeeded
+        assert t.responses[1].response_range.kvs[0].value == b"a"
+        assert t.responses[2].response_delete_range.deleted == 1
+        # etcd contract: ALL write ops of one txn share a single revision.
+        assert store.revision == rev0 + 1
+
+
+class TestLeaseRaces:
+    def test_keepalive_on_expired_lease_reports_zero_ttl(self, wire):
+        kv, lease, channel, store = wire
+        g = lease.LeaseGrant(epb.LeaseGrantRequest(TTL=1))
+        # Let it expire (sweeper interval 0.05s; TTL floor is 1s).
+        time.sleep(1.3)
+        call = channel.stream_stream(
+            "/etcdserverpb.Lease/LeaseKeepAlive",
+            request_serializer=lambda b: b,
+            response_deserializer=epb.LeaseKeepAliveResponse.FromString,
+        )(iter([epb.LeaseKeepAliveRequest(ID=g.ID).SerializeToString()]))
+        resp = next(iter(call))
+        assert resp.TTL == 0, "expired lease must keepalive to TTL=0"
+
+    def test_put_against_dead_lease_fails(self, wire):
+        kv, lease, _, _ = wire
+        g = lease.LeaseGrant(epb.LeaseGrantRequest(TTL=1))
+        lease.LeaseRevoke(epb.LeaseRevokeRequest(ID=g.ID))
+        with pytest.raises(grpc.RpcError) as e:
+            kv.Put(epb.PutRequest(key=b"l/x", value=b"v", lease=g.ID))
+        assert e.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+    def test_txn_put_dead_lease_aborts_whole_txn(self, wire):
+        kv, lease, _, _ = wire
+        g = lease.LeaseGrant(epb.LeaseGrantRequest(TTL=1))
+        lease.LeaseRevoke(epb.LeaseRevokeRequest(ID=g.ID))
+        with pytest.raises(grpc.RpcError):
+            kv.Txn(epb.TxnRequest(success=[
+                epb.RequestOp(request_put=epb.PutRequest(
+                    key=b"l/a", value=b"1")),
+                epb.RequestOp(request_put=epb.PutRequest(
+                    key=b"l/b", value=b"2", lease=g.ID)),
+            ]))
+        # Atomic abort: the FIRST put must not have landed either.
+        r = kv.Range(epb.RangeRequest(key=b"l/a"))
+        assert not r.kvs, "txn half-applied after dead-lease abort"
+
+    def test_revoke_deletes_attached_keys_and_notifies_watch(self, wire):
+        kv, lease, channel, store = wire
+        g = lease.LeaseGrant(epb.LeaseGrantRequest(TTL=60))
+        kv.Put(epb.PutRequest(key=b"l/eph", value=b"v", lease=g.ID))
+        req_q, call = _watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"l/", range_end=_prefix_end(b"l/"))))
+        it = iter(call)
+        assert next(it).created
+        lease.LeaseRevoke(epb.LeaseRevokeRequest(ID=g.ID))
+        resp = next(it)
+        assert resp.events[0].type == epb.MvccEvent.DELETE
+        assert resp.events[0].kv.key == b"l/eph"
+        req_q.put(None)
+
+    def test_keepalive_revoke_race_never_resurrects(self, wire):
+        """Hammer keepalives while a revoke lands, then prove the lease is
+        dead: every keepalive REQUEST SENT after the revoke returned must
+        answer TTL=0. (In-flight responses computed pre-revoke may
+        legitimately carry TTL>0 and are not judged — receive-time
+        heuristics misfire on descheduled clients.)"""
+        kv, lease, channel, _ = wire
+        g = lease.LeaseGrant(epb.LeaseGrantRequest(TTL=2))
+        stop = threading.Event()
+
+        def hammer():
+            call = channel.stream_stream(
+                "/etcdserverpb.Lease/LeaseKeepAlive",
+                request_serializer=lambda b: b,
+                response_deserializer=epb.LeaseKeepAliveResponse.FromString,
+            )
+            req = epb.LeaseKeepAliveRequest(ID=g.ID).SerializeToString()
+
+            def gen():
+                while not stop.is_set():
+                    yield req
+                    time.sleep(0.002)
+
+            for _ in call(gen()):
+                pass  # drain; no judgments on in-flight responses
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        lease.LeaseRevoke(epb.LeaseRevokeRequest(ID=g.ID))
+        # Fresh stream, requests unambiguously AFTER the revoke returned.
+        call = channel.stream_stream(
+            "/etcdserverpb.Lease/LeaseKeepAlive",
+            request_serializer=lambda b: b,
+            response_deserializer=epb.LeaseKeepAliveResponse.FromString,
+        )
+        reqs = [epb.LeaseKeepAliveRequest(ID=g.ID).SerializeToString()] * 5
+        for resp in call(iter(reqs), timeout=10):
+            assert resp.TTL == 0, "keepalive revived a revoked lease"
+        stop.set()
+        t.join(timeout=10)
+
+
+class TestWatchOrderingUnderConcurrentWriters:
+    def test_per_key_versions_gapless_and_revisions_monotone(self, wire):
+        """4 writer threads hammer 8 keys; a prefix watch must deliver,
+        per key, version increments with NO gaps, and mod_revisions
+        non-decreasing across the stream."""
+        kv, _, channel, _ = wire
+        req_q, call = _watch_stream(channel, timeout=60)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"wo/", range_end=_prefix_end(b"wo/"))))
+        it = iter(call)
+        assert next(it).created
+
+        N_WRITERS, WRITES = 4, 50
+        errs = []
+
+        def writer(w):
+            try:
+                for j in range(WRITES):
+                    kv.Put(epb.PutRequest(
+                        key=f"wo/k{(w + j) % 8}".encode(),
+                        value=f"{w}/{j}".encode()))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(w,)) for w in range(N_WRITERS)]
+        for t in threads:
+            t.start()
+        total = N_WRITERS * WRITES
+        seen = 0
+        last_rev = 0
+        versions: dict[bytes, int] = {}
+        deadline = time.monotonic() + 30
+        while seen < total and time.monotonic() < deadline:
+            resp = next(it)
+            for ev in resp.events:
+                seen += 1
+                assert ev.kv.mod_revision >= last_rev, "revision went backwards"
+                last_rev = ev.kv.mod_revision
+                prev = versions.get(ev.kv.key, 0)
+                assert ev.kv.version == prev + 1, (
+                    f"version gap on {ev.kv.key}: {prev} -> {ev.kv.version}"
+                )
+                versions[ev.kv.key] = ev.kv.version
+        for t in threads:
+            t.join(timeout=10)
+        assert not errs and seen == total
+        req_q.put(None)
+
+
+class TestTxnWatchAtomicity:
+    def test_txn_events_arrive_in_one_response(self, wire):
+        """etcd delivers all events of one revision in ONE WatchResponse —
+        resume fencing is strictly-greater on revision, so split delivery
+        would let a mid-batch disconnect drop the tail of a txn forever
+        (e.g. a lease revoke's remaining ephemeral-key DELETEs)."""
+        kv, lease, channel, _ = wire
+        req_q, call = _watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"ta/", range_end=_prefix_end(b"ta/"))))
+        it = iter(call)
+        assert next(it).created
+        kv.Txn(epb.TxnRequest(success=[
+            epb.RequestOp(request_put=epb.PutRequest(key=b"ta/a", value=b"1")),
+            epb.RequestOp(request_put=epb.PutRequest(key=b"ta/b", value=b"2")),
+            epb.RequestOp(request_put=epb.PutRequest(key=b"ta/c", value=b"3")),
+        ]))
+        resp = next(it)
+        assert len(resp.events) == 3, (
+            f"txn events split across deliveries: got {len(resp.events)}"
+        )
+        assert len({ev.kv.mod_revision for ev in resp.events}) == 1
+        req_q.put(None)
+
+    def test_lease_revoke_deletes_arrive_in_one_response(self, wire):
+        kv, lease, channel, _ = wire
+        g = lease.LeaseGrant(epb.LeaseGrantRequest(TTL=60))
+        for k in (b"ta2/x", b"ta2/y", b"ta2/z"):
+            kv.Put(epb.PutRequest(key=k, value=b"v", lease=g.ID))
+        req_q, call = _watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"ta2/", range_end=_prefix_end(b"ta2/"))))
+        it = iter(call)
+        assert next(it).created
+        lease.LeaseRevoke(epb.LeaseRevokeRequest(ID=g.ID))
+        resp = next(it)
+        assert len(resp.events) == 3
+        assert all(ev.type == epb.MvccEvent.DELETE for ev in resp.events)
+        assert len({ev.kv.mod_revision for ev in resp.events}) == 1
+        req_q.put(None)

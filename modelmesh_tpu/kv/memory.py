@@ -197,13 +197,7 @@ class InMemoryKV(KVStore):
                     self._batch_rev_allocated = False
                     if self._batch_events:
                         events, self._batch_events = self._batch_events, []
-                        for w in list(self._watchers):
-                            matched = [
-                                ev for ev in events
-                                if ev.kv.key.startswith(w.prefix)
-                            ]
-                            if matched:
-                                self._events.put((w, matched))
+                        self._deliver(events)
 
     def _put_locked(self, key: str, value: bytes, lease: int) -> KeyValue:
         if lease and lease not in self._leases:
@@ -323,9 +317,17 @@ class InMemoryKV(KVStore):
             # Same-revision events deliver TOGETHER at batch exit.
             self._batch_events.append(event)
             return
+        self._deliver([event])
+
+    def _deliver(self, events: list[WatchEvent]) -> None:
+        """Enqueue ``events`` as ONE delivery per matching watcher.
+        Caller holds the lock."""
         for w in list(self._watchers):
-            if event.kv.key.startswith(w.prefix):
-                self._events.put((w, [event]))
+            matched = [
+                ev for ev in events if ev.kv.key.startswith(w.prefix)
+            ]
+            if matched:
+                self._events.put((w, matched))
 
     def _dispatch_loop(self) -> None:
         while not self._closed.is_set():

@@ -40,6 +40,7 @@ from modelmesh_tpu.serving.errors import (
     ModelNotFoundError,
     ModelNotHereError,
     NoCapacityError,
+    ReadOnlyModeError,
     ServiceUnavailableError,
 )
 from modelmesh_tpu.serving.instance import (
@@ -129,13 +130,18 @@ class MeshApiServicer:
                 request.model_id, info,
                 load_now=request.load_now, sync=request.sync,
             )
+        except ReadOnlyModeError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         except Exception as e:  # noqa: BLE001 — map to status
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         return self._status_info(request.model_id)
 
     def UnregisterModel(self, request, context):
         self._require_id(request.model_id, context)
-        self.instance.unregister_model(request.model_id)
+        try:
+            self.instance.unregister_model(request.model_id)
+        except ReadOnlyModeError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         return apb.UnregisterModelResponse()
 
     def GetModelStatus(self, request, context):

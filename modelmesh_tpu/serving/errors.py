@@ -45,6 +45,13 @@ class ApplierError(Exception):
         self.grpc_code = grpc_code
 
 
+class ReadOnlyModeError(Exception):
+    """Registry mutation rejected: this instance runs in KV-migration
+    read-only mode (MM_KV_READ_ONLY=1; reference readOnlyMode,
+    ModelMesh.java:200-204) — model addition/removal is blocked while the
+    operator migrates between disjoint KV stores."""
+
+
 class ServiceUnavailableError(Exception):
     """Peer instance unreachable."""
 

@@ -63,6 +63,7 @@ from modelmesh_tpu.serving.errors import (
     ModelNotFoundError,
     ModelNotHereError,
     NoCapacityError,
+    ReadOnlyModeError,
     RequestCancelledError,
     ServiceUnavailableError,
 )
@@ -152,6 +153,7 @@ class InstanceConfig:
         space_wait_s: float = 30.0,
         min_churn_age_ms: int = DEFAULT_MIN_CHURN_AGE_MS,
         publish_interval_s: float = 8.0,
+        read_only: Optional[bool] = None,
     ):
         self.instance_id = instance_id or f"i-{uuid.uuid4().hex[:8]}"
         self.kv_prefix = kv_prefix.rstrip("/")
@@ -164,6 +166,16 @@ class InstanceConfig:
         self.space_wait_s = space_wait_s
         self.min_churn_age_ms = min_churn_age_ms
         self.publish_interval_s = publish_interval_s
+        # KV-migration read-only mode (reference readOnlyMode,
+        # ModelMesh.java:200-204): registry mutations are blocked and
+        # reaper pruning suppressed while the operator migrates between
+        # disjoint KV stores (copies registered in the OTHER store look
+        # like dead instances from here and must not be pruned).
+        if read_only is None:
+            from modelmesh_tpu.utils import envs
+
+            read_only = bool(envs.get_int("MM_KV_READ_ONLY"))
+        self.read_only = read_only
 
 
 class ModelMeshInstance:
@@ -428,6 +440,24 @@ class ModelMeshInstance:
         self, model_id: str, info: ModelInfo, load_now: bool = False,
         sync: bool = False,
     ) -> ModelRecord:
+        if self.config.read_only:
+            # Migration read-only mode: re-register of an EXISTING model is
+            # tolerated as a no-op read (reference: the existing-record
+            # branch skips the readOnly rejection, ModelMesh.java:3112-3131);
+            # creating a NEW record is rejected.
+            existing = self.registry.get(model_id)
+            if existing is None:
+                raise ReadOnlyModeError(
+                    f"registerModel({model_id}) rejected in read-only mode"
+                )
+            log.warning(
+                "read-only mode: registerModel(%s) served as no-op", model_id
+            )
+            if load_now:
+                self.ensure_loaded(model_id, sync=sync)
+                existing = self.registry.get(model_id) or existing
+            return existing
+
         def create(cur: Optional[ModelRecord]) -> ModelRecord:
             if cur is not None:
                 # Idempotent re-register with same info keeps the record.
@@ -450,6 +480,10 @@ class ModelMeshInstance:
         return mr
 
     def unregister_model(self, model_id: str) -> bool:
+        if self.config.read_only:
+            raise ReadOnlyModeError(
+                f"unregisterModel({model_id}) rejected in read-only mode"
+            )
         mr = self.registry.get(model_id)
         if mr is None:
             return False

@@ -369,6 +369,12 @@ class BackgroundTasks:
             if now - since >= self.config.assume_gone_ms
         }
         # (a) prune placements on gone instances + stale loading claims.
+        # SUPPRESSED in KV-migration read-only mode: holders registered in
+        # the OTHER kv store are invisible here and would all look "gone"
+        # (reference skips pruning under readOnlyMode, ModelMesh.java:6543).
+        if inst.config.read_only:
+            self._proactive_load(records, visible_only=live)
+            return
         for model_id, mr in records:
             stale_claims = [
                 iid for iid, ts in mr.loading_instances.items()
@@ -398,7 +404,12 @@ class BackgroundTasks:
         #     models into free cluster space, above a reserve.
         self._proactive_load(records)
 
-    def _proactive_load(self, records) -> None:
+    def _proactive_load(self, records, visible_only=None) -> None:
+        """``visible_only``: in KV-migration read-only mode, placements on
+        instances outside OUR instance registry belong to the other store's
+        fleet — for load decisions they count as not loaded here (reference
+        filters insts to instanceInfo under readOnlyMode,
+        ModelMesh.java:6547-6551)."""
         inst = self.instance
         views = inst.instances_view.items()
         cap = sum(r.capacity_units for _, r in views) or 1
@@ -406,10 +417,19 @@ class BackgroundTasks:
         budget_units = int((cap - used) - cap * PROACTIVE_RESERVE_FRACTION) // 2
         if budget_units <= 0:
             return
+
+        def visible(ids):
+            if visible_only is None:
+                return ids
+            return [i for i in ids if i in visible_only]
+
+        # loading_instances gets the same filter: an other-store (or stale)
+        # claim must not block the local load for the whole migration —
+        # read-only mode suppresses the pruning that would clear it.
         unloaded = [
             (mr.last_used, model_id, mr)
             for model_id, mr in records
-            if not mr.instance_ids and not mr.loading_instances
+            if not visible(mr.instance_ids) and not visible(mr.loading_instances)
             and not mr.load_exhausted()
         ]
         unloaded.sort(reverse=True, key=lambda t: t[0])

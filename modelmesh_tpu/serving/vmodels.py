@@ -69,6 +69,11 @@ class VModelManager:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 "vmodel_id and target_model_id are required",
             )
+        if self.instance.config.read_only:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "vmodel mutations rejected in KV-migration read-only mode",
+            )
         info = ModelInfo(
             model_type=request.info.model_type,
             model_path=request.info.model_path,
@@ -180,6 +185,11 @@ class VModelManager:
         return self._status(vmid, status_fn)
 
     def delete_vmodel(self, request, context) -> apb.DeleteVModelResponse:
+        if self.instance.config.read_only:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "vmodel mutations rejected in KV-migration read-only mode",
+            )
         vmid = request.vmodel_id
         vkey = self.table.raw_key(vmid)
         # Alias delete + refcount releases ride ONE txn: a crash after a

@@ -71,6 +71,9 @@ REGISTRY: dict[str, EnvVar] = {
         EnvVar("MM_BENCH_E2E", "int", "1",
                "also measure the end-to-end plan refresh (0 disables)",
                "bench.py"),
+        EnvVar("MM_KV_READ_ONLY", "int", "0",
+               "KV-migration read-only mode: block model add/remove, "
+               "suppress reaper pruning", "serving/instance.py"),
     ]
 }
 

@@ -1,0 +1,183 @@
+"""KV-migration read-only mode (MM_KV_READ_ONLY; reference readOnlyMode,
+ModelMesh.java:200-204, 3131, 3193, 6543-6551): while an operator migrates
+between disjoint KV stores, model addition/removal is blocked, serving
+continues, reaper pruning is suppressed (holders registered in the OTHER
+store look like dead instances from here), and proactive loading treats
+models whose only holders are invisible as unloaded.
+"""
+
+import time
+
+import grpc
+import pytest
+
+from modelmesh_tpu.runtime import ModelInfo
+from modelmesh_tpu.runtime.fake import PREDICT_METHOD
+from modelmesh_tpu.serving.errors import ReadOnlyModeError
+from tests.cluster_util import Cluster
+
+INFO = ModelInfo(model_type="example", model_path="mem://ro")
+
+
+@pytest.fixture()
+def ro_cluster():
+    c = Cluster(n=2)
+    # Seed state BEFORE entering read-only mode.
+    c[0].instance.register_model("ro-live", INFO, load_now=True, sync=True)
+    for pod in c.pods:
+        pod.instance.config.read_only = True
+    yield c
+    for pod in c.pods:
+        pod.instance.config.read_only = False
+    c.close()
+
+
+class TestMutationsBlocked:
+    def test_new_registration_rejected(self, ro_cluster):
+        with pytest.raises(ReadOnlyModeError):
+            ro_cluster[0].instance.register_model("ro-new", INFO)
+        assert ro_cluster[0].instance.registry.get("ro-new") is None
+
+    def test_reregister_existing_is_noop_read(self, ro_cluster):
+        inst = ro_cluster[0].instance
+        before = inst.registry.get("ro-live")
+        got = inst.register_model("ro-live", INFO)
+        assert got.model_type == "example"
+        after = inst.registry.get("ro-live")
+        assert after.version == before.version, "no write may happen"
+
+    def test_unregister_rejected(self, ro_cluster):
+        with pytest.raises(ReadOnlyModeError):
+            ro_cluster[0].instance.unregister_model("ro-live")
+        assert ro_cluster[0].instance.registry.get("ro-live") is not None
+
+    def test_grpc_surface_maps_failed_precondition(self, ro_cluster):
+        from modelmesh_tpu.proto import mesh_api_pb2 as apb
+        from modelmesh_tpu.runtime import grpc_defs
+
+        ch = grpc.insecure_channel(ro_cluster[0].server.endpoint)
+        try:
+            api = grpc_defs.make_stub(
+                ch, grpc_defs.API_SERVICE, grpc_defs.API_METHODS
+            )
+            with pytest.raises(grpc.RpcError) as e:
+                api.RegisterModel(apb.RegisterModelRequest(
+                    model_id="ro-grpc-new",
+                    info=apb.ModelInfo(model_type="example"),
+                ))
+            assert e.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+            with pytest.raises(grpc.RpcError) as e2:
+                api.UnregisterModel(
+                    apb.UnregisterModelRequest(model_id="ro-live")
+                )
+            assert e2.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+            with pytest.raises(grpc.RpcError) as e3:
+                api.SetVModel(apb.SetVModelRequest(
+                    vmodel_id="ro-vm", target_model_id="ro-live",
+                    info=apb.ModelInfo(model_type="example"),
+                ))
+            assert e3.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        finally:
+            ch.close()
+
+    def test_serving_continues(self, ro_cluster):
+        out = ro_cluster[0].instance.invoke_model(
+            "ro-live", PREDICT_METHOD, b"req", []
+        )
+        assert out.payload.startswith(b"ro-live:")
+
+
+class TestReaperSuppression:
+    def test_invisible_holders_not_pruned_and_proactively_loaded(self):
+        """A record whose only holder is in the OTHER kv store (invisible
+        here) must keep its placement entry AND be proactively loaded
+        locally. With pruning active it would first be stripped; in
+        read-only it must survive the whole pass."""
+        from modelmesh_tpu.serving.tasks import BackgroundTasks, TaskConfig
+
+        c = Cluster(n=1)
+        try:
+            inst = c[0].instance
+            inst.register_model("ro-ghost", INFO)
+
+            def mark(cur):
+                cur.promote_loaded("other-store-instance", 1_000)
+                return cur
+
+            inst.registry.update_or_create("ro-ghost", mark)
+            inst.config.read_only = True
+            tasks = BackgroundTasks(
+                inst, TaskConfig(assume_gone_ms=0)
+            )
+            tasks._missing_since["other-store-instance"] = 0  # long gone
+            tasks._reaper_tick()
+            mr = inst.registry.get("ro-ghost")
+            assert "other-store-instance" in mr.instance_ids, (
+                "read-only reaper must not prune other-store holders"
+            )
+            # Proactive load treated it as unloaded HERE: a local copy
+            # appears (async ensure_loaded; wait briefly).
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                mr = inst.registry.get("ro-ghost")
+                if inst.instance_id in mr.all_placements:
+                    break
+                time.sleep(0.05)
+            assert inst.instance_id in mr.all_placements, (
+                "proactive load must treat invisible-only holders as "
+                "unloaded here"
+            )
+        finally:
+            c[0].instance.config.read_only = False
+            c.close()
+
+    def test_invisible_loading_claim_does_not_block_proactive_load(self):
+        """A stale/other-store LOADING claim must not exclude the record
+        from proactive loading for the whole migration window (read-only
+        suppresses the pruning that would otherwise clear it)."""
+        from modelmesh_tpu.serving.tasks import BackgroundTasks, TaskConfig
+
+        c = Cluster(n=1)
+        try:
+            inst = c[0].instance
+            inst.register_model("ro-claimed", INFO)
+
+            def mark(cur):
+                cur.claim_loading("other-store-i1", 1_000)
+                return cur
+
+            inst.registry.update_or_create("ro-claimed", mark)
+            inst.config.read_only = True
+            tasks = BackgroundTasks(inst, TaskConfig(assume_gone_ms=0))
+            tasks._reaper_tick()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                mr = inst.registry.get("ro-claimed")
+                if inst.instance_id in mr.all_placements:
+                    break
+                time.sleep(0.05)
+            assert inst.instance_id in mr.all_placements
+        finally:
+            c[0].instance.config.read_only = False
+            c.close()
+
+    def test_normal_mode_does_prune(self):
+        from modelmesh_tpu.serving.tasks import BackgroundTasks, TaskConfig
+
+        c = Cluster(n=1)
+        try:
+            inst = c[0].instance
+            inst.register_model("prune-me", INFO)
+
+            def mark(cur):
+                cur.promote_loaded("dead-instance", 1_000)
+                return cur
+
+            inst.registry.update_or_create("prune-me", mark)
+            tasks = BackgroundTasks(inst, TaskConfig(assume_gone_ms=0))
+            tasks._missing_since["dead-instance"] = 0
+            tasks._reaper_tick()
+            mr = inst.registry.get("prune-me")
+            assert "dead-instance" not in mr.instance_ids
+        finally:
+            c.close()

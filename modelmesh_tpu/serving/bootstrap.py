@@ -24,6 +24,7 @@ from typing import Optional
 
 from modelmesh_tpu.cache.lru import now_ms
 from modelmesh_tpu.runtime.spi import ModelInfo
+from modelmesh_tpu.serving.errors import ReadOnlyModeError
 from modelmesh_tpu.serving.instance import ModelMeshInstance
 
 log = logging.getLogger(__name__)
@@ -61,7 +62,14 @@ def register_static_models(
             model_path=spec.get("path", ""),
             model_key=spec.get("key", ""),
         )
-        instance.register_model(mid, info, load_now=True, sync=verify)
+        try:
+            instance.register_model(mid, info, load_now=True, sync=verify)
+        except ReadOnlyModeError as e:
+            # KV-migration read-only: the registration will arrive with
+            # the store copy — a crash-looping pod would defeat "serving
+            # continues" for the whole migration window.
+            log.warning("static model %s skipped: %s", mid, e)
+            continue
         registered.append(mid)
         if verify and instance.get_status(mid)[0] != "LOADED":
             failed.append(mid)

@@ -303,6 +303,11 @@ class VModelManager:
                 log.exception("vmodel sweep failed")
 
     def _advance_transition(self, vmid: str) -> None:
+        if self.instance.config.read_only:
+            # Migration read-only: promotion writes the vmodel record and
+            # can auto-delete the old model's registration — both blocked.
+            # The transition stays pending and resumes after migration.
+            return
         vr = self.table.get(vmid)
         if vr is None or not vr.in_transition:
             return

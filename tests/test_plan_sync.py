@@ -29,6 +29,39 @@ def _wait(pred, timeout=5.0, interval=0.02):
 
 
 class TestPlanWire:
+    def test_v1_json_payload_still_decodes(self):
+        """Mixed-version rolling update: a follower on this version must
+        adopt plans published by a pre-v2 (zlib'd JSON) leader."""
+        import json
+        import zlib
+
+        payload = json.dumps({
+            "g": 4, "t": now_ms() - 10, "ms": 2.0,
+            "p": {"m1": ["a", "b"], "m2": []},
+        }, separators=(",", ":"))
+        q = GlobalPlan.from_bytes(zlib.compress(payload.encode(), level=1))
+        assert q.placements == {"m1": ["a", "b"], "m2": []}
+        assert q.generation == 4
+
+    def test_empty_plan_roundtrip(self):
+        q = GlobalPlan.from_bytes(GlobalPlan({}, now_ms(), 0.0).to_bytes())
+        assert q.placements == {}
+
+    def test_newline_id_falls_back_to_json(self):
+        weird = {"bad\nid": ["i0"], "ok": ["i1"]}
+        q = GlobalPlan.from_bytes(
+            GlobalPlan(weird, now_ms(), 1.0, 2).to_bytes()
+        )
+        assert q.placements == weird and q.generation == 2
+
+    def test_v2_is_compact(self):
+        placements = {
+            f"model-{i:06d}": [f"inst-{i % 100:03d}"] for i in range(20_000)
+        }
+        data = GlobalPlan(placements, now_ms(), 1.0).to_bytes()
+        # v1 JSON of the same plan was ~3x larger.
+        assert len(data) < 100_000, f"v2 plan unexpectedly large: {len(data)}"
+
     def test_roundtrip(self):
         p = GlobalPlan({"m": ["i0", "i1"]}, now_ms() - 123, 4.5, generation=7)
         q = GlobalPlan.from_bytes(p.to_bytes())

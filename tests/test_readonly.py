@@ -181,3 +181,74 @@ class TestReaperSuppression:
             assert "dead-instance" not in mr.instance_ids
         finally:
             c.close()
+
+
+class TestReadOnlyAuxPaths:
+    def test_static_models_skip_instead_of_crash(self):
+        """Pods with MM_STATIC_MODELS pointed at a not-yet-copied store must
+        come up (skip + warn), not crash-loop for the migration window."""
+        import json
+
+        from modelmesh_tpu.serving.bootstrap import register_static_models
+
+        c = Cluster(n=1)
+        try:
+            c[0].instance.config.read_only = True
+            cfg = json.dumps({"models": [
+                {"modelId": "not-copied-yet", "type": "example"},
+            ]})
+            registered = register_static_models(
+                c[0].instance, config_json=cfg, verify=False
+            )
+            assert registered == []
+            assert c[0].instance.registry.get("not-copied-yet") is None
+        finally:
+            c[0].instance.config.read_only = False
+            c.close()
+
+    def test_sweeper_promotion_blocked(self):
+        """A vmodel transition in flight when read-only engages must stay
+        pending (promotion writes records / can auto-delete) and resume
+        after the mode clears."""
+        c = Cluster(n=1)
+        try:
+            inst = c[0].instance
+            vm = c[0].vmodels
+            inst.register_model("sw-v1", INFO, load_now=True, sync=True)
+            from modelmesh_tpu.records import VModelRecord
+
+            vm.table.put("sw", VModelRecord(
+                active_model="sw-v1", target_model="sw-v1"))
+            vm.bump_ref("sw-v1", +1, auto_delete=True)
+            inst.register_model("sw-v2", INFO)
+            vm.bump_ref("sw-v2", +1, auto_delete=True)
+
+            def mut(cur):
+                cur.target_model = "sw-v2"
+                return cur
+
+            vm.table.update_or_create("sw", mut)
+            inst.config.read_only = True
+            vm._advance_transition("sw")
+            vr = vm.table.get("sw")
+            assert vr.active_model == "sw-v1" and vr.in_transition
+            assert inst.registry.get("sw-v1") is not None
+            # Mode clears -> promotion completes and old model cleans up.
+            inst.config.read_only = False
+            vm._advance_transition("sw")
+            assert vm.table.get("sw").active_model == "sw-v2"
+        finally:
+            c[0].instance.config.read_only = False
+            c.close()
+
+
+class TestPlanWireGuards:
+    def test_over_255_targets_falls_back_to_json(self):
+        from modelmesh_tpu.cache.lru import now_ms
+        from modelmesh_tpu.placement.jax_engine import GlobalPlan
+
+        placements = {"fat": [f"i{k}" for k in range(300)], "thin": ["i0"]}
+        q = GlobalPlan.from_bytes(
+            GlobalPlan(placements, now_ms(), 1.0).to_bytes()
+        )
+        assert q.placements == placements

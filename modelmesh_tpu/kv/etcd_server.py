@@ -97,7 +97,7 @@ class EtcdLiteServicer:
                 req.range_end.decode() if req.range_end else "",
             )
             total = len(kvs)
-            if req.limit:
+            if req.limit > 0:  # etcd: limit <= 0 means unlimited
                 kvs = kvs[: req.limit]
             revision = self.store.revision
         # Protobuf construction happens OUTSIDE the lock — a large range

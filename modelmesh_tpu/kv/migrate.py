@@ -1,0 +1,82 @@
+"""Offline key-layout migration: flat registry keys -> bucketed layout.
+
+The registry moved from flat ``<prefix>/registry/<id>`` keys to the
+bucketed ``<prefix>/registry/<bb>/<id>`` layout (BucketedKVTable,
+kv/table.py). Data written by a pre-bucketing version must be migrated
+ONCE, with the fleet stopped (or before the first bucketed-version pod
+starts): live migration is deliberately not attempted — two keys mapping
+to one id breaks TableView version fencing and splits CAS writers across
+a mixed-version fleet.
+
+    python -m modelmesh_tpu.kv.migrate --kv etcd://host:2379 --prefix mm
+
+Each move is one atomic txn (create-bucketed guarded on absence + delete
+flat guarded on version), so re-running after an interruption is safe and
+concurrent writers lose cleanly (the key is re-scanned).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from modelmesh_tpu.kv.store import Compare, KVStore, Op
+
+log = logging.getLogger(__name__)
+
+_BUCKET_SEG = re.compile(r"^[0-9a-f]{2}/")
+
+
+def migrate_flat_registry(
+    store: KVStore, prefix: str = "mm", n_buckets: int = 128,
+    page_size: int = 500,
+) -> int:
+    """Move every flat registry key into its bucket; returns moves made."""
+    from modelmesh_tpu.kv.table import BucketedKVTable
+    from modelmesh_tpu.records import ModelRecord
+
+    table = BucketedKVTable(
+        store, f"{prefix.rstrip('/')}/registry", ModelRecord,
+        n_buckets=n_buckets,
+    )
+    moved = 0
+    for kv in list(store.range_paged(table.prefix, page_size)):
+        rest = kv.key[len(table.prefix):]
+        if _BUCKET_SEG.match(rest):
+            continue  # already bucketed
+        target = table.raw_key(rest)
+        ok, _ = store.txn(
+            [Compare(target, 0), Compare(kv.key, kv.version)],
+            [Op(target, kv.value), Op(kv.key)],
+        )
+        if ok:
+            moved += 1
+        else:
+            log.warning("skipped %s (concurrent change; re-run)", rest)
+    return moved
+
+
+def main() -> None:
+    import argparse
+
+    from modelmesh_tpu.serving.main import build_store
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--kv", required=True,
+                        help="mesh://host:port or etcd://host:port")
+    parser.add_argument("--prefix", default="mm")
+    parser.add_argument("--buckets", type=int, default=128)
+    args = parser.parse_args()
+    logging.basicConfig(level="INFO")
+    store = build_store(args.kv)
+    try:
+        moved = migrate_flat_registry(store, args.prefix, args.buckets)
+        print(f"migrated {moved} flat registry keys")
+    finally:
+        close = getattr(store, "close", None)
+        if close:
+            close()
+
+
+if __name__ == "__main__":
+    main()

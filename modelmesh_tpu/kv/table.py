@@ -176,13 +176,13 @@ class BucketedKVTable(KVTable[R]):
     bucket without fan-in.
 
     Legacy FLAT keys (``<prefix><id>`` from pre-bucketing versions) are
-    lazily migrated: a get() that misses the bucketed key falls back to
-    the flat key and, on a hit, atomically moves the record into its
-    bucket (txn: create-bucketed + delete-flat) so subsequent CAS ops see
-    one canonical key. During a mixed-version rolling update old pods
-    keep finding records via their flat reads until they restart; scans
-    (items()) see only migrated records, so run the upgrade before
-    relying on scan-driven features at scale.
+    NOT read by this table: migrate them explicitly with
+    ``python -m modelmesh_tpu.kv.migrate`` while the fleet is stopped.
+    (An earlier lazy migrate-on-read was removed deliberately: two keys
+    mapping to one id breaks TableView's per-key version fencing — the
+    PUT/DELETE pair fired spurious DELETED events — and a read that
+    writes both splits the registry across a mixed-version fleet and
+    violates KV-migration read-only mode.)
     """
 
     def __init__(
@@ -205,40 +205,9 @@ class BucketedKVTable(KVTable[R]):
         _, _, id_ = rest.partition("/")
         return id_ or rest  # tolerate stray un-bucketed keys
 
-    def get(self, id_: str) -> Optional[R]:
-        rec = super().get(id_)
-        if rec is not None:
-            return rec
-        # Flat-layout fallback + lazy migration (see class docstring).
-        flat = self.store.get(self.prefix + id_)
-        if flat is None:
-            return None
-        from modelmesh_tpu.kv.store import Compare, Op
-
-        ok, _ = self.store.txn(
-            [Compare(self._key(id_), 0), Compare(flat.key, flat.version)],
-            [Op(self._key(id_), flat.value), Op(flat.key)],
-        )
-        if not ok:
-            # Concurrent migration or write won; canonical key authoritative.
-            return super().get(id_) or self.record_cls.from_bytes(
-                flat.value, flat.version
-            )
-        return super().get(id_)
-
-    def delete(self, id_: str) -> bool:
-        bucketed = super().delete(id_)
-        flat = self.store.delete(self.prefix + id_)
-        return bucketed or flat
-
-    def items(self, page_size: int = 1000) -> Iterator[tuple[str, R]]:
-        for b in range(self.n_buckets):
-            for kv in self.store.range_paged(
-                f"{self.prefix}{b:02x}/", page_size
-            ):
-                yield self.key_to_id(kv.key), self.record_cls.from_bytes(
-                    kv.value, kv.version
-                )
+    # Scans are inherited: range_paged over the whole prefix already
+    # bounds every RPC by page_size — iterating the 128 bucket prefixes
+    # separately would impose a >=128-RPC floor per scan for nothing.
 
 
 class TableView(Generic[R]):

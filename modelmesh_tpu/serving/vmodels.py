@@ -327,13 +327,21 @@ class VModelManager:
                 # A sync load unblocks on the cache entry going ACTIVE;
                 # the loader thread's registry promote (a CAS, possibly
                 # over a networked KV) can land a beat LATER. When the load
-                # reports success, poll briefly for visible progress —
-                # but don't stall 5 s on a load that plainly didn't happen
-                # (that would serialize the leader sweep behind every
-                # unplaceable transition).
-                poll_deadline = time.monotonic() + (
-                    5.0 if status in ("LOADED", "LOADING") else 0.0
-                )
+                # reports success, poll briefly for visible progress — but
+                # don't stall on a load that plainly didn't happen (that
+                # would serialize the leader sweep behind every unplaceable
+                # transition). The long poll applies only to the FIRST copy
+                # (the promotion-blocking race); "LOADED" during scale-up
+                # can mean the request rode an existing copy with no new
+                # placement, so extra copies get a short poll and the
+                # sweep's next pass picks up any real lag.
+                if status not in ("LOADED", "LOADING"):
+                    poll_s = 0.0
+                elif have == 0:
+                    poll_s = 5.0
+                else:
+                    poll_s = 1.0
+                poll_deadline = time.monotonic() + poll_s
                 new_tgt, new_have = tgt, have
                 while True:
                     new_tgt = self.instance.registry.get(target)

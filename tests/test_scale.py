@@ -191,39 +191,47 @@ class TestPlanPublishScale:
 
 
 class TestFlatLayoutMigration:
-    """Pre-bucketing (flat `<prefix><id>`) records must stay reachable and
-    migrate lazily into their bucket on first get()."""
+    """Pre-bucketing (flat `<prefix><id>`) data migrates via the EXPLICIT
+    offline utility (kv/migrate.py) — never on read: two keys mapping to
+    one id breaks TableView version fencing and splits CAS writers across
+    a mixed-version fleet (round-3 review repro)."""
 
-    def test_get_migrates_flat_key(self):
+    def test_offline_utility_moves_flat_keys(self):
+        from modelmesh_tpu.kv.migrate import migrate_flat_registry
+
         store = InMemoryKV(sweep_interval_s=0.5)
         try:
             table = BucketedKVTable(store, "mig/registry", ModelRecord)
-            # Simulate a record written by a pre-bucketing version.
-            legacy = ModelRecord(model_type="legacy")
-            store.put("mig/registry/old-model", legacy.to_bytes())
-            got = table.get("old-model")
-            assert got is not None and got.model_type == "legacy"
-            # Migrated: canonical bucketed key exists, flat key gone.
-            assert store.get(table.raw_key("old-model")) is not None
-            assert store.get("mig/registry/old-model") is None
-            # CAS ops work against the canonical key post-migration.
-            got.model_type = "updated"
-            table.conditional_set("old-model", got)
-            assert table.get("old-model").model_type == "updated"
-            # Scans see it now.
-            assert dict(table.items())["old-model"].model_type == "updated"
+            for i in range(20):
+                store.put(
+                    f"mig/registry/old-{i}",
+                    ModelRecord(model_type="legacy").to_bytes(),
+                )
+            table.put("already-bucketed", ModelRecord(model_type="new"))
+            moved = migrate_flat_registry(store, "mig")
+            assert moved == 20
+            # Everything reachable through the table; no flat keys left.
+            ids = dict(table.items())
+            assert len(ids) == 21
+            assert ids["old-7"].model_type == "legacy"
+            assert store.get("mig/registry/old-7") is None
+            # Idempotent: a second run moves nothing.
+            assert migrate_flat_registry(store, "mig") == 0
+            # CAS works against the canonical key post-migration.
+            rec = table.get("old-3")
+            rec.model_type = "updated"
+            table.conditional_set("old-3", rec)
+            assert table.get("old-3").model_type == "updated"
         finally:
             store.close()
 
-    def test_delete_covers_both_layouts(self):
+    def test_flat_keys_invisible_without_migration(self):
+        """No silent dual-read: an unmigrated flat key is NOT served (the
+        operator must run the utility), preventing split-brain."""
         store = InMemoryKV(sweep_interval_s=0.5)
         try:
             table = BucketedKVTable(store, "mig2/registry", ModelRecord)
             store.put("mig2/registry/flat-only", ModelRecord().to_bytes())
-            assert table.delete("flat-only") is True
-            assert store.get("mig2/registry/flat-only") is None
-            table.put("bucketed", ModelRecord())
-            assert table.delete("bucketed") is True
-            assert table.get("bucketed") is None
+            assert table.get("flat-only") is None
         finally:
             store.close()

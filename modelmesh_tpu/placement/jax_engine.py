@@ -298,16 +298,115 @@ class GlobalPlan:
     """
 
     def __init__(
-        self, placements: dict[str, list[str]], solved_at_ms: int,
+        self, placements: Optional[dict[str, list[str]]], solved_at_ms: int,
         solve_ms: float, generation: int = 0,
     ):
-        self.placements = placements
+        self._placements = placements
+        # Columnar alternative representation (from_columnar / from_bytes
+        # v2): (model_ids, counts u8[n], flat instance indices, inst_ids).
+        # The 100k-entry dict-of-lists is only materialized if someone asks
+        # for `.placements` — the solve -> publish path never does, which
+        # keeps ~2-400 ms of Python object churn out of the refresh loop.
+        self._columnar: Optional[tuple[list, np.ndarray, np.ndarray, list]] = None
+        self._index: Optional[dict[str, int]] = None
+        self._offsets: Optional[np.ndarray] = None
         self.solved_at_ms = solved_at_ms
         self.solve_ms = solve_ms
         self.generation = generation
         self.adopted_at_ms = solved_at_ms
         # Local-only stage timings from solve_plan (not serialized).
         self.stats: dict[str, float] = {}
+
+    @classmethod
+    def from_columnar(
+        cls, model_ids: list, counts: np.ndarray, flat: np.ndarray,
+        inst_ids: list, solved_at_ms: int, solve_ms: float,
+        generation: int = 0,
+    ) -> "GlobalPlan":
+        """Wrap solver output without building the per-model dict.
+
+        ``counts[i]`` targets for model ``model_ids[i]`` live at
+        ``flat[offsets[i]:offsets[i]+counts[i]]`` (indices into inst_ids).
+        """
+        counts = np.asarray(counts)
+        if counts.size and int(counts.max()) > 255:
+            # u8 casts below would wrap silently and desynchronize the flat
+            # index stream for every later model (wire corruption). Nothing
+            # upstream produces >255 targets (auction caps at MAX_COPIES=8),
+            # so treat it as a caller bug, loudly.
+            raise ValueError("per-model target count exceeds 255")
+        plan = cls(None, solved_at_ms, solve_ms, generation)
+        plan._columnar = (model_ids, counts.astype(np.uint8),
+                          np.asarray(flat), inst_ids)
+        return plan
+
+    @property
+    def placements(self) -> dict[str, list[str]]:
+        if self._placements is None:
+            model_ids, counts, flat, inst_ids = self._columnar
+            flat_list = flat.tolist()
+            placements: dict[str, list[str]] = {}
+            pos = 0
+            for mid, c in zip(model_ids, counts.tolist()):
+                placements[mid] = [inst_ids[j] for j in flat_list[pos:pos + c]]
+                pos += c
+            self._placements = placements
+        return self._placements
+
+    def num_models(self) -> int:
+        if self._placements is not None:
+            return len(self._placements)
+        return len(self._columnar[0])
+
+    def ensure_index(self) -> None:
+        """Build the lookup index eagerly (PlanFollower calls this from the
+        watch thread so the first routed request never pays for it)."""
+        if self._columnar is not None and self._index is None:
+            model_ids, counts, _, _ = self._columnar
+            off = np.zeros(len(model_ids) + 1, np.int64)
+            np.cumsum(counts, out=off[1:])
+            # _offsets before _index: concurrent lock-free lookup()s treat a
+            # non-None _index as "ready" and immediately read _offsets.
+            self._offsets = off
+            self._index = {mid: i for i, mid in enumerate(model_ids)}
+
+    def lookup(self, model_id: str) -> Optional[list[str]]:
+        """Targets for one model (routing hot path; no full dict needed)."""
+        if self._placements is not None:
+            return self._placements.get(model_id)
+        self.ensure_index()
+        row = self._index.get(model_id)
+        if row is None:
+            return None
+        _, counts, flat, inst_ids = self._columnar
+        start = int(self._offsets[row])
+        return [inst_ids[j] for j in flat[start:start + counts[row]].tolist()]
+
+    def truncate(self, keep: int) -> "GlobalPlan":
+        """First ``keep`` models (placement order = hottest first), for the
+        publisher's byte-budget trim."""
+        if self._columnar is not None:
+            model_ids, counts, flat, inst_ids = self._columnar
+            cut = int(np.sum(counts[:keep], dtype=np.int64))
+            flat_cut = flat[:cut]
+            # Re-index against only the instances the kept rows reference:
+            # the publisher's byte-budget trim relies on the payload
+            # actually shrinking, and a full fleet-sized id table would put
+            # a floor under it.
+            used = np.unique(flat_cut)
+            plan = GlobalPlan.from_columnar(
+                model_ids[:keep], counts[:keep],
+                np.searchsorted(used, flat_cut),
+                [inst_ids[int(j)] for j in used],
+                self.solved_at_ms, self.solve_ms, self.generation,
+            )
+        else:
+            items = list(self._placements.items())[:keep]
+            plan = GlobalPlan(
+                dict(items), self.solved_at_ms, self.solve_ms, self.generation
+            )
+        plan.adopted_at_ms = self.adopted_at_ms
+        return plan
 
     def age_ms(self) -> int:
         return now_ms() - self.adopted_at_ms
@@ -329,6 +428,21 @@ class GlobalPlan:
         import json
         import zlib
 
+        if self._columnar is not None and self._placements is None:
+            # Columnar fast path: the solver's arrays serialize directly —
+            # no dict walk, no inst-table rebuild.
+            model_ids, counts, flat, inst_ids = self._columnar
+            if not any("\n" in s for s in model_ids) and not any(
+                "\n" in s for s in inst_ids
+            ):
+                idx_dtype = (
+                    np.uint16 if len(inst_ids) < 65_536 else np.uint32
+                )
+                return self._pack_v2(
+                    inst_ids, model_ids, counts,
+                    np.asarray(flat, idx_dtype), idx_dtype,
+                )
+            # fall through to the dict path (materializes placements)
         # Newlines delimit the id tables and copy counts ride a u8 column;
         # a pathological id containing "\n" or a row with >255 targets
         # (nothing upstream produces either, but the format must not
@@ -350,9 +464,18 @@ class GlobalPlan:
             for t in targets:
                 flat.append(inst_table.setdefault(t, len(inst_table)))
         idx_dtype = np.uint16 if len(inst_table) < 65_536 else np.uint32
+        return self._pack_v2(
+            list(inst_table), list(self.placements), counts,
+            np.asarray(flat, idx_dtype), idx_dtype,
+        )
+
+    def _pack_v2(self, inst_ids, model_ids, counts, flat, idx_dtype) -> bytes:
+        import json
+        import zlib
+
         header = json.dumps({
             "g": self.generation, "t": self.solved_at_ms,
-            "ms": self.solve_ms, "n": len(self.placements),
+            "ms": self.solve_ms, "n": len(model_ids),
             "w": int(np.dtype(idx_dtype).itemsize),
         }, separators=(",", ":")).encode()
 
@@ -362,10 +485,10 @@ class GlobalPlan:
         parts = [
             self._MAGIC_V2,
             *framed(header),
-            *framed("\n".join(inst_table).encode()),
-            *framed("\n".join(self.placements).encode()),
-            counts.tobytes(),
-            np.asarray(flat, idx_dtype).tobytes(),
+            *framed("\n".join(inst_ids).encode()),
+            *framed("\n".join(model_ids).encode()),
+            np.ascontiguousarray(counts, np.uint8).tobytes(),
+            np.ascontiguousarray(flat, idx_dtype).tobytes(),
         ]
         return zlib.compress(b"".join(parts), level=1)
 
@@ -397,13 +520,12 @@ class GlobalPlan:
         n = h["n"]
         counts = np.frombuffer(take(n), np.uint8)
         idx_dtype = np.uint16 if h["w"] == 2 else np.uint32
-        flat = np.frombuffer(raw[off:], idx_dtype).tolist()
-        placements: dict[str, list[str]] = {}
-        pos = 0
-        for mid, c in zip(model_ids, counts.tolist()):
-            placements[mid] = [inst_ids[j] for j in flat[pos:pos + c]]
-            pos += c
-        plan = cls(placements, h["t"], h["ms"], h.get("g", 0))
+        flat = np.frombuffer(raw[off:], idx_dtype)
+        # Stay columnar: followers route via lookup(); the dict-of-lists is
+        # only built if a consumer iterates .placements.
+        plan = cls.from_columnar(
+            model_ids, counts, flat, inst_ids, h["t"], h["ms"], h.get("g", 0)
+        )
         plan.adopted_at_ms = now_ms()
         return plan
 
@@ -434,28 +556,57 @@ def solve_plan(
     problem = _expand_problem_device(cols, pad=True)
     sol = jax.block_until_ready(solve_placement(problem, seed=seed))
     t2 = time.perf_counter()
+    # Compact readback: u16 indices + per-row valid counts instead of the
+    # raw i32[N,K] + bool[N,K] (2.1 MB vs 5.2 MB at the padded 100k tier —
+    # the D2H link, not the solve, dominates the refresh on a remote
+    # device). `valid` is a prefix mask by construction (slot < copies is a
+    # prefix; top-k values are descending so the threshold cut is too), so
+    # counts lose nothing. Pinned by test_jax_engine's compact-vs-mask test.
+    idx_dev, cnt_dev = _compact_result(
+        sol, narrow=len(cols.instance_ids) < 65_536
+    )
+    idx_h, cnt_h = jax.device_get((idx_dev, cnt_dev))
     n = len(cols.model_ids)
-    idx = np.asarray(sol.indices)[:n].tolist()
-    valid = np.asarray(sol.valid)[:n].tolist()
-    # Hottest-first insertion order: publish_plan truncates from the tail
-    # under its byte budget, so the models that lose central placement must
-    # be the coldest, not whichever ones the registry iterated last.
-    order = np.argsort(-cols.rates, kind="stable").tolist()
-    model_ids, instance_ids = cols.model_ids, cols.instance_ids
-    placements = {
-        model_ids[i]: [
-            instance_ids[j] for j, ok in zip(idx[i], valid[i]) if ok
-        ]
-        for i in order
-    }
+    idxa = idx_h[:n]
+    counts = cnt_h[:n]
+    # Hottest-first order: publish_plan truncates from the tail under its
+    # byte budget, so the models that lose central placement must be the
+    # coldest, not whichever ones the registry iterated last.
+    order = np.argsort(-cols.rates, kind="stable")
+    idxo = idxa[order]
+    counts = counts[order]
+    valid = np.arange(idxo.shape[1], dtype=np.uint8)[None, :] < counts[:, None]
+    flat = idxo[valid]
+    model_ids = [cols.model_ids[i] for i in order.tolist()]
     t3 = time.perf_counter()
-    plan = GlobalPlan(placements, now_ms(), (t3 - t0) * 1e3)
+    plan = GlobalPlan.from_columnar(
+        model_ids, counts, flat, cols.instance_ids, now_ms(), (t3 - t0) * 1e3
+    )
     plan.stats = {
         "snapshot_ms": (t1 - t0) * 1e3,
         "solve_ms": (t2 - t1) * 1e3,
         "extract_ms": (t3 - t2) * 1e3,
     }
     return plan
+
+
+_compact_jits: dict = {}
+
+
+def _compact_result(sol, narrow: bool):
+    """Jitted epilogue shrinking the solver result before D2H transfer."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = _compact_jits.get(narrow)
+    if fn is None:
+        dtype = jnp.uint16 if narrow else jnp.int32
+
+        def compact(idx, valid):
+            return idx.astype(dtype), valid.sum(1).astype(jnp.uint8)
+
+        fn = _compact_jits[narrow] = jax.jit(compact)
+    return fn(sol.indices, sol.valid)
 
 
 class JaxPlacementStrategy(PlacementStrategy):
@@ -505,7 +656,7 @@ class JaxPlacementStrategy(PlacementStrategy):
             self._plan = plan
             log.info(
                 "placement plan refreshed: %d models x %d instances in %.1f ms",
-                len(plan.placements), len(instances), plan.solve_ms,
+                plan.num_models(), len(instances), plan.solve_ms,
             )
             return plan
 
@@ -525,7 +676,7 @@ class JaxPlacementStrategy(PlacementStrategy):
     ) -> Optional[str]:
         plan = self._plan
         if plan is not None and plan.age_ms() <= self.plan_ttl_ms:
-            desired = plan.placements.get(req.model_id)
+            desired = plan.lookup(req.model_id)
             if desired:
                 live = {iid for iid, rec in view.placeable()}
                 for iid in desired:

@@ -70,19 +70,16 @@ def publish_plan(
     if len(data) > max_bytes:
         # Binary-search-free trim: drop proportionally and re-check once,
         # then hard-drop in halves until under budget.
-        items = list(plan.placements.items())
-        while items and len(data) > max_bytes:
-            keep = max(1, int(len(items) * max_bytes / len(data) * 0.9))
-            if keep >= len(items):
-                keep = len(items) // 2
-            items = items[:keep]
-            trimmed = GlobalPlan(
-                dict(items), plan.solved_at_ms, plan.solve_ms, plan.generation
-            )
-            data = trimmed.to_bytes()
+        n_keep = plan.num_models()
+        while n_keep and len(data) > max_bytes:
+            keep = max(1, int(n_keep * max_bytes / len(data) * 0.9))
+            if keep >= n_keep:
+                keep = n_keep // 2
+            n_keep = keep
+            data = plan.truncate(n_keep).to_bytes()
         log.warning(
             "plan publish truncated to %d models (%d bytes, budget %d)",
-            len(items), len(data), max_bytes,
+            n_keep, len(data), max_bytes,
         )
     store.put(plan_key(prefix), data)
     return len(data)
@@ -140,6 +137,9 @@ class PlanFollower:
                 wall_age / 60_000,
             )
             return
+        # Build the model->row index here, in the watch thread, so the first
+        # routed request after adoption doesn't pay for it.
+        plan.ensure_index()
         with self._lock:
             if mod_rev <= self._last_rev:
                 return

@@ -115,6 +115,63 @@ class TestPlanServing:
         assert plan.placements == {}
 
 
+class TestColumnarPlan:
+    """The solve->publish path stays columnar; the dict is a lazy view."""
+
+    def test_compact_counts_match_valid_mask(self):
+        # The u8-counts readback assumes `valid` is a prefix mask per row
+        # (auction._finalize_topk: slot < copies is a prefix, and top-k
+        # values are descending so the threshold cut is too). Verify the
+        # full mask agrees with the counts on a real solve.
+        import jax
+
+        from modelmesh_tpu.ops.solve import solve_placement
+        from modelmesh_tpu.placement.jax_engine import (
+            _expand_problem_device,
+            snapshot_columns,
+        )
+
+        models = _models(64, loaded_on=["i1", "i3"])
+        instances = _instances(6)
+        cols = snapshot_columns(models, instances)
+        sol = jax.block_until_ready(
+            solve_placement(_expand_problem_device(cols, pad=True))
+        )
+        valid = np.asarray(sol.valid)
+        counts = valid.sum(axis=1)
+        prefix = np.arange(valid.shape[1])[None, :] < counts[:, None]
+        assert (valid == prefix).all(), "valid is not a prefix mask"
+
+    def test_lookup_matches_placements_dict(self):
+        models = _models(32)
+        instances = _instances(4)
+        plan = solve_plan(models, instances)
+        assert plan._placements is None  # still columnar
+        for mid, _ in models:
+            assert plan.lookup(mid) is not None
+        looked = {mid: plan.lookup(mid) for mid, _ in models}
+        assert plan.num_models() == 32
+        # materializing the dict afterwards agrees entry-for-entry
+        assert plan.placements == looked
+        assert plan.lookup("nope") is None
+
+    def test_columnar_roundtrip_and_truncate(self):
+        models = _models(40)
+        instances = _instances(5)
+        plan = solve_plan(models, instances)
+        data = plan.to_bytes()
+        back = type(plan).from_bytes(data)
+        assert back._placements is None  # decoded columnar, no dict built
+        assert back.placements == plan.placements
+        cut = plan.truncate(7)
+        assert cut.num_models() == 7
+        kept = list(plan.placements)[:7]
+        assert list(cut.placements) == kept
+        assert all(cut.placements[k] == plan.placements[k] for k in kept)
+        # truncate survives serialization too
+        assert type(plan).from_bytes(cut.to_bytes()).placements == cut.placements
+
+
 class TestClusterWithJaxStrategy:
     def test_end_to_end_with_global_plan(self):
         from modelmesh_tpu.runtime import ModelInfo

@@ -1,0 +1,137 @@
+"""On-hardware stage profiler for the placement solve (run when the axon
+tunnel is up; every timing forces a scalar readback so the experimental
+platform's async dispatch cannot fake a number).
+
+Decomposes the 100k x 1k solve into: H2D transfer, cost assembly, Sinkhorn
+(pallas vs xla LSE), plan logits, auction rounding, full solve — at both the
+unpadded tier (100000 x 1000, what bench.py used to measure) and the
+bucket-padded tier (131072 x 1024, what solve_plan runs) — to localize the
+~900x kernel-vs-e2e discrepancy recorded in BENCH_TPU_EVIDENCE.md.
+
+Usage:  python tools/tpu_profile.py [N] [M] [--reps R]
+Writes one JSON line per measurement to stdout; tee it somewhere durable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def probe(timeout_s: float = 90.0) -> bool:
+    proc = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        timeout=timeout_s, capture_output=True,
+    )
+    return proc.returncode == 0
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("n", type=int, nargs="?", default=100_000)
+    ap.add_argument("m", type=int, nargs="?", default=1_000)
+    ap.add_argument("--reps", type=int, default=3)
+    parsed = ap.parse_args()
+    n, m, reps = parsed.n, parsed.m, parsed.reps
+
+    force_cpu = os.environ.get("MM_PROFILE_CPU") == "1"
+    if not force_cpu:
+        try:
+            if not probe():
+                print(json.dumps({"error": "accelerator unreachable"}))
+                return 1
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"error": "accelerator probe timeout"}))
+            return 1
+
+    import jax
+
+    if force_cpu:
+        # The ambient sitecustomize forces jax_platforms at startup; the
+        # env var alone is not enough (see .claude/skills/verify).
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modelmesh_tpu import ops
+    from modelmesh_tpu.ops import costs as costs_mod
+    from modelmesh_tpu.ops.sinkhorn import plan_logits, sinkhorn
+    from modelmesh_tpu.ops.auction import auction
+    from modelmesh_tpu.ops.solve import SolveConfig, solve_placement
+    from modelmesh_tpu.placement import jax_engine as je
+    from modelmesh_tpu.placement.synthetic import synthetic_records
+
+    dev = jax.devices()[0]
+    out = {"platform": dev.platform, "device": str(dev), "n": n, "m": m}
+    print(json.dumps({"stage": "init", **out}), flush=True)
+
+    def timed(name, fn, *a, **k):
+        """Warm once, then time `reps` runs; each run blocks AND reads one
+        scalar back to host (sum of the first leaf) so completion is
+        provable."""
+        def force(res):
+            leaf = jax.tree_util.tree_leaves(res)[0]
+            return float(jnp.sum(leaf.astype(jnp.float32)).block_until_ready())
+
+        res = fn(*a, **k)
+        force(res)
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            force(fn(*a, **k))
+            ts.append((time.perf_counter() - t0) * 1e3)
+        print(json.dumps({"stage": name, "ms_min": round(min(ts), 2),
+                          "ms_all": [round(t, 1) for t in ts]}), flush=True)
+        return res
+
+    for label, make in (
+        ("random-unpadded", lambda: jax.device_put(
+            ops.random_problem(jax.random.PRNGKey(0), n, m,
+                               capacity_slack=2.0), dev)),
+        ("expanded-padded", lambda: je._expand_problem_device(
+            je.snapshot_columns(*synthetic_records(n, m)), pad=True)),
+    ):
+        problem = make()
+        jax.block_until_ready(problem)
+        np_, mp_ = problem.sizes.shape[0], problem.capacity.shape[0]
+        print(json.dumps({"problem": label, "shape": [np_, mp_]}), flush=True)
+
+        timed(f"{label}:full-solve", solve_placement, problem, seed=1)
+
+        C = timed(f"{label}:assemble-cost", costs_mod.assemble_cost, problem)
+        row_mass = problem.sizes * jnp.minimum(problem.copies, 8).astype(
+            jnp.float32)
+        free = jnp.maximum(problem.capacity - problem.reserved, 0.0)
+        sk = None
+        for impl in ("pallas", "xla"):
+            try:
+                sk = timed(f"{label}:sinkhorn-{impl}", sinkhorn, C, row_mass,
+                           free, eps=0.05, iters=10, lse_impl=impl)
+            except Exception as e:  # noqa: BLE001
+                print(json.dumps({"stage": f"{label}:sinkhorn-{impl}",
+                                  "error": f"{type(e).__name__}: {e}"}),
+                      flush=True)
+        if sk is None:
+            continue  # both LSE impls failed at this tier; potentials from
+            # another tier would shape-mismatch the cost matrix
+        logits = timed(f"{label}:plan-logits", jax.jit(plan_logits),
+                       C, sk.f, sk.g, 0.05)
+        timed(f"{label}:auction", auction, logits, problem.sizes,
+              jnp.minimum(problem.copies, 8), free, problem.feasible, 1)
+        # f32 vs bf16 cost dtype on the full solve
+        timed(f"{label}:full-solve-f32", solve_placement, problem,
+              SolveConfig(dtype=jnp.float32), seed=1)
+        timed(f"{label}:full-solve-xla-lse", solve_placement, problem,
+              SolveConfig(lse_impl="xla"), seed=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

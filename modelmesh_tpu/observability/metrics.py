@@ -165,9 +165,66 @@ class PrometheusMetrics(Metrics):
 
     # -- exposition ----------------------------------------------------------
 
+    def _process_lines(self) -> list[str]:
+        """Process-level exports, the analog of the reference's hotspot
+        collectors (prometheus/hotspot/*: JVM memory, GC, FD gauges) for a
+        CPython process. Read at scrape time; every read is best-effort
+        (a platform missing /proc or `resource` just drops those lines)."""
+        import gc
+        import sys as _sys
+
+        lines: list[str] = []
+        inst = (
+            '{instance="%s"}' % self.instance_id if self.instance_id else ""
+        )
+
+        def emit(name: str, kind: str, help_: str, value: float) -> None:
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name}{inst} {value}")
+
+        try:
+            import resource
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            # ru_maxrss unit: KiB on Linux, bytes on macOS.
+            scale = 1 if _sys.platform == "darwin" else 1024
+            emit("mm_process_max_rss_bytes", "gauge",
+                 "peak resident set size", ru.ru_maxrss * scale)
+            emit("mm_process_cpu_seconds_total", "counter",
+                 "user+system CPU time", ru.ru_utime + ru.ru_stime)
+            try:
+                with open("/proc/self/statm") as f:
+                    rss_pages = int(f.read().split()[1])
+                emit("mm_process_rss_bytes", "gauge",
+                     "current resident set size",
+                     rss_pages * resource.getpagesize())
+            except Exception:  # noqa: BLE001
+                pass
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            import os as _os
+
+            emit("mm_process_open_fds", "gauge", "open file descriptors",
+                 len(_os.listdir("/proc/self/fd")))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            emit("mm_process_threads", "gauge", "live python threads",
+                 threading.active_count())
+            emit("mm_python_gc_pending_gen0", "gauge",
+                 "objects pending in gc gen 0", gc.get_count()[0])
+            emit("mm_python_gc_collections_total", "counter",
+                 "completed gc collections (all generations)",
+                 sum(s["collections"] for s in gc.get_stats()))
+        except Exception:  # noqa: BLE001
+            pass
+        return lines
+
     def render(self) -> str:
         by_name: dict[str, Metric] = {m.metric_name: m for m in Metric}
-        lines: list[str] = []
+        lines: list[str] = self._process_lines()
         inst = (
             f'instance="{self.instance_id}"' if self.instance_id else ""
         )

@@ -64,6 +64,27 @@ class TestPrometheusMetrics:
         finally:
             m.close()
 
+    def test_process_exports_present(self):
+        """Process-level exports (the reference's hotspot-collector analog,
+        prometheus/hotspot/*) appear on every scrape with sane values and
+        carry the instance label like every other series on the page."""
+        import os
+
+        m = PrometheusMetrics(start_server=False, instance_id="iZ")
+        text = m.render()
+        names = ["mm_process_threads"]
+        if os.path.exists("/proc/self/statm"):
+            names += ["mm_process_rss_bytes", "mm_process_open_fds"]
+        for name in names:
+            assert f"# TYPE {name} gauge" in text, name
+            val = float(
+                next(ln for ln in text.splitlines()
+                     if ln.startswith(name + "{")).split()[1]
+            )
+            assert val > 0, name
+        # cumulative series are typed counter, not gauge
+        assert "# TYPE mm_process_cpu_seconds_total counter" in text
+
     def test_statsd_does_not_crash_without_server(self):
         s = StatsDMetrics(port=18125)
         s.inc(Metric.LOAD_COUNT)

@@ -1,0 +1,102 @@
+"""Deploy-manifest sanity: the kustomize tree stays consistent with the
+code it deploys (no kustomize binary in this image, so structural checks
+stand in for a `kustomize build`).
+
+Reference analog: config/base + patch overlays (SURVEY.md section 5.6);
+the reference's CI materializes them in the docker build.
+"""
+
+import pathlib
+import re
+
+import yaml
+
+DEPLOY = pathlib.Path(__file__).resolve().parent.parent / "deploy" / "kubernetes"
+MAIN_PY = (
+    pathlib.Path(__file__).resolve().parent.parent
+    / "modelmesh_tpu" / "serving" / "main.py"
+)
+
+
+def _all_yaml_docs():
+    for path in sorted(DEPLOY.rglob("*.yaml")):
+        for doc in yaml.safe_load_all(path.read_text()):
+            if doc:
+                yield path, doc
+
+
+def _containers(doc):
+    tmpl = doc.get("spec", {}).get("template", {})
+    return tmpl.get("spec", {}).get("containers", [])
+
+
+class TestManifests:
+    def test_all_parse(self):
+        docs = list(_all_yaml_docs())
+        assert len(docs) >= 10  # base(4 objects + kustomization) + overlays
+
+    def test_flat_manifest_matches_base(self):
+        """The single-file convenience manifest and the kustomize base must
+        contain the same objects (kind, name) — they are two views of one
+        deployment."""
+        flat = {
+            (d["kind"], d["metadata"]["name"])
+            for d in yaml.safe_load_all(
+                (DEPLOY / "modelmesh-tpu.yaml").read_text()
+            )
+            if d
+        }
+        base = set()
+        for f in (DEPLOY / "base").glob("*.yaml"):
+            for d in yaml.safe_load_all(f.read_text()):
+                if d and d.get("kind") != "Kustomization":
+                    base.add((d["kind"], d["metadata"]["name"]))
+        assert flat == base
+
+    def test_mesh_args_are_real_cli_flags(self):
+        """Every --flag passed to the mesh container exists in
+        serving/main.py's argparse — catches manifest drift when flags are
+        renamed."""
+        known = set(re.findall(r'add_argument\(\s*"(--[a-z-]+)"',
+                               MAIN_PY.read_text()))
+        assert known, "failed to extract flags from main.py"
+        for path, doc in _all_yaml_docs():
+            for c in _containers(doc):
+                if c.get("name") != "mesh":
+                    continue
+                for arg in c.get("args", []):
+                    if not arg.startswith("--"):
+                        continue
+                    flag = arg.split("=", 1)[0]
+                    assert flag in known, f"{path.name}: unknown flag {flag}"
+
+    def test_mm_env_names_registered(self):
+        """MM_* env vars set in manifests are registered knobs (or the
+        documented inter-container URI var)."""
+        from modelmesh_tpu.utils import envs
+
+        allowed = set(envs.REGISTRY) | {"MM_KV_URI"}
+        for path, doc in _all_yaml_docs():
+            for c in _containers(doc):
+                for e in c.get("env", []) or []:
+                    name = e.get("name", "")
+                    if name.startswith("MM_"):
+                        assert name in allowed, f"{path.name}: {name}"
+
+    def test_probe_paths_match_prestop_server(self):
+        """/ready, /live, /prestop wired in the base must be routes the
+        PreStopServer actually serves (serving/bootstrap.py)."""
+        src = (
+            MAIN_PY.parent / "bootstrap.py"
+        ).read_text()
+        base_dep = yaml.safe_load(
+            (DEPLOY / "base" / "deployment.yaml").read_text()
+        )
+        mesh = next(c for c in _containers(base_dep) if c["name"] == "mesh")
+        paths = [
+            mesh["readinessProbe"]["httpGet"]["path"],
+            mesh["livenessProbe"]["httpGet"]["path"],
+            mesh["lifecycle"]["preStop"]["httpGet"]["path"],
+        ]
+        for p in paths:
+            assert f'"{p}"' in src or f"'{p}'" in src, f"unserved probe path {p}"

@@ -36,22 +36,72 @@ class TestManifests:
         assert len(docs) >= 10  # base(4 objects + kustomization) + overlays
 
     def test_flat_manifest_matches_base(self):
-        """The single-file convenience manifest and the kustomize base must
-        contain the same objects (kind, name) — they are two views of one
-        deployment."""
+        """The single-file convenience manifest is GENERATED from the base
+        (tools/regen_flat_manifest.py) — assert full semantic equality, not
+        just matching object names, so base edits can't silently diverge."""
         flat = {
-            (d["kind"], d["metadata"]["name"])
+            (d["kind"], d["metadata"]["name"]): d
             for d in yaml.safe_load_all(
                 (DEPLOY / "modelmesh-tpu.yaml").read_text()
             )
             if d
         }
-        base = set()
+        base = {}
         for f in (DEPLOY / "base").glob("*.yaml"):
             for d in yaml.safe_load_all(f.read_text()):
                 if d and d.get("kind") != "Kustomization":
-                    base.add((d["kind"], d["metadata"]["name"]))
-        assert flat == base
+                    base[(d["kind"], d["metadata"]["name"])] = d
+        assert flat == base, "run tools/regen_flat_manifest.py"
+
+    def test_json6902_patches_target_mesh_container(self):
+        """Overlay json6902 ops hardcode container index 0 — pin that the
+        mesh container IS containers[0] in the base, and that appended
+        --flags are real CLI flags."""
+        base_dep = yaml.safe_load(
+            (DEPLOY / "base" / "deployment.yaml").read_text()
+        )
+        assert _containers(base_dep)[0]["name"] == "mesh"
+        known = set(re.findall(r'add_argument\(\s*"(--[a-z-]+)"',
+                               MAIN_PY.read_text()))
+        for kfile in DEPLOY.glob("overlays/*/kustomization.yaml"):
+            kust = yaml.safe_load(kfile.read_text())
+            for entry in kust.get("patches", []):
+                patch = entry.get("patch")
+                if not patch or not patch.lstrip().startswith("- op"):
+                    continue
+                for op in yaml.safe_load(patch):
+                    path = op.get("path", "")
+                    if "/containers/" in path:
+                        assert path.startswith(
+                            "/spec/template/spec/containers/0/"
+                        ), f"{kfile}: {path}"
+                    val = op.get("value", "")
+                    if isinstance(val, str) and val.startswith("--"):
+                        flag = val.split("=", 1)[0]
+                        assert flag in known, f"{kfile}: unknown flag {flag}"
+
+    def test_overlay_arg_lists_keep_base_args(self):
+        """Overlays that restate the mesh args list wholesale (strategic
+        merge replaces lists) must keep every base arg except ones they
+        intentionally override — catches silent reverts when base args
+        change."""
+        base_dep = yaml.safe_load(
+            (DEPLOY / "base" / "deployment.yaml").read_text()
+        )
+        base_args = next(
+            c for c in _containers(base_dep) if c["name"] == "mesh"
+        )["args"]
+        overridable = {"--runtime"}
+        base_keys = {a.split("=", 1)[0] for a in base_args}
+        for path, doc in _all_yaml_docs():
+            if "overlays" not in str(path) or doc.get("kind") != "Deployment":
+                continue
+            for c in _containers(doc):
+                if c.get("name") != "mesh" or "args" not in c:
+                    continue
+                keys = {a.split("=", 1)[0] for a in c["args"]}
+                missing = base_keys - keys - overridable
+                assert not missing, f"{path.name} drops base args {missing}"
 
     def test_mesh_args_are_real_cli_flags(self):
         """Every --flag passed to the mesh container exists in

@@ -1,0 +1,30 @@
+"""Regenerate deploy/kubernetes/modelmesh-tpu.yaml from the kustomize base.
+
+The flat manifest is a convenience view of kubernetes/base/*.yaml;
+tests/test_deploy_manifests.py asserts they stay semantically identical.
+"""
+
+import pathlib
+
+BASE = pathlib.Path(__file__).resolve().parent.parent / "deploy" / "kubernetes"
+ORDER = ("deployment.yaml", "service.yaml", "meshkv.yaml")
+HEADER = (
+    "# modelmesh-tpu serving deployment (FLAT convenience manifest).\n"
+    "#\n"
+    "# GENERATED: this file is the concatenation of kubernetes/base/*.yaml\n"
+    "# (regenerate with tools/regen_flat_manifest.py; pinned by\n"
+    "# tests/test_deploy_manifests.py). Use `kubectl apply -k` with the\n"
+    "# base or an overlay for anything beyond a quick start.\n"
+)
+
+
+def main() -> None:
+    parts = [(BASE / "base" / f).read_text().rstrip("\n") for f in ORDER]
+    (BASE / "modelmesh-tpu.yaml").write_text(
+        HEADER + "\n---\n".join(parts) + "\n"
+    )
+    print("regenerated deploy/kubernetes/modelmesh-tpu.yaml")
+
+
+if __name__ == "__main__":
+    main()

@@ -125,6 +125,49 @@ def main() -> int:
                        C, sk.f, sk.g, 0.05)
         timed(f"{label}:auction", auction, logits, problem.sizes,
               jnp.minimum(problem.copies, 8), free, problem.feasible, 1)
+        # auction sub-stages: localize whether the price loop's
+        # approx_max_k shortlist, the exact top_k select, or the scatter
+        # is the hot spot on this platform
+        from modelmesh_tpu.ops.auction import (
+            K_CAND,
+            _NEG_INF,
+            _implied_load,
+            _select,
+            gumbel_perturb,
+            select_from_candidates,
+            shortlist,
+        )
+
+        # seed is TRACED (matching auction's handling) so XLA can't
+        # constant-fold any of the key/noise pipeline out of the timing
+        scores = timed(
+            f"{label}:gumbel-feasible",
+            jax.jit(lambda s, f_, sd: jnp.where(
+                f_, gumbel_perturb(s, 1.0, sd), _NEG_INF
+            )),
+            logits, problem.feasible, jnp.uint32(1),
+        )
+        price = jnp.zeros((mp_,), jnp.float32)
+        kc = min(K_CAND, mp_)
+        cand_vals, cand_idx = timed(
+            f"{label}:shortlist-approx-max-k",
+            jax.jit(shortlist, static_argnums=2), scores, price, kc,
+        )
+        timed(
+            f"{label}:select-from-candidates",
+            jax.jit(select_from_candidates),
+            cand_vals, cand_idx, jnp.minimum(problem.copies, 8), price,
+        )
+        sel_idx, sel_valid = timed(
+            f"{label}:full-width-topk",
+            jax.jit(_select),
+            scores - price[None, :], jnp.minimum(problem.copies, 8),
+        )
+        timed(
+            f"{label}:implied-load-scatter",
+            jax.jit(_implied_load, static_argnums=3),
+            sel_idx, sel_valid, problem.sizes, mp_,
+        )
         # f32 vs bf16 cost dtype on the full solve
         timed(f"{label}:full-solve-f32", solve_placement, problem,
               SolveConfig(dtype=jnp.float32), seed=1)

@@ -179,7 +179,7 @@ def _bucket(x: int, floor: int = 256) -> int:
     return three_q if x <= three_q else p
 
 
-def _expand_problem_device(cols: ProblemColumns, pad: bool):
+def _expand_problem_device(cols: ProblemColumns, pad: bool, mesh=None):
     """Build the PlacementProblem ON DEVICE from columnar inputs.
 
     With ``pad=True``, N/M/nnz are padded to buckets; padded rows are inert
@@ -187,6 +187,11 @@ def _expand_problem_device(cols: ProblemColumns, pad: bool):
     columns are inert (placeable=False → infeasible, free capacity 0).
     Norm-sensitive vectors (rates/busy/lru_age) pad with their real minimum
     so _minmax_norm of the real entries is unchanged by padding.
+
+    With ``mesh``, the assembled problem comes out with the sharded
+    solver's layout (model-axis arrays on ``mdl``, instance-axis on
+    ``inst``, matrices on both) — GSPMD partitions the expansion so no
+    device materializes the full [N, M] masks.
     """
     import jax.numpy as jnp
 
@@ -223,7 +228,7 @@ def _expand_problem_device(cols: ProblemColumns, pad: bool):
     if m_p != m:
         req_masks = np.pad(req_masks, ((0, 0), (0, m_p - m)))
         pref_masks = np.pad(pref_masks, ((0, 0), (0, m_p - m)))
-    return _ensure_assemble_jit()(
+    return _ensure_assemble_jit(mesh)(
         jnp.asarray(sizes), jnp.asarray(copies), jnp.asarray(rates),
         jnp.asarray(rows), jnp.asarray(ccols), jnp.asarray(type_idx),
         jnp.asarray(req_masks), jnp.asarray(pref_masks),
@@ -249,16 +254,35 @@ def _assemble(sizes, copies, rates, rows, ccols, type_idx, req_masks,
     )
 
 
-_assemble_jit = None  # populated lazily so importing this module stays light
+_assemble_jits: dict = {}  # keyed by mesh (None = default device)
 
 
-def _ensure_assemble_jit():
-    global _assemble_jit
-    if _assemble_jit is None:
+def _ensure_assemble_jit(mesh=None):
+    fn = _assemble_jits.get(mesh)
+    if fn is None:
         import jax
 
-        _assemble_jit = jax.jit(_assemble)
-    return _assemble_jit
+        if mesh is None:
+            fn = jax.jit(_assemble)
+        else:
+            from modelmesh_tpu.parallel.mesh import problem_shardings
+
+            fn = jax.jit(_assemble, out_shardings=problem_shardings(mesh))
+        _assemble_jits[mesh] = fn
+    return fn
+
+
+_sharded_solvers: dict = {}
+
+
+def _solver_for(mesh):
+    """jitted sharded solver per mesh (rebuilding would recompile)."""
+    solver = _sharded_solvers.get(mesh)
+    if solver is None:
+        from modelmesh_tpu.parallel.sharded_solver import make_sharded_solver
+
+        solver = _sharded_solvers[mesh] = make_sharded_solver(mesh)
+    return solver
 
 
 def build_problem(
@@ -379,8 +403,11 @@ class GlobalPlan:
         if row is None:
             return None
         _, counts, flat, inst_ids = self._columnar
+        # int() both operands: python_int + np.uint8 coerces INTO uint8
+        # under NumPy 2 and overflows at offset 256.
         start = int(self._offsets[row])
-        return [inst_ids[j] for j in flat[start:start + counts[row]].tolist()]
+        end = start + int(counts[row])
+        return [inst_ids[j] for j in flat[start:end].tolist()]
 
     def truncate(self, keep: int) -> "GlobalPlan":
         """First ``keep`` models (placement order = hottest first), for the
@@ -536,6 +563,7 @@ def solve_plan(
     rpm_fn: Optional[RpmSource] = None,
     seed: int = 0,
     constraints=None,
+    mesh=None,
 ) -> GlobalPlan:
     """One global solve -> GlobalPlan (blocking; runs on the JAX device).
 
@@ -543,6 +571,11 @@ def solve_plan(
     extraction, milliseconds) — the e2e refresh cost, not just the kernel
     (round-2 VERDICT weak #2). Shapes are bucket-padded so consecutive
     refreshes with drifting model counts reuse the compiled solver.
+
+    ``mesh``: a parallel.mesh device mesh shards the solve across chips
+    (parallel/sharded_solver.py) — the 1M x 10k ladder path. Bucket sizes
+    are powers of two or 3·2^k, so any power-of-two mesh axis ≤ the pad
+    floors (256 rows, 64 cols) divides them evenly.
     """
     import jax
 
@@ -553,8 +586,27 @@ def solve_plan(
     t0 = time.perf_counter()
     cols = snapshot_columns(models, instances, rpm_fn, constraints=constraints)
     t1 = time.perf_counter()
-    problem = _expand_problem_device(cols, pad=True)
-    sol = jax.block_until_ready(solve_placement(problem, seed=seed))
+    if mesh is not None:
+        from modelmesh_tpu.parallel.mesh import INSTANCE_AXIS, MODEL_AXIS
+
+        if MODEL_AXIS not in mesh.shape or INSTANCE_AXIS not in mesh.shape:
+            raise ValueError(
+                f"mesh axes {tuple(mesh.shape)} != "
+                f"({MODEL_AXIS!r}, {INSTANCE_AXIS!r}); build with "
+                "parallel.mesh.make_mesh"
+            )
+        n_mdl, n_inst = mesh.shape[MODEL_AXIS], mesh.shape[INSTANCE_AXIS]
+        if _bucket(len(cols.model_ids)) % n_mdl or (
+            _bucket(len(cols.instance_ids), 64) % n_inst
+        ):
+            raise ValueError(
+                f"mesh {dict(mesh.shape)} does not divide the padded problem"
+            )
+        problem = _expand_problem_device(cols, pad=True, mesh=mesh)
+        sol = jax.block_until_ready(_solver_for(mesh)(problem, seed=seed))
+    else:
+        problem = _expand_problem_device(cols, pad=True)
+        sol = jax.block_until_ready(solve_placement(problem, seed=seed))
     t2 = time.perf_counter()
     # Compact readback: u16 indices + per-row valid counts instead of the
     # raw i32[N,K] + bool[N,K] (2.1 MB vs 5.2 MB at the padded 100k tier —
@@ -625,6 +677,7 @@ class JaxPlacementStrategy(PlacementStrategy):
         plan_ttl_ms: int = 15 * 60_000,
         fallback: Optional[PlacementStrategy] = None,
         constraints=None,
+        mesh=None,
     ):
         self.plan_ttl_ms = plan_ttl_ms
         self.fallback = fallback or GreedyStrategy()
@@ -632,6 +685,23 @@ class JaxPlacementStrategy(PlacementStrategy):
         # (like greedy's) so solves honor required masks and preferred
         # labels (build_problem feasible/preferred).
         self.constraints = constraints
+        # mesh=None solves on the default device; mesh="auto" shards
+        # refreshes across all visible devices (multi-chip leader hosts —
+        # the 1M x 10k ladder tier); a parallel.mesh Mesh is explicit.
+        # Opt-in rather than defaulted: an instance's JAX devices are not
+        # necessarily a placement-solver pool.
+        if mesh == "auto":
+            import jax
+
+            from modelmesh_tpu.parallel.mesh import make_mesh
+
+            devs = jax.devices()
+            # Largest power-of-two subset: bucket-padded shapes are 2^k or
+            # 3·2^k, so power-of-two axes always divide them; a 6- or
+            # 12-device host must not turn every refresh into a ValueError.
+            usable = 1 << (len(devs).bit_length() - 1)
+            mesh = make_mesh(devices=devs[:usable]) if usable > 1 else None
+        self.mesh = mesh
         self._plan: Optional[GlobalPlan] = None
         self._seed = 0
         self._refresh_lock = threading.Lock()
@@ -650,7 +720,7 @@ class JaxPlacementStrategy(PlacementStrategy):
             self._seed += 1
             plan = solve_plan(
                 models, instances, rpm_fn, seed=self._seed,
-                constraints=self.constraints,
+                constraints=self.constraints, mesh=self.mesh,
             )
             plan.generation = self._seed
             self._plan = plan

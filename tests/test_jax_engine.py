@@ -172,6 +172,55 @@ class TestColumnarPlan:
         assert type(plan).from_bytes(cut.to_bytes()).placements == cut.placements
 
 
+class TestShardedRefresh:
+    """solve_plan(mesh=...) — the leader's refresh sharded across chips
+    (8 virtual CPU devices here, the conftest mesh)."""
+
+    def test_sharded_plan_structurally_valid(self):
+        from modelmesh_tpu.parallel.mesh import make_mesh
+
+        models = _models(512, loaded_on=["i0", "i2"])
+        instances = _instances(8)
+        mesh = make_mesh()  # all 8 virtual devices on the model axis
+        plan = solve_plan(models, instances, mesh=mesh)
+        single = solve_plan(models, instances)
+        assert plan.num_models() == single.num_models() == 512
+        iids = {iid for iid, _ in instances}
+        for mid, _ in models:
+            targets = plan.lookup(mid)
+            assert targets is not None and targets, mid
+            assert set(targets) <= iids
+            assert len(set(targets)) == len(targets)  # distinct copies
+
+    def test_strategy_auto_mesh_refresh(self):
+        strat = JaxPlacementStrategy(mesh="auto")
+        assert strat.mesh is not None  # conftest forces 8 CPU devices
+        models = _models(256)
+        instances = _instances(4)
+        plan = strat.refresh(models, instances)
+        assert plan.num_models() == 256
+        req = PlacementRequest(
+            model_id=models[0][0], model=models[0][1], required_units=64,
+            requesting_instance="i-other",
+        )
+        assert strat.choose_load_target(
+            req, ClusterView(instances=instances)
+        ) is not None
+
+    def test_indivisible_mesh_rejected(self):
+        import numpy as np_
+
+        import jax
+        from jax.sharding import Mesh
+
+        from modelmesh_tpu.parallel.mesh import INSTANCE_AXIS, MODEL_AXIS
+
+        devs = np_.asarray(jax.devices()[:3]).reshape(3, 1)
+        mesh = Mesh(devs, (MODEL_AXIS, INSTANCE_AXIS))
+        with pytest.raises(ValueError, match="does not divide"):
+            solve_plan(_models(64), _instances(4), mesh=mesh)
+
+
 class TestClusterWithJaxStrategy:
     def test_end_to_end_with_global_plan(self):
         from modelmesh_tpu.runtime import ModelInfo

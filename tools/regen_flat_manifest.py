@@ -6,8 +6,9 @@ tests/test_deploy_manifests.py asserts they stay semantically identical.
 
 import pathlib
 
+import yaml
+
 BASE = pathlib.Path(__file__).resolve().parent.parent / "deploy" / "kubernetes"
-ORDER = ("deployment.yaml", "service.yaml", "meshkv.yaml")
 HEADER = (
     "# modelmesh-tpu serving deployment (FLAT convenience manifest).\n"
     "#\n"
@@ -19,7 +20,14 @@ HEADER = (
 
 
 def main() -> None:
-    parts = [(BASE / "base" / f).read_text().rstrip("\n") for f in ORDER]
+    # The base kustomization's resources list is the single source of
+    # truth for which files (and in what order) make up the deployment —
+    # the same set `kubectl apply -k` would materialize.
+    kust = yaml.safe_load((BASE / "base" / "kustomization.yaml").read_text())
+    parts = [
+        (BASE / "base" / f).read_text().rstrip("\n")
+        for f in kust["resources"]
+    ]
     (BASE / "modelmesh-tpu.yaml").write_text(
         HEADER + "\n---\n".join(parts) + "\n"
     )

@@ -147,9 +147,13 @@ class MeshApiServicer:
     def GetModelStatus(self, request, context):
         # Reserved diagnostic id: dump full cache + cluster state (the
         # reference's ***LOGCACHE***/***GETSTATE*** facility).
-        from modelmesh_tpu.serving.bootstrap import STATE_DUMP_ID, debug_dump
+        from modelmesh_tpu.serving.bootstrap import (
+            STATE_DUMP_ALIASES,
+            STATE_DUMP_ID,
+            debug_dump,
+        )
 
-        if request.model_id == STATE_DUMP_ID:
+        if request.model_id in STATE_DUMP_ALIASES:
             import json as _json
 
             return apb.ModelStatusInfo(

@@ -30,6 +30,11 @@ from modelmesh_tpu.serving.instance import ModelMeshInstance
 log = logging.getLogger(__name__)
 
 STATE_DUMP_ID = "***STATE***"
+# The reference's reserved diagnostic ids (ModelMesh.java:3248-3253) are
+# accepted as aliases so migrated runbooks keep working.
+STATE_DUMP_ALIASES = frozenset(
+    {STATE_DUMP_ID, "***GETSTATE***", "***LOGCACHE***"}
+)
 STATIC_MODELS_ENV = "MM_STATIC_MODELS"
 
 
@@ -159,6 +164,13 @@ def debug_dump(instance: ModelMeshInstance) -> dict:
         },
         "cluster": instances,
         "registry": registry,
+        # Shadow-mode evaluation report, when the strategy runs one
+        # (placement/shadow.py): agreement rates + recent divergences.
+        **(
+            {"shadow": instance.strategy.shadow_stats()}
+            if hasattr(instance.strategy, "shadow_stats")
+            else {}
+        ),
     }
 
 

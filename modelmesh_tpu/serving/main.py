@@ -89,7 +89,12 @@ def main(argv=None) -> None:
     parser.add_argument("--capacity-mb", type=int, default=256)
     parser.add_argument("--metrics-port", type=int, default=-1)
     parser.add_argument("--prestop-port", type=int, default=-1)
-    parser.add_argument("--strategy", choices=["greedy", "jax"], default="greedy")
+    parser.add_argument(
+        "--strategy", choices=["greedy", "jax", "shadow"], default="greedy",
+        help="placement decisions: greedy heuristics, the jax global plan, "
+        "or shadow (serve greedy while scoring the jax plan on the side - "
+        "read agreement in the ***GETSTATE*** dump before promoting)",
+    )
     parser.add_argument("--load-timeout-s", type=float, default=None)
     parser.add_argument("--tls-cert", default="", help="server cert PEM path")
     parser.add_argument("--tls-key", default="", help="server key PEM path")
@@ -154,6 +159,15 @@ def main(argv=None) -> None:
         from modelmesh_tpu.placement.jax_engine import JaxPlacementStrategy
 
         strategy = JaxPlacementStrategy()
+    elif args.strategy == "shadow":
+        from modelmesh_tpu.placement.greedy import GreedyStrategy
+        from modelmesh_tpu.placement.jax_engine import JaxPlacementStrategy
+        from modelmesh_tpu.placement.shadow import ShadowStrategy
+
+        # Serve greedy; score the jax plan on every decision. The shadow's
+        # own fallback is greedy too, so "agreement" during plan gaps is
+        # trivially high — the interesting rate is while a plan is live.
+        strategy = ShadowStrategy(GreedyStrategy(), JaxPlacementStrategy())
 
     from modelmesh_tpu.serving.health import BootstrapProbation
 

@@ -67,6 +67,8 @@ def sinkhorn(
     eps: float = 0.05,
     iters: int = 12,
     lse_impl: str = "auto",
+    f0: jax.Array | None = None,
+    g0: jax.Array | None = None,
 ) -> SinkhornResult:
     """Semi-unbalanced log-domain Sinkhorn: rows are equalities (every
     model's copy-mass must place), columns are CAPS.
@@ -78,6 +80,14 @@ def sinkhorn(
     absorb its proportional share even when the whole fleet prefers a
     subset — nullifying cost-pool preferences (the `preferred` label term)
     whenever there is slack, which is most of the time.
+
+    ``f0``/``g0`` warm-start the potentials (SURVEY.md section 7 hard part
+    #4: incremental solves as state churns). Between consecutive refreshes
+    the problem barely moves, so last solve's potentials are a few
+    iterations from the new fixed point — same iteration budget converges
+    tighter, or a reduced budget matches cold quality. The first f-update
+    overwrites f from g0, so only g0's quality matters mathematically;
+    passing f0 too keeps the API symmetric for the price loop's caller.
     """
     row_mass = row_mass.astype(jnp.float32)
     col_mass = col_mass.astype(jnp.float32)
@@ -112,9 +122,12 @@ def sinkhorn(
         g = jnp.minimum(0.0, eps * (log_b - col_fn(C, f)))
         return (f, g), None
 
-    f0 = jnp.zeros_like(log_a)
-    g0 = jnp.zeros_like(log_b)
-    (f, g), _ = jax.lax.scan(body, (f0, g0), None, length=iters)
+    f_init = jnp.zeros_like(log_a) if f0 is None else f0.astype(jnp.float32)
+    g_init = (
+        jnp.minimum(0.0, g0.astype(jnp.float32))  # g <= 0 invariant
+        if g0 is not None else jnp.zeros_like(log_b)
+    )
+    (f, g), _ = jax.lax.scan(body, (f_init, g_init), None, length=iters)
 
     # Diagnostic: row-marginal violation of the implied plan.
     row_sum = jnp.exp((f + eps * row_fn(C, g)) / eps)

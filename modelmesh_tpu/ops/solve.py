@@ -52,6 +52,18 @@ class Placement(NamedTuple):
     load: jax.Array      # f32[M]
     overflow: jax.Array  # f32[]
     row_err: jax.Array   # f32[] sinkhorn marginal diagnostic
+    f: jax.Array | None = None  # f32[N] row potentials (warm-start carry)
+    g: jax.Array | None = None  # f32[M] column potentials
+
+
+class SolveInit(NamedTuple):
+    """Warm-start carry from a previous solve (SURVEY.md section 7 hard
+    part #4: incremental solves as cluster state churns). Rows must be
+    id-aligned to the CURRENT problem's row order by the caller
+    (placement/jax_engine.py scatters by model id)."""
+
+    g0: jax.Array        # f32[M] column potentials (the part that matters)
+    f0: jax.Array | None = None  # f32[N] row potentials
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -59,10 +71,13 @@ def solve_placement(
     problem: costs_mod.PlacementProblem,
     config: SolveConfig = SolveConfig(),
     seed: jax.Array | int = 0x5EED,
+    init: SolveInit | None = None,
 ) -> Placement:
     """Solve one global placement. ``seed`` is traced — vary it per solve
     (e.g. janitor pass counter) so an unlucky rounding draw isn't frozen
-    forever; changing it never recompiles."""
+    forever; changing it never recompiles. ``init`` warm-starts the
+    Sinkhorn potentials from the previous refresh (same iteration budget,
+    tighter convergence)."""
     C = costs_mod.assemble_cost(problem, weights=config.weights, dtype=config.dtype)
     # Clamp copies to what rounding can actually place, BEFORE building the
     # transport marginals — otherwise the prior reserves phantom capacity.
@@ -72,6 +87,8 @@ def solve_placement(
     sk = _sinkhorn(
         C, row_mass, free, eps=config.eps, iters=config.sinkhorn_iters,
         lse_impl=config.lse_impl,
+        f0=None if init is None else init.f0,
+        g0=None if init is None else init.g0,
     )
     logits = _plan_logits(C, sk.f, sk.g, config.eps)
     res = _auction(
@@ -91,4 +108,6 @@ def solve_placement(
         load=res.load,
         overflow=res.overflow,
         row_err=sk.row_err,
+        f=sk.f,
+        g=sk.g,
     )

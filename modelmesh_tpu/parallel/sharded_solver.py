@@ -92,7 +92,7 @@ def _lse(z_blk: jax.Array, axis: int, axis_name: str) -> jax.Array:
 
 
 def _sharded_sinkhorn(C, row_mass, col_mass, eps: float, iters: int,
-                      lse_impl: str = "xla"):
+                      lse_impl: str = "xla", g0=None):
     # Semi-unbalanced (rows equality, columns CAPS via g <= 0) — must match
     # ops/sinkhorn.py exactly; the parity tests compare potentials.
     log_a = jnp.log(jnp.maximum(row_mass, 1e-30))
@@ -137,9 +137,12 @@ def _sharded_sinkhorn(C, row_mass, col_mass, eps: float, iters: int,
         g = jnp.minimum(0.0, eps * (log_b - col_lse(f)))
         return (f, g), None
 
-    f0 = jnp.zeros_like(log_a)
-    g0 = jnp.zeros_like(log_b)
-    (f, g), _ = jax.lax.scan(body, (f0, g0), None, length=iters)
+    f_init = jnp.zeros_like(log_a)
+    g_init = (
+        jnp.minimum(0.0, g0.astype(jnp.float32))
+        if g0 is not None else jnp.zeros_like(log_b)
+    )
+    (f, g), _ = jax.lax.scan(body, (f_init, g_init), None, length=iters)
 
     row_sum = jnp.exp((f + eps * row_lse(g)) / eps)
     err = jax.lax.psum(jnp.sum(jnp.abs(row_sum - row_mass)), MODEL_AXIS)
@@ -223,7 +226,8 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
 
 
 def _solve_kernel(
-    p: PlacementProblem, seed: jax.Array, config: SolveConfig, weights: CostWeights
+    p: PlacementProblem, seed: jax.Array, g0: jax.Array,
+    config: SolveConfig, weights: CostWeights,
 ):
     C = _cost_block(p, weights, config.dtype)
     copies = jnp.minimum(p.copies, MAX_COPIES)
@@ -231,7 +235,7 @@ def _solve_kernel(
     free = jnp.maximum(p.capacity - p.reserved, 0.0)
     f, g, row_err = _sharded_sinkhorn(
         C, row_mass, free, config.eps, config.sinkhorn_iters,
-        lse_impl=resolve_lse_impl(config.lse_impl),
+        lse_impl=resolve_lse_impl(config.lse_impl), g0=g0,
     )
     # Quantize to the cost dtype exactly like ops.sinkhorn.plan_logits does,
     # so single-device and sharded rounding see identical scores.
@@ -258,7 +262,8 @@ def _solve_kernel(
         config.eta,
     )
     return Placement(
-        indices=idx, valid=valid, load=load, overflow=overflow, row_err=row_err
+        indices=idx, valid=valid, load=load, overflow=overflow,
+        row_err=row_err, f=f, g=g,
     )
 
 
@@ -277,14 +282,16 @@ def make_sharded_solver(
     length by ``inst``; outputs: indices/valid sharded on ``mdl``, load
     replicated.
     """
-    in_specs = (mesh_mod.problem_pspec(), P())
+    col = P(INSTANCE_AXIS)
+    in_specs = (mesh_mod.problem_pspec(), P(), col)
     row = P(MODEL_AXIS)
     out_specs = Placement(
-        indices=row, valid=row, load=P(), overflow=P(), row_err=P()
+        indices=row, valid=row, load=P(), overflow=P(), row_err=P(),
+        f=row, g=col,
     )
     kernel = partial(_solve_kernel, config=config, weights=weights)
     shmapped = jax.shard_map(
-        lambda prob, seed: kernel(prob, seed),
+        lambda prob, seed, g0: kernel(prob, seed, g0),
         mesh=mesh,
         in_specs=in_specs,
         out_specs=out_specs,
@@ -292,8 +299,10 @@ def make_sharded_solver(
     )
     jitted = jax.jit(shmapped)
 
-    def solver(problem: PlacementProblem, seed=0x5EED):
-        return jitted(problem, jnp.asarray(seed, jnp.uint32))
+    def solver(problem: PlacementProblem, seed=0x5EED, g0=None):
+        if g0 is None:
+            g0 = jnp.zeros(problem.capacity.shape, jnp.float32)
+        return jitted(problem, jnp.asarray(seed, jnp.uint32), g0)
 
     return solver
 

@@ -340,6 +340,9 @@ class GlobalPlan:
         self.adopted_at_ms = solved_at_ms
         # Local-only stage timings from solve_plan (not serialized).
         self.stats: dict[str, float] = {}
+        # Per-instance column potentials for warm-starting the next solve
+        # (local-only: followers never need it, only the refresher does).
+        self.warm_g: Optional[dict[str, float]] = None
 
     @classmethod
     def from_columnar(
@@ -564,6 +567,7 @@ def solve_plan(
     seed: int = 0,
     constraints=None,
     mesh=None,
+    warm_g: Optional[Mapping[str, float]] = None,
 ) -> GlobalPlan:
     """One global solve -> GlobalPlan (blocking; runs on the JAX device).
 
@@ -576,6 +580,12 @@ def solve_plan(
     (parallel/sharded_solver.py) — the 1M x 10k ladder path. Bucket sizes
     are powers of two or 3·2^k, so any power-of-two mesh axis ≤ the pad
     floors (256 rows, 64 cols) divides them evenly.
+
+    ``warm_g``: per-instance-id column potentials from the previous solve
+    (``plan.warm_g``) — warm-starts Sinkhorn (SURVEY.md section 7 hard
+    part #4, incremental solves as state churns). Only g needs carrying:
+    the first iteration derives f entirely from g, and keying by instance
+    id makes the carry robust to models/instances joining or leaving.
     """
     import jax
 
@@ -586,6 +596,15 @@ def solve_plan(
     t0 = time.perf_counter()
     cols = snapshot_columns(models, instances, rpm_fn, constraints=constraints)
     t1 = time.perf_counter()
+    # Warm-start column potentials, id-aligned to this snapshot's column
+    # order; instances unknown to the carry (new pods) start at 0 = cold.
+    # ALWAYS materialized (zeros = cold): switching the jitted solve's
+    # init between None and an array would change the argument pytree and
+    # force a full recompile on the first warm refresh.
+    g0 = np.zeros(_bucket(len(cols.instance_ids), 64), np.float32)
+    if warm_g:
+        for j, iid in enumerate(cols.instance_ids):
+            g0[j] = warm_g.get(iid, 0.0)
     if mesh is not None:
         from modelmesh_tpu.parallel.mesh import INSTANCE_AXIS, MODEL_AXIS
 
@@ -603,10 +622,16 @@ def solve_plan(
                 f"mesh {dict(mesh.shape)} does not divide the padded problem"
             )
         problem = _expand_problem_device(cols, pad=True, mesh=mesh)
-        sol = jax.block_until_ready(_solver_for(mesh)(problem, seed=seed))
+        sol = jax.block_until_ready(
+            _solver_for(mesh)(problem, seed=seed, g0=g0)
+        )
     else:
+        from modelmesh_tpu.ops.solve import SolveInit
+
         problem = _expand_problem_device(cols, pad=True)
-        sol = jax.block_until_ready(solve_placement(problem, seed=seed))
+        sol = jax.block_until_ready(
+            solve_placement(problem, seed=seed, init=SolveInit(g0=g0))
+        )
     t2 = time.perf_counter()
     # Compact readback: u16 indices + per-row valid counts instead of the
     # raw i32[N,K] + bool[N,K] (2.1 MB vs 5.2 MB at the padded 100k tier —
@@ -638,7 +663,14 @@ def solve_plan(
         "snapshot_ms": (t1 - t0) * 1e3,
         "solve_ms": (t2 - t1) * 1e3,
         "extract_ms": (t3 - t2) * 1e3,
+        "warm": bool(warm_g),
     }
+    # Warm-start carry for the NEXT refresh (~4 KB at 1k instances).
+    if sol.g is not None:
+        g_host = np.asarray(jax.device_get(sol.g))[: len(cols.instance_ids)]
+        plan.warm_g = dict(
+            zip(cols.instance_ids, g_host.astype(float).tolist())
+        )
     return plan
 
 
@@ -705,6 +737,8 @@ class JaxPlacementStrategy(PlacementStrategy):
         self._plan: Optional[GlobalPlan] = None
         self._seed = 0
         self._refresh_lock = threading.Lock()
+        # Column-potential carry across refreshes (solve_plan warm_g).
+        self._warm_g: Optional[dict[str, float]] = None
 
     @property
     def plan(self) -> Optional[GlobalPlan]:
@@ -721,7 +755,9 @@ class JaxPlacementStrategy(PlacementStrategy):
             plan = solve_plan(
                 models, instances, rpm_fn, seed=self._seed,
                 constraints=self.constraints, mesh=self.mesh,
+                warm_g=self._warm_g,
             )
+            self._warm_g = plan.warm_g
             plan.generation = self._seed
             self._plan = plan
             log.info(

@@ -127,12 +127,13 @@ class ShadowStrategy(PlacementStrategy):
     def choose_serve_target(
         self, model: ModelRecord, view: ClusterView, exclude: frozenset[str]
     ) -> Optional[str]:
-        out = self.primary.choose_serve_target(model, view, exclude)
-        self._observe(
-            "serve", getattr(model, "model_id", "?"), out,
-            lambda: self.shadow.choose_serve_target(model, view, exclude),
-        )
-        return out
+        # NOT scored: the jax strategy serves via its greedy fallback by
+        # design (balancing needs fresh busyness, not a global solve —
+        # jax_engine.choose_serve_target), so shadow-vs-primary here would
+        # compare greedy to greedy and report a tautological 1.0 agreement
+        # — false promotion evidence. Only load placement carries solver
+        # signal.
+        return self.primary.choose_serve_target(model, view, exclude)
 
     # -- reporting ----------------------------------------------------------
 

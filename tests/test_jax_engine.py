@@ -207,6 +207,22 @@ class TestShardedRefresh:
             req, ClusterView(instances=instances)
         ) is not None
 
+    def test_refresh_carries_warm_start(self):
+        """Second refresh warm-starts from the first solve's column
+        potentials; the strategy threads the carry automatically."""
+        strat = JaxPlacementStrategy()
+        models = _models(64)
+        instances = _instances(4)
+        p1 = strat.refresh(models, instances)
+        assert p1.stats["warm"] is False and p1.warm_g is not None
+        assert set(p1.warm_g) == {iid for iid, _ in instances}
+        p2 = strat.refresh(models, instances)
+        assert p2.stats["warm"] is True
+        assert p2.num_models() == 64
+        # a new instance joining mid-carry is handled (cold column)
+        p3 = strat.refresh(models, instances + _instances(5)[4:])
+        assert p3.stats["warm"] is True and len(p3.warm_g) == 5
+
     def test_indivisible_mesh_rejected(self):
         import numpy as np_
 

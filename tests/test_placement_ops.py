@@ -61,6 +61,32 @@ class TestSinkhorn:
             rows, np.asarray(row_mass), rtol=0.15
         )
 
+    def test_warm_start_converges_tighter_on_perturbed_problem(self):
+        """SURVEY section 7 hard part #4: consecutive refreshes see a
+        slightly-churned problem; warm-starting g from the last solve must
+        beat cold-start at a SMALL iteration budget and land near the
+        fully-converged answer."""
+        p = ops.random_problem(jax.random.PRNGKey(11), 512, 32,
+                               capacity_slack=1.2)
+        C = ops.assemble_cost(p)
+        row_mass = p.sizes * p.copies
+        free = p.capacity - p.reserved
+        converged = ops.sinkhorn(C, row_mass, free, eps=0.05, iters=40)
+        # churn: a few models change rate/size -> a few rows of C move
+        bump = jnp.zeros_like(row_mass).at[:16].set(row_mass[:16] * 0.3)
+        row_mass2 = row_mass + bump
+        cold = ops.sinkhorn(C, row_mass2, free, eps=0.05, iters=3)
+        warm = ops.sinkhorn(
+            C, row_mass2, free, eps=0.05, iters=3,
+            f0=converged.f, g0=converged.g,
+        )
+        ref = ops.sinkhorn(C, row_mass2, free, eps=0.05, iters=40)
+        assert float(warm.row_err) <= float(cold.row_err)
+        # warm @ 3 iters should be in the converged answer's neighborhood
+        g_gap_warm = float(jnp.abs(warm.g - ref.g).max())
+        g_gap_cold = float(jnp.abs(cold.g - ref.g).max())
+        assert g_gap_warm <= g_gap_cold
+
 
 class TestAuction:
     def test_respects_feasibility_and_copies(self):

@@ -67,7 +67,6 @@ def sinkhorn(
     eps: float = 0.05,
     iters: int = 12,
     lse_impl: str = "auto",
-    f0: jax.Array | None = None,
     g0: jax.Array | None = None,
 ) -> SinkhornResult:
     """Semi-unbalanced log-domain Sinkhorn: rows are equalities (every
@@ -81,13 +80,12 @@ def sinkhorn(
     subset — nullifying cost-pool preferences (the `preferred` label term)
     whenever there is slack, which is most of the time.
 
-    ``f0``/``g0`` warm-start the potentials (SURVEY.md section 7 hard part
-    #4: incremental solves as state churns). Between consecutive refreshes
-    the problem barely moves, so last solve's potentials are a few
-    iterations from the new fixed point — same iteration budget converges
-    tighter, or a reduced budget matches cold quality. The first f-update
-    overwrites f from g0, so only g0's quality matters mathematically;
-    passing f0 too keeps the API symmetric for the price loop's caller.
+    ``g0`` warm-starts the column potentials (SURVEY.md section 7 hard
+    part #4: incremental solves as state churns). Between consecutive
+    refreshes the problem barely moves, so the last solve's g is a few
+    iterations from the new fixed point — the same iteration budget
+    converges tighter. Only g needs carrying: the first iteration derives
+    f entirely from g, so a row-potential input would be dead code.
     """
     row_mass = row_mass.astype(jnp.float32)
     col_mass = col_mass.astype(jnp.float32)
@@ -122,7 +120,7 @@ def sinkhorn(
         g = jnp.minimum(0.0, eps * (log_b - col_fn(C, f)))
         return (f, g), None
 
-    f_init = jnp.zeros_like(log_a) if f0 is None else f0.astype(jnp.float32)
+    f_init = jnp.zeros_like(log_a)
     g_init = (
         jnp.minimum(0.0, g0.astype(jnp.float32))  # g <= 0 invariant
         if g0 is not None else jnp.zeros_like(log_b)

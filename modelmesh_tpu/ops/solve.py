@@ -58,12 +58,12 @@ class Placement(NamedTuple):
 
 class SolveInit(NamedTuple):
     """Warm-start carry from a previous solve (SURVEY.md section 7 hard
-    part #4: incremental solves as cluster state churns). Rows must be
-    id-aligned to the CURRENT problem's row order by the caller
-    (placement/jax_engine.py scatters by model id)."""
+    part #4: incremental solves as cluster state churns). Columns must be
+    id-aligned to the CURRENT problem's column order by the caller
+    (placement/jax_engine.py scatters by instance id). Only g is carried:
+    Sinkhorn's first iteration derives f entirely from g."""
 
-    g0: jax.Array        # f32[M] column potentials (the part that matters)
-    f0: jax.Array | None = None  # f32[N] row potentials
+    g0: jax.Array        # f32[M] column potentials
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -87,7 +87,6 @@ def solve_placement(
     sk = _sinkhorn(
         C, row_mass, free, eps=config.eps, iters=config.sinkhorn_iters,
         lse_impl=config.lse_impl,
-        f0=None if init is None else init.f0,
         g0=None if init is None else init.g0,
     )
     logits = _plan_logits(C, sk.f, sk.g, config.eps)

@@ -757,7 +757,11 @@ class JaxPlacementStrategy(PlacementStrategy):
                 constraints=self.constraints, mesh=self.mesh,
                 warm_g=self._warm_g,
             )
-            self._warm_g = plan.warm_g
+            if plan.warm_g is not None:
+                # Keep the carry across empty-snapshot blips (registry
+                # rebuild / watch reconnect): a transiently empty refresh
+                # must not force the next real solve cold.
+                self._warm_g = plan.warm_g
             plan.generation = self._seed
             self._plan = plan
             log.info(

@@ -1,13 +1,14 @@
 """Shadow-mode strategy evaluation: serve greedy, measure the solver.
 
 SURVEY.md section 7 build plan step 9 prescribes running the JAX global
-strategy "in shadow-mode vs greedy before promoting": every placement
+strategy "in shadow-mode vs greedy before promoting": every LOAD-placement
 decision is taken by the ``primary`` (production) strategy, while the
-``shadow`` strategy answers the same question on the side. Agreement is
-counted per decision kind, recent divergences are kept for the
-***GETSTATE*** dump, and shadow failures can never affect serving —
-operators read the agreement rate, then flip ``--strategy jax`` with
-evidence instead of faith.
+``shadow`` strategy answers the same question on the side (serve-target
+balancing is not scored — the jax strategy serves via its greedy fallback
+by design, so that comparison would be tautological). Agreement is
+counted, recent divergences are kept for the ***STATE*** dump, and shadow
+failures can never affect serving — operators read the agreement rate,
+then flip ``--strategy jax`` with evidence instead of faith.
 
 The reference has no analog (its heuristics are hardcoded inline); this is
 the promotion-safety half of the PlacementStrategy SPI departure.
@@ -142,9 +143,10 @@ class ShadowStrategy(PlacementStrategy):
             counts = dict(self._counts)
             recent = list(self._recent)
         out: dict = {"counts": counts, "recent_divergences": recent}
-        for kind in ("load", "serve"):
-            agree = counts.get(f"{kind}_agree", 0)
-            total = agree + counts.get(f"{kind}_diverge", 0)
-            if total:
-                out[f"{kind}_agreement"] = round(agree / total, 4)
+        # Only load placement is scored (serve decisions pass through
+        # unscored — see choose_serve_target).
+        agree = counts.get("load_agree", 0)
+        total = agree + counts.get("load_diverge", 0)
+        if total:
+            out["load_agreement"] = round(agree / total, 4)
         return out

@@ -1,0 +1,75 @@
+"""Load-queue priority ordering (serving/entry.py PrioritizedLoadingPool).
+
+Reference analog: ModelMeshLoadPriorityTest — loads with a waiting request
+jump ahead of preemptive/chained loads (priority queue at
+ModelMesh.java:504, 2108-2116), ties broken most-recently-used first.
+"""
+
+import threading
+import time
+
+import pytest
+
+from modelmesh_tpu.serving.entry import PrioritizedLoadingPool
+
+
+def _drain_order(submits):
+    """Run a 1-thread pool; block it, enqueue `submits`, release, record
+    execution order."""
+    pool = PrioritizedLoadingPool(concurrency=1, name="prio-test")
+    gate = threading.Event()
+    started = threading.Event()
+    order: list[str] = []
+    done = threading.Event()
+
+    def blocker():
+        started.set()
+        gate.wait(10)
+
+    pool.submit(blocker, urgent=True, last_used=0)
+    assert started.wait(5)
+    for name, urgent, last_used in submits:
+        pool.submit(
+            (lambda n=name: (order.append(n),
+                             done.set() if n == "LAST" else None)),
+            urgent=urgent, last_used=last_used,
+        )
+    # sentinel guaranteed to run last: non-urgent, least-recently-used
+    pool.submit(lambda: (order.append("LAST"), done.set()),
+                urgent=False, last_used=-1)
+    gate.set()
+    assert done.wait(10)
+    pool.shutdown()
+    return order[:-1]
+
+
+class TestLoadPriority:
+    def test_urgent_preempts_preemptive(self):
+        order = _drain_order([
+            ("chained-old", False, 100),
+            ("urgent-1", True, 5),
+            ("chained-new", False, 900),
+            ("urgent-2", True, 1),
+        ])
+        assert order[:2] == ["urgent-1", "urgent-2"]  # urgency first, FIFO-ish
+        assert order[2:] == ["chained-new", "chained-old"]  # then MRU first
+
+    def test_mru_breaks_ties_within_class(self):
+        order = _drain_order([
+            (f"m{t}", False, t) for t in (10, 50, 30, 90)
+        ])
+        assert order == ["m90", "m50", "m30", "m10"]
+
+    def test_shutdown_rejects_new_work(self):
+        pool = PrioritizedLoadingPool(concurrency=1, name="prio-shut")
+        pool.shutdown()
+        with pytest.raises(RuntimeError):
+            pool.submit(lambda: None, urgent=False, last_used=0)
+
+
+class TestUrgentTieBreak:
+    def test_equal_urgency_equal_recency_is_fifo(self):
+        order = _drain_order([
+            ("a", True, 7), ("b", True, 7), ("c", True, 7)
+        ])
+        assert order == ["a", "b", "c"]

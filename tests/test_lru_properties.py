@@ -1,0 +1,179 @@
+"""Stateful property test: WeightedLRUCache vs an executable oracle.
+
+SURVEY.md section 7 step 2 says to property-test the clhm-equivalent
+"hard" — this machine drives random op sequences (hypothesis shrinks
+failures to minimal reproductions) against a pure-python model that
+mirrors the documented semantics exactly: weighted capacity, (last_used,
+insertion_seq) eviction order, never-evict-the-triggering-entry,
+forward-only plain touches, force-backdating, CAS remove/replace,
+re-weighting, live capacity changes, and eviction-listener ordering.
+"""
+
+import pytest
+from hypothesis import settings, strategies as st
+from hypothesis.stateful import (
+    RuleBasedStateMachine,
+    invariant,
+    rule,
+)
+
+from modelmesh_tpu.cache.lru import WeightedLRUCache
+
+KEYS = st.sampled_from([f"k{i}" for i in range(8)])
+TS = st.integers(min_value=0, max_value=1_000_000)
+
+
+class LruMachine(RuleBasedStateMachine):
+    def __init__(self):
+        super().__init__()
+        self.capacity = 100
+        self.evicted: list[tuple[str, int]] = []
+        self.cache = WeightedLRUCache(
+            self.capacity,
+            eviction_listener=lambda k, v, ts: self.evicted.append((k, ts)),
+        )
+        # key -> [value, weight, ts, seq]
+        self.model: dict[str, list] = {}
+        self.seq = 0
+        self.model_evicted: list[tuple[str, int]] = []
+
+    # -- oracle -------------------------------------------------------------
+
+    def _model_weight(self) -> int:
+        return sum(e[1] for e in self.model.values())
+
+    def _model_evict(self, exclude=None) -> None:
+        while self._model_weight() > self.capacity and self.model:
+            victims = [
+                (e[2], e[3], k)
+                for k, e in self.model.items() if k != exclude
+            ]
+            if not victims:
+                return
+            ts, _seq, k = min(victims)
+            self.model.pop(k)
+            self.model_evicted.append((k, ts))
+
+    # -- rules --------------------------------------------------------------
+
+    @rule(k=KEYS, w=st.integers(1, 130), ts=TS)
+    def put_if_absent(self, k, w, ts):
+        v = object()
+        if k in self.model:
+            assert self.cache.put_if_absent(k, v, w, ts) is self.model[k][0]
+        elif w > self.capacity:
+            with pytest.raises(ValueError):
+                self.cache.put_if_absent(k, v, w, ts)
+        else:
+            assert self.cache.put_if_absent(k, v, w, ts) is None
+            self.seq += 1
+            self.model[k] = [v, w, ts, self.seq]
+            self._model_evict(exclude=k)
+
+    @rule(k=KEYS, ts=TS)
+    def get_touches_forward_only(self, k, ts):
+        out = self.cache.get(k, touch_ts=ts)
+        e = self.model.get(k)
+        if e is None:
+            assert out is None
+        else:
+            assert out is e[0]
+            if ts > e[2]:
+                e[2] = ts
+
+    @rule(k=KEYS)
+    def get_quietly(self, k):
+        e = self.model.get(k)
+        out = self.cache.get_quietly(k)
+        assert out is (e[0] if e else None)
+
+    @rule(k=KEYS, ts=TS)
+    def force_last_used(self, k, ts):
+        ok = self.cache.force_last_used(k, ts)
+        e = self.model.get(k)
+        assert ok == (e is not None)
+        if e is not None:
+            e[2] = ts
+
+    @rule(k=KEYS)
+    def remove(self, k):
+        e = self.model.pop(k, None)
+        out = self.cache.remove(k)
+        assert out is (e[0] if e else None)
+
+    @rule(k=KEYS, matching=st.booleans())
+    def remove_if_value(self, k, matching):
+        e = self.model.get(k)
+        probe = e[0] if (e and matching) else object()
+        ok = self.cache.remove_if_value(k, probe)
+        assert ok == bool(e and matching)
+        if ok:
+            self.model.pop(k)
+
+    @rule(k=KEYS, matching=st.booleans())
+    def replace_quietly(self, k, matching):
+        e = self.model.get(k)
+        old = e[0] if (e and matching) else object()
+        new = object()
+        ok = self.cache.replace_quietly(k, old, new)
+        assert ok == bool(e and matching)
+        if ok:
+            e[0] = new
+
+    @rule(k=KEYS, w=st.integers(1, 130))
+    def update_weight(self, k, w):
+        e = self.model.get(k)
+        out = self.cache.update_weight(k, w)
+        if e is None:
+            assert out is None
+            return
+        assert out == e[1]
+        grew = w > e[1]
+        e[1] = w
+        if grew:
+            self._model_evict(exclude=k)
+
+    @rule(c=st.integers(1, 150))
+    def set_capacity(self, c):
+        self.capacity = c
+        self.cache.set_capacity(c)
+        self._model_evict()
+
+    # -- invariants ---------------------------------------------------------
+
+    @invariant()
+    def sizes_and_weight_agree(self):
+        assert len(self.cache) == len(self.model)
+        assert self.cache.weight == self._model_weight()
+
+    @invariant()
+    def lru_order_agrees(self):
+        ordered = sorted(
+            self.model.items(), key=lambda kv: (kv[1][2], kv[1][3]),
+            reverse=True,
+        )
+        want = [(k, e[0], e[2]) for k, e in ordered]
+        got = list(self.cache.descending_items())
+        assert [(k, ts) for k, _v, ts in got] == [
+            (k, ts) for k, _v, ts in want
+        ]
+        for (k1, v1, _), (k2, v2, _) in zip(got, want):
+            assert k1 == k2 and v1 is v2
+
+    @invariant()
+    def oldest_time_agrees(self):
+        want = min(
+            ((e[2], e[3]) for e in self.model.values()), default=None
+        )
+        got = self.cache.oldest_time()
+        assert got == (want[0] if want else None)
+
+    @invariant()
+    def eviction_stream_agrees(self):
+        assert self.evicted == self.model_evicted
+
+
+LruMachine.TestCase.settings = settings(
+    max_examples=120, stateful_step_count=60, deadline=None
+)
+TestLruProperties = LruMachine.TestCase

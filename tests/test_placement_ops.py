@@ -78,7 +78,7 @@ class TestSinkhorn:
         cold = ops.sinkhorn(C, row_mass2, free, eps=0.05, iters=3)
         warm = ops.sinkhorn(
             C, row_mass2, free, eps=0.05, iters=3,
-            f0=converged.f, g0=converged.g,
+            g0=converged.g,
         )
         ref = ops.sinkhorn(C, row_mass2, free, eps=0.05, iters=40)
         assert float(warm.row_err) <= float(cold.row_err)

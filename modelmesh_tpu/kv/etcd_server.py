@@ -4,9 +4,11 @@ Speaks the real etcd gRPC API (etcdserverpb method paths, mvcc field
 numbers — protos/etcd_rpc.proto) over the InMemoryKV engine, including the
 behaviors a client must survive in production: global revisions, version
 CAS via Txn, leases with TTL expiry, watch streams with start_revision
-replay, and COMPACTION — a watch whose start_revision predates the compact
-floor is canceled with ``compact_revision`` set, exactly the etcd behavior
-that forces clients to re-list (kv/etcd.py's resync path).
+replay, historical MVCC reads (RangeRequest.revision, with the
+ErrCompacted/ErrFutureRev contract — unary and txn-nested), and
+COMPACTION — a watch whose start_revision predates the compact floor is
+canceled with ``compact_revision`` set, exactly the etcd behavior that
+forces clients to re-list (kv/etcd.py's resync path).
 
 Two roles:
 - The test double for EtcdKV: the CI image carries no etcd binary and has
@@ -35,10 +37,20 @@ from typing import Optional
 import grpc
 
 from modelmesh_tpu.kv.memory import InMemoryKV
-from modelmesh_tpu.kv.store import EventType, KeyValue
+from modelmesh_tpu.kv.store import (
+    CompactedRevision,
+    EventType,
+    FutureRevision,
+    KeyValue,
+)
 from modelmesh_tpu.proto import etcd_rpc_pb2 as epb
 from modelmesh_tpu.runtime import grpc_defs
 from modelmesh_tpu.utils.grpcopts import message_size_options
+
+# Exact etcd error strings — clients (kv/etcd.py resync, real etcd
+# clients) match on them; unary and txn-nested paths must agree.
+_ERR_COMPACTED = "etcdserver: mvcc: required revision has been compacted"
+_ERR_FUTURE_REV = "etcdserver: mvcc: required revision is a future revision"
 
 log = logging.getLogger(__name__)
 
@@ -92,10 +104,20 @@ class EtcdLiteServicer:
         limit (clients paginate on it); ``more`` flags truncation. Callers
         may hold the (reentrant) lock already — the Txn branch does."""
         with self.store.locked():
-            kvs = self._range_locked(
-                req.key.decode(),
-                req.range_end.decode() if req.range_end else "",
-            )
+            if req.revision > 0:
+                # Historical MVCC read (etcd RangeRequest.revision):
+                # reconstructed from the watch-replay history, valid down
+                # to the same compaction floor watches resume from.
+                kvs = self.store.range_interval_at(
+                    req.key.decode(),
+                    req.range_end.decode() if req.range_end else "",
+                    req.revision,
+                )
+            else:
+                kvs = self._range_locked(
+                    req.key.decode(),
+                    req.range_end.decode() if req.range_end else "",
+                )
             total = len(kvs)
             if req.limit > 0:  # etcd: limit <= 0 means unlimited
                 kvs = kvs[: req.limit]
@@ -111,7 +133,13 @@ class EtcdLiteServicer:
         )
 
     def Range(self, request, context):
-        return self._range_response(request)
+        try:
+            return self._range_response(request)
+        except CompactedRevision:
+            # etcd ErrCompacted wire behavior: OUT_OF_RANGE + this message.
+            context.abort(grpc.StatusCode.OUT_OF_RANGE, _ERR_COMPACTED)
+        except FutureRevision:
+            context.abort(grpc.StatusCode.OUT_OF_RANGE, _ERR_FUTURE_REV)
 
     def Put(self, request, context):
         try:
@@ -156,16 +184,38 @@ class EtcdLiteServicer:
             ok = all(self._compare(c) for c in request.compare)
             branch = request.success if ok else request.failure
             # Validate before applying ANY op: a put against a dead lease
-            # must fail the whole txn atomically, not halfway through.
-            for op in branch:
+            # or an unreadable nested historical range must fail the whole
+            # txn atomically, not halfway through (etcd's applier checks
+            # txn request ranges before applying). Historical reads are
+            # EXECUTED here too: their result is independent of this txn's
+            # own writes (those land at a higher revision), and applying a
+            # write first could advance the compaction floor via the
+            # history-cap trim, invalidating a revision validation passed.
+            hist_responses: dict[int, epb.RangeResponse] = {}
+            for i, op in enumerate(branch):
                 if op.HasField("request_put") and op.request_put.lease:
                     if not self.store.lease_exists(op.request_put.lease):
                         context.abort(
                             grpc.StatusCode.FAILED_PRECONDITION,
                             f"lease {op.request_put.lease} does not exist",
                         )
+                if op.HasField("request_range") and (
+                    op.request_range.revision > 0
+                ):
+                    try:
+                        hist_responses[i] = self._range_response(
+                            op.request_range
+                        )
+                    except CompactedRevision:
+                        context.abort(
+                            grpc.StatusCode.OUT_OF_RANGE, _ERR_COMPACTED
+                        )
+                    except FutureRevision:
+                        context.abort(
+                            grpc.StatusCode.OUT_OF_RANGE, _ERR_FUTURE_REV
+                        )
             responses = []
-            for op in branch:
+            for i, op in enumerate(branch):
                 if op.HasField("request_put"):
                     self.store.put_locked(
                         op.request_put.key.decode(),
@@ -186,13 +236,12 @@ class EtcdLiteServicer:
                         )
                     )
                 elif op.HasField("request_range"):
-                    responses.append(
-                        epb.ResponseOp(
-                            response_range=self._range_response(
-                                op.request_range
-                            )
-                        )
+                    rr = (
+                        hist_responses[i]
+                        if i in hist_responses
+                        else self._range_response(op.request_range)
                     )
+                    responses.append(epb.ResponseOp(response_range=rr))
             return epb.TxnResponse(
                 header=self._header(), succeeded=ok, responses=responses
             )

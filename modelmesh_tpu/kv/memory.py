@@ -22,7 +22,9 @@ from typing import Iterable, Optional, Sequence
 
 from modelmesh_tpu.kv.store import (
     Compare,
+    CompactedRevision,
     EventType,
+    FutureRevision,
     KeyValue,
     KVStore,
     Op,
@@ -165,6 +167,53 @@ class InMemoryKV(KVStore):
                 ev for ev in self._history if ev.kv.mod_rev > revision
             ]
             self._compact_rev = max(self._compact_rev, revision)
+
+    def range_interval_at(
+        self, start: str, end: str, revision: int
+    ) -> list[KeyValue]:
+        """Range as of a historical ``revision`` (etcd MVCC read).
+
+        No separate version store is needed: every retained WatchEvent
+        carries ``prev``, so the state at R is the CURRENT state with each
+        post-R-touched key rolled back to the ``prev`` of its FIRST event
+        after R (prev=None there means the key did not exist at R). Keys
+        untouched since R already carry their R-state in ``_data``. Valid
+        exactly for R >= the compaction floor — the same floor watch
+        resume uses (compact() and the history-cap trim both advance it).
+
+        Raises CompactedRevision below the floor and FutureRevision above
+        the current revision, mirroring etcd's ErrCompacted/ErrFutureRev.
+        """
+        with self._lock:
+            if revision > self._rev:
+                raise FutureRevision(revision, self._rev)
+            if revision < self._compact_rev:
+                raise CompactedRevision(revision, self._compact_rev)
+
+            def in_range(k: str) -> bool:
+                return k == start if not end else start <= k < end
+
+            state = {
+                kv.key: kv for kv in self.range_interval(start, end)
+            }
+            rolled: set[str] = set()
+            for ev in self._history:  # ascending revision order
+                key = ev.kv.key
+                # Cheap key filter FIRST: for a point read most of the
+                # (up to history_cap) events are other keys, and this scan
+                # holds the store lock.
+                if not in_range(key):
+                    continue
+                if ev.kv.mod_rev <= revision or key in rolled:
+                    continue
+                rolled.add(key)
+                if ev.prev is not None:
+                    state[key] = ev.prev
+                else:
+                    state.pop(key, None)
+                if not end:
+                    break  # point read: the single key is resolved
+            return sorted(state.values(), key=lambda kv: kv.key)
 
     # -- writes -----------------------------------------------------------
 

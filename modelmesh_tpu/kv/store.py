@@ -74,6 +74,28 @@ class CasFailed(Exception):
     """Conditional update lost the race; reread and retry."""
 
 
+class CompactedRevision(Exception):
+    """Historical read below the compaction floor (etcd ErrCompacted)."""
+
+    def __init__(self, requested: int, floor: int):
+        super().__init__(
+            f"revision {requested} has been compacted (floor {floor})"
+        )
+        self.requested = requested
+        self.floor = floor
+
+
+class FutureRevision(Exception):
+    """Historical read above the current revision (etcd ErrFutureRev)."""
+
+    def __init__(self, requested: int, current: int):
+        super().__init__(
+            f"revision {requested} is a future revision (current {current})"
+        )
+        self.requested = requested
+        self.current = current
+
+
 class KVStore(abc.ABC):
     """Versioned KV with prefix watch, leases, and transactions."""
 

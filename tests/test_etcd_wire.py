@@ -504,6 +504,122 @@ class TestWatchOrderingUnderConcurrentWriters:
         req_q.put(None)
 
 
+class TestHistoricalRange:
+    """RangeRequest.revision — MVCC reads at a past revision, valid down
+    to the compaction floor (etcd ErrCompacted / ErrFutureRev contract)."""
+
+    def _put(self, kv, key, val):
+        return kv.Put(epb.PutRequest(key=key, value=val)).header.revision
+
+    def test_range_at_revision_reflects_past_state(self, wire):
+        kv, _, _, _ = wire
+        r1 = self._put(kv, b"hr/a", b"1")
+        r2 = self._put(kv, b"hr/b", b"1")
+        self._put(kv, b"hr/a", b"2")
+        kv.DeleteRange(epb.DeleteRangeRequest(key=b"hr/b"))
+        self._put(kv, b"hr/c", b"1")
+
+        at_r1 = kv.Range(epb.RangeRequest(
+            key=b"hr/", range_end=_prefix_end(b"hr/"), revision=r1))
+        assert [(x.key, x.value) for x in at_r1.kvs] == [(b"hr/a", b"1")]
+        assert at_r1.count == 1
+
+        at_r2 = kv.Range(epb.RangeRequest(
+            key=b"hr/", range_end=_prefix_end(b"hr/"), revision=r2))
+        assert [(x.key, x.value) for x in at_r2.kvs] == [
+            (b"hr/a", b"1"), (b"hr/b", b"1")]
+        # mod/create revisions are the historical ones, not current
+        assert at_r2.kvs[0].mod_revision == r1
+        # header still reports the CURRENT store revision (etcd contract)
+        assert at_r2.header.revision > r2
+
+        now = kv.Range(epb.RangeRequest(
+            key=b"hr/", range_end=_prefix_end(b"hr/")))
+        assert [(x.key, x.value) for x in now.kvs] == [
+            (b"hr/a", b"2"), (b"hr/c", b"1")]
+
+    def test_point_get_at_revision(self, wire):
+        kv, _, _, _ = wire
+        r1 = self._put(kv, b"hp/k", b"v1")
+        self._put(kv, b"hp/k", b"v2")
+        at = kv.Range(epb.RangeRequest(key=b"hp/k", revision=r1))
+        assert [x.value for x in at.kvs] == [b"v1"]
+        assert at.kvs[0].version == 1
+
+    def test_limit_and_count_at_revision(self, wire):
+        kv, _, _, _ = wire
+        for i in range(6):
+            rev = self._put(kv, f"hl/{i}".encode(), b"x")
+        kv.DeleteRange(epb.DeleteRangeRequest(
+            key=b"hl/", range_end=_prefix_end(b"hl/")))
+        at = kv.Range(epb.RangeRequest(
+            key=b"hl/", range_end=_prefix_end(b"hl/"),
+            revision=rev, limit=2))
+        assert len(at.kvs) == 2 and at.count == 6 and at.more
+
+    def test_compacted_revision_rejected(self, wire):
+        kv, _, _, store = wire
+        r1 = self._put(kv, b"hc/k", b"v1")
+        self._put(kv, b"hc/k", b"v2")
+        store.compact(r1 + 1)
+        with pytest.raises(grpc.RpcError) as ei:
+            kv.Range(epb.RangeRequest(key=b"hc/k", revision=r1))
+        assert ei.value.code() == grpc.StatusCode.OUT_OF_RANGE
+        assert "compacted" in ei.value.details()
+        # AT the floor is still readable (etcd allows rev == compact_rev)
+        ok = kv.Range(epb.RangeRequest(key=b"hc/k", revision=r1 + 1))
+        assert [x.value for x in ok.kvs] == [b"v2"]
+
+    def test_future_revision_rejected(self, wire):
+        kv, _, _, _ = wire
+        self._put(kv, b"hf/k", b"v")
+        with pytest.raises(grpc.RpcError) as ei:
+            kv.Range(epb.RangeRequest(key=b"hf/k", revision=10_000))
+        assert ei.value.code() == grpc.StatusCode.OUT_OF_RANGE
+        assert "future" in ei.value.details()
+
+    def test_txn_nested_historical_range(self, wire):
+        kv, _, _, _ = wire
+        r1 = self._put(kv, b"ht/k", b"old")
+        self._put(kv, b"ht/k", b"new")
+        resp = kv.Txn(epb.TxnRequest(success=[
+            epb.RequestOp(request_range=epb.RangeRequest(
+                key=b"ht/k", revision=r1)),
+        ]))
+        assert resp.succeeded
+        assert resp.responses[0].response_range.kvs[0].value == b"old"
+
+    def test_nonpositive_revision_means_latest_everywhere(self, wire):
+        # etcd: revision <= 0 reads latest; unary and txn-nested must agree
+        kv, _, _, _ = wire
+        self._put(kv, b"hz/k", b"v1")
+        self._put(kv, b"hz/k", b"v2")
+        for rev in (0, -1):
+            un = kv.Range(epb.RangeRequest(key=b"hz/k", revision=rev))
+            assert [x.value for x in un.kvs] == [b"v2"], rev
+            tx = kv.Txn(epb.TxnRequest(success=[
+                epb.RequestOp(request_range=epb.RangeRequest(
+                    key=b"hz/k", revision=rev)),
+            ]))
+            assert tx.succeeded
+            assert tx.responses[0].response_range.kvs[0].value == b"v2", rev
+
+    def test_txn_nested_future_revision_fails_whole_txn(self, wire):
+        kv, _, _, _ = wire
+        self._put(kv, b"ht2/k", b"v")
+        with pytest.raises(grpc.RpcError) as ei:
+            kv.Txn(epb.TxnRequest(success=[
+                epb.RequestOp(request_put=epb.PutRequest(
+                    key=b"ht2/side", value=b"x")),
+                epb.RequestOp(request_range=epb.RangeRequest(
+                    key=b"ht2/k", revision=99_999)),
+            ]))
+        assert ei.value.code() == grpc.StatusCode.OUT_OF_RANGE
+        # the put before the bad range must NOT have been applied
+        side = kv.Range(epb.RangeRequest(key=b"ht2/side"))
+        assert len(side.kvs) == 0
+
+
 class TestTxnWatchAtomicity:
     def test_txn_events_arrive_in_one_response(self, wire):
         """etcd delivers all events of one revision in ONE WatchResponse —

@@ -21,8 +21,10 @@ Two roles:
   etcd protocol without operating etcd:
       python -m modelmesh_tpu.kv.etcd_server --port 2379
 
+Request options supported: prev_kv on Put/DeleteRange/Txn-put and on
+watches, keys_only/count_only ranges, watch filters (NOPUT/NODELETE).
 Limitations vs real etcd (documented, deliberate): no raft/replication, no
-auth, watch filters/fragmentation unimplemented; watch ranges must be
+auth, no watch fragmentation or progress-notify; watch ranges must be
 whole-prefix or exact-key (all this framework's clients use).
 """
 
@@ -71,10 +73,10 @@ _WATCH_METHOD = "/etcdserverpb.Watch/Watch"
 _KEEPALIVE_METHOD = "/etcdserverpb.Lease/LeaseKeepAlive"
 
 
-def _to_mvcc(kv: KeyValue) -> epb.MvccKeyValue:
+def _to_mvcc(kv: KeyValue, keys_only: bool = False) -> epb.MvccKeyValue:
     return epb.MvccKeyValue(
         key=kv.key.encode(),
-        value=kv.value,
+        value=b"" if keys_only else kv.value,
         create_revision=kv.create_rev,
         mod_revision=kv.mod_rev,
         version=kv.version,
@@ -119,7 +121,9 @@ class EtcdLiteServicer:
                     req.range_end.decode() if req.range_end else "",
                 )
             total = len(kvs)
-            if req.limit > 0:  # etcd: limit <= 0 means unlimited
+            if req.count_only:
+                kvs = []
+            elif req.limit > 0:  # etcd: limit <= 0 means unlimited
                 kvs = kvs[: req.limit]
             revision = self.store.revision
         # Protobuf construction happens OUTSIDE the lock — a large range
@@ -127,9 +131,9 @@ class EtcdLiteServicer:
         # behind message serialization.
         return epb.RangeResponse(
             header=epb.ResponseHeader(revision=revision),
-            kvs=[_to_mvcc(kv) for kv in kvs],
+            kvs=[_to_mvcc(kv, keys_only=req.keys_only) for kv in kvs],
             count=total,
-            more=total > len(kvs),
+            more=(not req.count_only) and total > len(kvs),
         )
 
     def Range(self, request, context):
@@ -142,13 +146,26 @@ class EtcdLiteServicer:
             context.abort(grpc.StatusCode.OUT_OF_RANGE, _ERR_FUTURE_REV)
 
     def Put(self, request, context):
+        prev = None
         try:
-            self.store.put(
-                request.key.decode(), request.value, request.lease
-            )
+            # prev_kv: read-then-put under the (reentrant) store lock so
+            # the returned pair is exactly what this put replaced.
+            with self.store.locked():
+                if request.prev_kv:
+                    prev = self.store.get_locked(request.key.decode())
+                written = self.store.put_locked(
+                    request.key.decode(), request.value, request.lease
+                )
         except ValueError as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
-        return epb.PutResponse(header=self._header())
+        # header.revision must be THIS put's revision (etcd contract —
+        # clients fence on it), not whatever the store moved to since.
+        resp = epb.PutResponse(
+            header=epb.ResponseHeader(revision=written.mod_rev)
+        )
+        if prev is not None:
+            resp.prev_kv.CopyFrom(_to_mvcc(prev))
+        return resp
 
     def _delete_range_response(
         self, req: epb.DeleteRangeRequest
@@ -160,17 +177,25 @@ class EtcdLiteServicer:
         # batch(): all deletions share ONE revision, like etcd's atomic
         # DeleteRange (it also holds the store lock for the atomicity).
         with self.store.batch():
-            keys = [
-                kv.key
-                for kv in self._range_locked(
-                    req.key.decode(),
-                    req.range_end.decode() if req.range_end else "",
-                )
-            ]
-            deleted = sum(1 for k in keys if self.store.delete_locked(k))
-            return epb.DeleteRangeResponse(
-                header=self._header(), deleted=deleted
+            victims = self._range_locked(
+                req.key.decode(),
+                req.range_end.decode() if req.range_end else "",
             )
+            deleted = sum(
+                1 for kv in victims if self.store.delete_locked(kv.key)
+            )
+            revision = self.store.revision
+        # Proto construction OUTSIDE the lock (same rule as
+        # _range_response): a registry-scale prefix delete with prev_kv
+        # must not stall every put/lease-sweep/watch behind serialization.
+        # (Txn-nested calls still run inside the txn's outer batch —
+        # unavoidable; the unary path is the high-volume one.)
+        resp = epb.DeleteRangeResponse(
+            header=epb.ResponseHeader(revision=revision), deleted=deleted
+        )
+        if req.prev_kv:
+            resp.prev_kvs.extend(_to_mvcc(kv) for kv in victims)
+        return resp
 
     def DeleteRange(self, request, context):
         return self._delete_range_response(request)
@@ -217,16 +242,19 @@ class EtcdLiteServicer:
             responses = []
             for i, op in enumerate(branch):
                 if op.HasField("request_put"):
+                    prev = (
+                        self.store.get_locked(op.request_put.key.decode())
+                        if op.request_put.prev_kv else None
+                    )
                     self.store.put_locked(
                         op.request_put.key.decode(),
                         op.request_put.value,
                         op.request_put.lease,
                     )
-                    responses.append(
-                        epb.ResponseOp(
-                            response_put=epb.PutResponse(header=self._header())
-                        )
-                    )
+                    pr = epb.PutResponse(header=self._header())
+                    if prev is not None:
+                        pr.prev_kv.CopyFrom(_to_mvcc(prev))
+                    responses.append(epb.ResponseOp(response_put=pr))
                 elif op.HasField("request_delete_range"):
                     responses.append(
                         epb.ResponseOp(
@@ -344,26 +372,42 @@ class EtcdLiteServicer:
         start = create.start_revision
         prefix = create.key.decode()
         exact = not create.range_end  # etcd: empty range_end = single key
+        # Server-side event filters + prev_kv attachment (etcd
+        # WatchCreateRequest fields 5/6).
+        drop_puts = epb.WatchCreateRequest.NOPUT in create.filters
+        drop_deletes = epb.WatchCreateRequest.NODELETE in create.filters
+        want_prev = create.prev_kv
+
+        def to_event(ev) -> epb.MvccEvent:
+            out = epb.MvccEvent(
+                type=(
+                    epb.MvccEvent.DELETE
+                    if ev.type is EventType.DELETE
+                    else epb.MvccEvent.PUT
+                ),
+                kv=_to_mvcc(ev.kv),
+            )
+            if want_prev and ev.prev is not None:
+                out.prev_kv.CopyFrom(_to_mvcc(ev.prev))
+            return out
 
         def on_events(events):
             if exact:
                 events = [ev for ev in events if ev.kv.key == prefix]
-                if not events:
-                    return
+            if drop_puts or drop_deletes:
+                events = [
+                    ev for ev in events
+                    if not (
+                        drop_deletes
+                        if ev.type is EventType.DELETE else drop_puts
+                    )
+                ]
+            if not events:
+                return
             try:
                 out_q.put_nowait(epb.WatchResponse(
                     header=self._header(), watch_id=watch_id,
-                    events=[
-                        epb.MvccEvent(
-                            type=(
-                                epb.MvccEvent.DELETE
-                                if ev.type is EventType.DELETE
-                                else epb.MvccEvent.PUT
-                            ),
-                            kv=_to_mvcc(ev.kv),
-                        )
-                        for ev in events
-                    ],
+                    events=[to_event(ev) for ev in events],
                 ))
             except queue.Full:
                 # NEVER block here: this runs on the store's single

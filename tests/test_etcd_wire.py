@@ -504,6 +504,80 @@ class TestWatchOrderingUnderConcurrentWriters:
         req_q.put(None)
 
 
+class TestRequestOptions:
+    """prev_kv / keys_only / count_only / watch filters — the etcd request
+    options real clients (clientv3, kubernetes) routinely set."""
+
+    def test_put_prev_kv(self, wire):
+        kv, _, _, _ = wire
+        r0 = kv.Put(epb.PutRequest(key=b"po/k", value=b"v1", prev_kv=True))
+        assert not r0.HasField("prev_kv")  # no prior pair
+        r1 = kv.Put(epb.PutRequest(key=b"po/k", value=b"v2", prev_kv=True))
+        assert r1.prev_kv.value == b"v1" and r1.prev_kv.version == 1
+        r2 = kv.Put(epb.PutRequest(key=b"po/k", value=b"v3"))
+        assert not r2.HasField("prev_kv")  # flag off
+
+    def test_put_header_is_own_revision(self, wire):
+        # etcd contract: PutResponse.header.revision is THIS put's
+        # revision (clients fence on it), strictly increasing per put.
+        kv, _, _, _ = wire
+        r1 = kv.Put(epb.PutRequest(key=b"ph/a", value=b"1")).header.revision
+        r2 = kv.Put(epb.PutRequest(key=b"ph/b", value=b"2")).header.revision
+        assert r2 == r1 + 1
+        got = kv.Range(epb.RangeRequest(key=b"ph/b"))
+        assert got.kvs[0].mod_revision == r2
+
+    def test_delete_range_prev_kvs(self, wire):
+        kv, _, _, _ = wire
+        for i in range(3):
+            kv.Put(epb.PutRequest(key=f"pd/{i}".encode(), value=b"x%d" % i))
+        resp = kv.DeleteRange(epb.DeleteRangeRequest(
+            key=b"pd/", range_end=_prefix_end(b"pd/"), prev_kv=True))
+        assert resp.deleted == 3
+        assert sorted((p.key, p.value) for p in resp.prev_kvs) == [
+            (b"pd/0", b"x0"), (b"pd/1", b"x1"), (b"pd/2", b"x2")]
+
+    def test_keys_only_and_count_only(self, wire):
+        kv, _, _, _ = wire
+        for i in range(4):
+            kv.Put(epb.PutRequest(key=f"ko/{i}".encode(), value=b"payload"))
+        ko = kv.Range(epb.RangeRequest(
+            key=b"ko/", range_end=_prefix_end(b"ko/"), keys_only=True))
+        assert len(ko.kvs) == 4 and ko.count == 4
+        assert all(x.value == b"" and x.mod_revision > 0 for x in ko.kvs)
+        co = kv.Range(epb.RangeRequest(
+            key=b"ko/", range_end=_prefix_end(b"ko/"), count_only=True))
+        assert len(co.kvs) == 0 and co.count == 4 and not co.more
+
+    def test_txn_put_prev_kv(self, wire):
+        kv, _, _, _ = wire
+        kv.Put(epb.PutRequest(key=b"pt/k", value=b"old"))
+        resp = kv.Txn(epb.TxnRequest(success=[
+            epb.RequestOp(request_put=epb.PutRequest(
+                key=b"pt/k", value=b"new", prev_kv=True)),
+        ]))
+        assert resp.responses[0].response_put.prev_kv.value == b"old"
+
+    def test_watch_filters_and_prev_kv(self, wire):
+        kv, _, channel, _ = wire
+        kv.Put(epb.PutRequest(key=b"wf/k", value=b"v1"))
+        req_q, call = _watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"wf/", range_end=_prefix_end(b"wf/"),
+            filters=[epb.WatchCreateRequest.NOPUT], prev_kv=True)))
+        it = iter(call)
+        assert next(it).created
+        kv.Put(epb.PutRequest(key=b"wf/k", value=b"v2"))  # filtered out
+        kv.DeleteRange(epb.DeleteRangeRequest(key=b"wf/k"))
+        resp = next(it)
+        assert len(resp.events) == 1
+        ev = resp.events[0]
+        assert ev.type == epb.MvccEvent.DELETE
+        # prev_kv carries the pair the delete removed (the v2 put)
+        assert ev.prev_kv.value == b"v2"
+        req_q.put(None)
+
+
 class TestHistoricalRange:
     """RangeRequest.revision — MVCC reads at a past revision, valid down
     to the compaction floor (etcd ErrCompacted / ErrFutureRev contract)."""

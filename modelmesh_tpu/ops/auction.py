@@ -106,9 +106,80 @@ def _select(scores_minus_price: jax.Array, copies: jax.Array):
     return _finalize_topk(vals, idx, copies)
 
 
-def _implied_load(
+# Flat (idx, weight) entries per scan step of the fused histogram. The
+# [_FUSED_CHUNK, M] one-hot comparison is an XLA input fusion into the
+# reduction — it never materializes — so the step size only bounds the
+# fusion's working set, not HBM traffic.
+_FUSED_CHUNK = 8192
+
+
+def resolve_load_impl(impl: str) -> str:
+    """Validate + resolve "auto" for the implied-load implementation.
+
+    "scatter" is the natural formulation and fast on CPU/GPU; on TPU a
+    1M-entry scatter-add with duplicate indices lowers to a serialized
+    update path that can dominate the whole solve (the same reason
+    embedding gradients on TPU are classically expressed as one-hot
+    matmuls), so "auto" picks the fused compare-reduce there."""
+    if impl not in ("auto", "scatter", "fused"):
+        raise ValueError(f"load_impl={impl!r} (expected auto | scatter | fused)")
+    if impl != "auto":
+        return impl
+    return "fused" if jax.default_backend() == "tpu" else "scatter"
+
+
+def _implied_load_fused(
     idx: jax.Array, valid: jax.Array, sizes: jax.Array, num_instances: int
 ) -> jax.Array:
+    """Scatter-free histogram: chunked one-hot compare-reduce.
+
+    Each scan step reduces a [chunk, M] on-the-fly comparison block; XLA
+    fuses the broadcasted equality into the reduction so the block never
+    hits HBM. Compute is O(N·K·M) VPU ops — bandwidth-trivial, and immune
+    to the duplicate-index serialization that makes TPU scatter-add slow."""
+    if idx.size == 0:  # zero-model problem: nothing contributes
+        return jnp.zeros((num_instances,), jnp.float32)
+    contrib = sizes[:, None] * valid.astype(jnp.float32)  # [N, K]
+    flat_idx = idx.reshape(-1).astype(jnp.int32)
+    flat_w = contrib.reshape(-1)
+    s = flat_idx.shape[0]
+    chunk = min(_FUSED_CHUNK, s)
+    pad = (-s) % chunk
+    if pad:
+        # Padded entries point one past the column range: they match no
+        # iota column and contribute nothing (weight 0 besides).
+        flat_idx = jnp.pad(flat_idx, (0, pad), constant_values=num_instances)
+        flat_w = jnp.pad(flat_w, (0, pad))
+    cols = jnp.arange(num_instances, dtype=jnp.int32)
+
+    def body(acc, xs):
+        ic, wc = xs
+        acc = acc + jnp.sum(
+            jnp.where(ic[:, None] == cols[None, :], wc[:, None], 0.0), axis=0
+        )
+        return acc, None
+
+    acc, _ = jax.lax.scan(
+        body,
+        jnp.zeros((num_instances,), jnp.float32),
+        (flat_idx.reshape(-1, chunk), flat_w.reshape(-1, chunk)),
+    )
+    return acc
+
+
+def _implied_load(
+    idx: jax.Array,
+    valid: jax.Array,
+    sizes: jax.Array,
+    num_instances: int,
+    impl: str = "scatter",
+) -> jax.Array:
+    # "auto" is resolved ONCE at the solver entry points (auction /
+    # _sharded_auction); this private helper takes only concrete impls.
+    if impl not in ("scatter", "fused"):
+        raise ValueError(f"unresolved load impl {impl!r}")
+    if impl == "fused":
+        return _implied_load_fused(idx, valid, sizes, num_instances)
     contrib = sizes[:, None] * valid.astype(jnp.float32)  # [N, K]
     return (
         jnp.zeros((num_instances,), jnp.float32)
@@ -141,7 +212,10 @@ def price_step(load, cap, price, eta_t):
     return jnp.clip(price + eta_t * step, 0.0, None)
 
 
-@partial(jax.jit, static_argnames=("iters", "eta", "price_scale", "tau"))
+@partial(
+    jax.jit,
+    static_argnames=("iters", "eta", "price_scale", "tau", "load_impl"),
+)
 def auction(
     scores: jax.Array,      # [N, M] plan logits, higher is better (bf16 ok)
     sizes: jax.Array,       # f32[N]
@@ -154,6 +228,7 @@ def auction(
     eta: float = 0.5,
     price_scale: float = 1.0,
     tau: float = 1.0,
+    load_impl: str = "auto",
 ) -> AuctionResult:
     """Gumbel-top-k sampling + best-iterate congestion-price repair.
 
@@ -178,28 +253,32 @@ def auction(
     # full-width argmax herds, so re-deriving from the price would lose it).
     kc = min(K_CAND, num_instances)
     n = scores_f32.shape[0]
+    load_impl = resolve_load_impl(load_impl)
 
     def narrow_round(carry, length):
-        price, best_idx, best_valid, best_of = carry
+        price, best_idx, best_valid, best_load, best_of = carry
         cand_vals, cand_idx = shortlist(scores_f32, price, kc)
 
         def body(carry, _):
-            price, bi, bv, bo = carry
+            price, bi, bv, bl, bo = carry
             idx, valid = select_from_candidates(
                 cand_vals, cand_idx, copies, price
             )
-            load = _implied_load(idx, valid, sizes, num_instances)
+            load = _implied_load(idx, valid, sizes, num_instances, load_impl)
             of = jnp.sum(jnp.maximum(load - cap, 0.0))
             better = of < bo
             bi = jnp.where(better, idx, bi)
             bv = jnp.where(better, valid, bv)
+            bl = jnp.where(better, load, bl)
             bo = jnp.minimum(of, bo)
             return (
-                price_step(load, cap, price, eta * price_scale), bi, bv, bo,
+                price_step(load, cap, price, eta * price_scale),
+                bi, bv, bl, bo,
             ), None
 
         carry, _ = jax.lax.scan(
-            body, (price, best_idx, best_valid, best_of), None, length=length
+            body, (price, best_idx, best_valid, best_load, best_of), None,
+            length=length,
         )
         return carry
 
@@ -208,6 +287,7 @@ def auction(
         price0,
         jnp.zeros((n, MAX_COPIES), jnp.int32),
         jnp.zeros((n, MAX_COPIES), bool),
+        jnp.zeros((num_instances,), jnp.float32),
         jnp.asarray(jnp.inf, jnp.float32),
     )
     # Honor `iters` exactly: full rounds of RESHORTLIST_EVERY plus one
@@ -216,17 +296,18 @@ def auction(
         [iters % RESHORTLIST_EVERY] if iters % RESHORTLIST_EVERY else []
     ):
         carry = narrow_round(carry, length)
-    price, best_idx, best_valid, best_of = carry
+    price, best_idx, best_valid, best_load, best_of = carry
     # One exact full-width selection at the final prices competes with the
-    # best recorded assignment; whichever overflows less wins.
+    # best recorded assignment; whichever overflows less wins. The winner's
+    # load rides the carry — no histogram recompute in the epilogue.
     idx_l, valid_l = _select(scores_f32 - price[None, :], copies)
-    load_l = _implied_load(idx_l, valid_l, sizes, num_instances)
+    load_l = _implied_load(idx_l, valid_l, sizes, num_instances, load_impl)
     of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
     use_last = of_l <= best_of
     idx = jnp.where(use_last, idx_l, best_idx)
     valid = jnp.where(use_last, valid_l, best_valid)
-    load = _implied_load(idx, valid, sizes, num_instances)
-    overflow = jnp.sum(jnp.maximum(load - cap, 0.0))
+    load = jnp.where(use_last, load_l, best_load)
+    overflow = jnp.minimum(of_l, best_of)
     return AuctionResult(
         indices=idx, valid=valid, load=load, prices=price,
         overflow=overflow,

@@ -41,6 +41,9 @@ class SolveConfig(NamedTuple):
     weights: costs_mod.CostWeights = costs_mod.CostWeights()
     # Sinkhorn LSE backend: "auto" = Pallas kernels on TPU, XLA elsewhere.
     lse_impl: str = "auto"
+    # Auction implied-load histogram: "auto" = fused compare-reduce on TPU
+    # (duplicate-index scatter-add serializes there), scatter elsewhere.
+    load_impl: str = "auto"
     dtype: jnp.dtype = jnp.bfloat16
 
 
@@ -100,6 +103,7 @@ def solve_placement(
         iters=config.auction_iters,
         eta=config.eta,
         tau=config.tau,
+        load_impl=config.load_impl,
     )
     return Placement(
         indices=res.indices,

@@ -32,8 +32,10 @@ from modelmesh_tpu.ops.auction import (
     MAX_COPIES,
     RESHORTLIST_EVERY,
     _NEG_INF,
+    _implied_load,
     _select,
     price_step,
+    resolve_load_impl,
     select_from_candidates,
     shortlist,
 )
@@ -152,7 +154,7 @@ def _sharded_sinkhorn(C, row_mass, col_mass, eps: float, iters: int,
 
 
 def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
-                     eta: float):
+                     eta: float, load_impl: str = "auto"):
     """scores_full: [n_blk, M] (rows sharded on mdl, full instance width).
 
     Gumbel perturbation is folded in by the caller (per-shard key) so the
@@ -164,14 +166,10 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
     copies = jnp.minimum(copies, MAX_COPIES)
 
     kc = min(K_CAND, num_instances)
+    load_impl = resolve_load_impl(load_impl)
 
     def implied_load(idx, valid):
-        contrib = sizes[:, None] * valid.astype(jnp.float32)
-        local = (
-            jnp.zeros((num_instances,), jnp.float32)
-            .at[idx.reshape(-1)]
-            .add(contrib.reshape(-1))
-        )
+        local = _implied_load(idx, valid, sizes, num_instances, load_impl)
         return jax.lax.psum(local, MODEL_AXIS)
 
     # Best-ASSIGNMENT tracking + round-based re-shortlisting — must mirror
@@ -181,11 +179,11 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
     n_blk = scores_full.shape[0]
 
     def narrow_round(carry, length):
-        price, best_idx, best_valid, best_of = carry
+        price, best_idx, best_valid, best_load, best_of = carry
         cand_vals, cand_idx = shortlist(scores_full, price, kc)
 
         def body(carry, _):
-            price, bi, bv, bo = carry
+            price, bi, bv, bl, bo = carry
             idx, valid = select_from_candidates(
                 cand_vals, cand_idx, copies, price
             )
@@ -194,11 +192,13 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
             better = of < bo
             bi = jnp.where(better, idx, bi)
             bv = jnp.where(better, valid, bv)
+            bl = jnp.where(better, load, bl)
             bo = jnp.minimum(of, bo)
-            return (price_step(load, cap, price, eta), bi, bv, bo), None
+            return (price_step(load, cap, price, eta), bi, bv, bl, bo), None
 
         carry, _ = jax.lax.scan(
-            body, (price, best_idx, best_valid, best_of), None, length=length
+            body, (price, best_idx, best_valid, best_load, best_of), None,
+            length=length,
         )
         return carry
 
@@ -207,21 +207,23 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
         price0,
         jnp.zeros((n_blk, MAX_COPIES), jnp.int32),
         jnp.zeros((n_blk, MAX_COPIES), bool),
+        jnp.zeros((num_instances,), jnp.float32),
         jnp.asarray(jnp.inf, jnp.float32),
     )
     for length in [RESHORTLIST_EVERY] * (iters // RESHORTLIST_EVERY) + (
         [iters % RESHORTLIST_EVERY] if iters % RESHORTLIST_EVERY else []
     ):
         carry = narrow_round(carry, length)
-    price, best_idx, best_valid, best_of = carry
+    price, best_idx, best_valid, best_load, best_of = carry
     idx_l, valid_l = _select(scores_full - price[None, :], copies)
     load_l = implied_load(idx_l, valid_l)
     of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
     use_last = of_l <= best_of
     idx = jnp.where(use_last, idx_l, best_idx)
     valid = jnp.where(use_last, valid_l, best_valid)
-    load = implied_load(idx, valid)
-    overflow = jnp.sum(jnp.maximum(load - cap, 0.0))
+    # Winner's load rides the carry (saves a recompute AND its psum).
+    load = jnp.where(use_last, load_l, best_load)
+    overflow = jnp.minimum(of_l, best_of)
     return idx, valid, load, price, overflow
 
 
@@ -259,7 +261,7 @@ def _solve_kernel(
     free_full = jax.lax.all_gather(free, INSTANCE_AXIS, axis=0, tiled=True)
     idx, valid, load, _price, overflow = _sharded_auction(
         logits_full, p.sizes, copies, free_full, config.auction_iters,
-        config.eta,
+        config.eta, load_impl=config.load_impl,
     )
     return Placement(
         indices=idx, valid=valid, load=load, overflow=overflow,

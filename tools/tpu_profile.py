@@ -163,16 +163,42 @@ def main() -> int:
             jax.jit(_select),
             scores - price[None, :], jnp.minimum(problem.copies, 8),
         )
-        timed(
-            f"{label}:implied-load-scatter",
-            jax.jit(_implied_load, static_argnums=3),
-            sel_idx, sel_valid, problem.sizes, mp_,
-        )
+        for impl in ("scatter", "fused"):
+            timed(
+                f"{label}:implied-load-{impl}",
+                jax.jit(_implied_load, static_argnums=(3, 4)),
+                sel_idx, sel_valid, problem.sizes, mp_, impl,
+            )
+            # In-loop behavior (what the price loop actually pays): 40
+            # iterations with a carry-dependent index perturbation so XLA
+            # cannot hoist the loop-invariant histogram out of the scan.
+            def loop40(idx, valid, sizes, _impl=impl):
+                def body(acc, _):
+                    bump = (acc[0] > 1e30).astype(jnp.int32)  # always 0
+                    load = _implied_load(
+                        idx + bump, valid, sizes, mp_, _impl
+                    )
+                    return acc + load, None
+
+                acc, _ = jax.lax.scan(
+                    body, jnp.zeros((mp_,), jnp.float32), None, length=40
+                )
+                return acc
+
+            timed(f"{label}:implied-load-{impl}-x40",
+                  jax.jit(loop40), sel_idx, sel_valid, problem.sizes)
         # f32 vs bf16 cost dtype on the full solve
         timed(f"{label}:full-solve-f32", solve_placement, problem,
               SolveConfig(dtype=jnp.float32), seed=1)
         timed(f"{label}:full-solve-xla-lse", solve_placement, problem,
               SolveConfig(lse_impl="xla"), seed=1)
+        timed(f"{label}:full-solve-scatter-load", solve_placement, problem,
+              SolveConfig(load_impl="scatter"), seed=1)
+        timed(f"{label}:full-solve-fused-load", solve_placement, problem,
+              SolveConfig(load_impl="fused"), seed=1)
+        # tau=0 disables the Gumbel draw: isolates the threefry cost
+        timed(f"{label}:full-solve-no-gumbel", solve_placement, problem,
+              SolveConfig(tau=0.0), seed=1)
     return 0
 
 

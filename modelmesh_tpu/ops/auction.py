@@ -188,13 +188,85 @@ def _implied_load(
     )
 
 
-def gumbel_perturb(scores: jax.Array, tau: float, seed: jax.Array) -> jax.Array:
+def check_rounding_config(noise_impl: str, final_select: str, iters: int):
+    """Validate the rounding knobs once, shared by both solvers (the
+    single-device and sharded epilogues must behave identically)."""
+    if noise_impl not in ("threefry", "hash"):
+        raise ValueError(
+            f"noise_impl={noise_impl!r} (expected threefry | hash)"
+        )
+    if final_select not in ("exact", "approx", "none"):
+        raise ValueError(
+            f"final_select={final_select!r} (expected exact | approx | none)"
+        )
+    if final_select == "none" and iters < 1:
+        # The best-iterate carry would still hold the inf/zeros sentinel.
+        raise ValueError("final_select='none' requires iters >= 1")
+
+
+def final_candidate(scores_minus_price, copies, final_select: str):
+    """Epilogue competitor to the best price iterate — shared by both
+    solvers so the parity-critical selection cannot drift."""
+    if final_select == "approx":
+        k = min(MAX_COPIES, scores_minus_price.shape[1])
+        vals, idx = jax.lax.approx_max_k(scores_minus_price, k)
+        return _finalize_topk(vals, idx, copies)
+    return _select(scores_minus_price, copies)
+
+
+def hash_gumbel(
+    shape: tuple[int, int],
+    seed: jax.Array,
+    row_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Counter-based Gumbel(0, 1) noise: murmur3-finalizer mixing of the
+    (global row, col, seed) counter, bitcast to uniform, double-log map.
+
+    Statistically ample for de-herding top-k draws (the only consumer),
+    and much cheaper than threefry on a 1e8-element matrix. ``row_offset``
+    makes a sharded block's noise equal the corresponding rows of the
+    full-matrix draw — single-device and sharded solves see IDENTICAL
+    noise for the same seed, which threefry's fold_in cannot offer."""
+    rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0) + jnp.asarray(
+        row_offset, jnp.uint32
+    )
+    cols = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    x = (
+        rows * jnp.uint32(0x9E3779B9)
+        + cols * jnp.uint32(0x85EBCA6B)
+        + jnp.asarray(seed, jnp.uint32) * jnp.uint32(0xC2B2AE35)
+    )
+    # murmur3 fmix32: full avalanche, pure VPU integer ops.
+    x ^= x >> 16
+    x *= jnp.uint32(0x85EBCA6B)
+    x ^= x >> 13
+    x *= jnp.uint32(0xC2B2AE35)
+    x ^= x >> 16
+    # Top 24 bits -> uniform in [eps, 1) (0 would blow up the outer log).
+    u = (x >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+    u = jnp.maximum(u, 1e-7)
+    return -jnp.log(-jnp.log(u))
+
+
+def gumbel_perturb(
+    scores: jax.Array,
+    tau: float,
+    seed: jax.Array,
+    impl: str = "threefry",
+    row_offset: jax.Array | int = 0,
+) -> jax.Array:
     """Add Gumbel(0, tau) noise so top-k draws ~ softmax(scores / tau).
 
     ``seed`` is a *traced* int32 scalar — callers vary it per solve (janitor
-    pass counter) without triggering a recompile.
+    pass counter) without triggering a recompile. ``impl``: "threefry" uses
+    the JAX PRNG; "hash" the cheap counter-based draw (hash_gumbel).
     """
-    g = jax.random.gumbel(jax.random.PRNGKey(seed), scores.shape)
+    if impl not in ("threefry", "hash"):
+        raise ValueError(f"noise impl {impl!r} (expected threefry | hash)")
+    if impl == "hash":
+        g = hash_gumbel(scores.shape, seed, row_offset)
+    else:
+        g = jax.random.gumbel(jax.random.PRNGKey(seed), scores.shape)
     return scores.astype(jnp.float32) + tau * g
 
 
@@ -214,7 +286,10 @@ def price_step(load, cap, price, eta_t):
 
 @partial(
     jax.jit,
-    static_argnames=("iters", "eta", "price_scale", "tau", "load_impl"),
+    static_argnames=(
+        "iters", "eta", "price_scale", "tau", "load_impl", "noise_impl",
+        "final_select",
+    ),
 )
 def auction(
     scores: jax.Array,      # [N, M] plan logits, higher is better (bf16 ok)
@@ -229,17 +304,27 @@ def auction(
     price_scale: float = 1.0,
     tau: float = 1.0,
     load_impl: str = "auto",
+    noise_impl: str = "threefry",
+    final_select: str = "exact",
 ) -> AuctionResult:
     """Gumbel-top-k sampling + best-iterate congestion-price repair.
 
     ``price_scale`` converts prices into score units; with Sinkhorn plan
     logits the useful spread is O(1), so the default 1.0 is right — the
     per-iteration step is ``eta * price_scale * clip(overload)``.
+
+    ``noise_impl``: "threefry" (JAX PRNG) or "hash" (cheap counter-based
+    draw). ``final_select``: how the epilogue competes with the tracked
+    best-iterate assignment — "exact" full-width top-k, "approx"
+    approx_max_k (cheaper on TPU, recall ~0.95), "none" skips the
+    epilogue candidate entirely and returns the best iterate.
     """
+    check_rounding_config(noise_impl, final_select, iters)
     num_instances = capacity.shape[0]
     seed = jnp.asarray(seed, jnp.uint32)
     scores_f32 = (
-        gumbel_perturb(scores, tau, seed) if tau > 0 else scores.astype(jnp.float32)
+        gumbel_perturb(scores, tau, seed, impl=noise_impl)
+        if tau > 0 else scores.astype(jnp.float32)
     )
     scores_f32 = jnp.where(feasible, scores_f32, _NEG_INF)
     cap = jnp.maximum(capacity.astype(jnp.float32), 1e-6)
@@ -297,10 +382,19 @@ def auction(
     ):
         carry = narrow_round(carry, length)
     price, best_idx, best_valid, best_load, best_of = carry
-    # One exact full-width selection at the final prices competes with the
-    # best recorded assignment; whichever overflows less wins. The winner's
+    # One full-width selection at the final prices competes with the best
+    # recorded assignment; whichever overflows less wins. The winner's
     # load rides the carry — no histogram recompute in the epilogue.
-    idx_l, valid_l = _select(scores_f32 - price[None, :], copies)
+    if final_select == "none":
+        # With iters >= 1 the first narrow round always improves on the
+        # inf sentinel, so the best-iterate carry is a real assignment.
+        return AuctionResult(
+            indices=best_idx, valid=best_valid, load=best_load,
+            prices=price, overflow=best_of,
+        )
+    idx_l, valid_l = final_candidate(
+        scores_f32 - price[None, :], copies, final_select
+    )
     load_l = _implied_load(idx_l, valid_l, sizes, num_instances, load_impl)
     of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
     use_last = of_l <= best_of

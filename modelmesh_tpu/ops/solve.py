@@ -44,6 +44,12 @@ class SolveConfig(NamedTuple):
     # Auction implied-load histogram: "auto" = fused compare-reduce on TPU
     # (duplicate-index scatter-add serializes there), scatter elsewhere.
     load_impl: str = "auto"
+    # Rounding-noise generator: "threefry" (JAX PRNG) or "hash" (cheap
+    # counter-based murmur mix; identical draws single-device vs sharded).
+    noise_impl: str = "threefry"
+    # Epilogue competitor to the best price iterate: "exact" full top-k,
+    # "approx" approx_max_k, "none" best-iterate only.
+    final_select: str = "exact"
     dtype: jnp.dtype = jnp.bfloat16
 
 
@@ -104,6 +110,8 @@ def solve_placement(
         eta=config.eta,
         tau=config.tau,
         load_impl=config.load_impl,
+        noise_impl=config.noise_impl,
+        final_select=config.final_select,
     )
     return Placement(
         indices=res.indices,

@@ -33,7 +33,9 @@ from modelmesh_tpu.ops.auction import (
     RESHORTLIST_EVERY,
     _NEG_INF,
     _implied_load,
-    _select,
+    check_rounding_config,
+    final_candidate,
+    hash_gumbel,
     price_step,
     resolve_load_impl,
     select_from_candidates,
@@ -154,7 +156,8 @@ def _sharded_sinkhorn(C, row_mass, col_mass, eps: float, iters: int,
 
 
 def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
-                     eta: float, load_impl: str = "auto"):
+                     eta: float, load_impl: str = "auto",
+                     final_select: str = "exact"):
     """scores_full: [n_blk, M] (rows sharded on mdl, full instance width).
 
     Gumbel perturbation is folded in by the caller (per-shard key) so the
@@ -215,7 +218,11 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
     ):
         carry = narrow_round(carry, length)
     price, best_idx, best_valid, best_load, best_of = carry
-    idx_l, valid_l = _select(scores_full - price[None, :], copies)
+    if final_select == "none":
+        return best_idx, best_valid, best_load, price, best_of
+    idx_l, valid_l = final_candidate(
+        scores_full - price[None, :], copies, final_select
+    )
     load_l = implied_load(idx_l, valid_l)
     of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
     use_last = of_l <= best_of
@@ -248,13 +255,23 @@ def _solve_kernel(
     # Full-width rows for top-k (no-op when inst mesh axis is 1).
     logits_full = jax.lax.all_gather(logits, INSTANCE_AXIS, axis=1, tiled=True)
     if config.tau > 0:
-        # Gumbel perturbation keyed per model-shard (see ops.auction: top-k
-        # of logits + Gumbel samples ~ the soft plan, de-herding identical
-        # rows).
-        key = jax.random.fold_in(
-            jax.random.PRNGKey(seed), jax.lax.axis_index(MODEL_AXIS)
-        )
-        noise = config.tau * jax.random.gumbel(key, logits_full.shape)
+        # Gumbel perturbation de-herds identical rows (see ops.auction:
+        # top-k of logits + Gumbel samples ~ the soft plan). "hash" offsets
+        # the counter by the shard's global row start, so the draw equals
+        # the single-device one bit-for-bit; threefry folds the shard index
+        # into the key instead (distinct but not offset-consistent).
+        if config.noise_impl == "hash":
+            row_off = (
+                jax.lax.axis_index(MODEL_AXIS) * logits_full.shape[0]
+            ).astype(jnp.uint32)
+            noise = config.tau * hash_gumbel(
+                logits_full.shape, seed, row_off
+            )
+        else:
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(seed), jax.lax.axis_index(MODEL_AXIS)
+            )
+            noise = config.tau * jax.random.gumbel(key, logits_full.shape)
         logits_full = jnp.where(
             logits_full > _NEG_INF / 2, logits_full + noise, logits_full
         )
@@ -262,6 +279,7 @@ def _solve_kernel(
     idx, valid, load, _price, overflow = _sharded_auction(
         logits_full, p.sizes, copies, free_full, config.auction_iters,
         config.eta, load_impl=config.load_impl,
+        final_select=config.final_select,
     )
     return Placement(
         indices=idx, valid=valid, load=load, overflow=overflow,
@@ -278,12 +296,18 @@ def make_sharded_solver(
     # TPU backends, XLA elsewhere) exactly like the single-device path.
     """Build a jitted sharded solver bound to ``mesh``.
 
+    Raises the same ValueErrors as the single-device ``auction`` for
+    invalid rounding knobs (noise_impl / final_select / iters).
+
     The returned callable is ``solver(problem, seed=...)`` — seed is traced,
     so varying it per solve never recompiles. The problem's model-axis
     length must be divisible by the ``mdl`` mesh axis and instance-axis
     length by ``inst``; outputs: indices/valid sharded on ``mdl``, load
     replicated.
     """
+    check_rounding_config(
+        config.noise_impl, config.final_select, config.auction_iters
+    )
     col = P(INSTANCE_AXIS)
     in_specs = (mesh_mod.problem_pspec(), P(), col)
     row = P(MODEL_AXIS)

@@ -157,6 +157,31 @@ def main() -> None:
         times_ms.append((time.perf_counter() - t0) * 1e3)
 
     p99 = float(np.percentile(np.asarray(times_ms), 99))
+    # Pipelined throughput (accelerators only): K solves queued
+    # back-to-back with ONE readback at the end. The device executes
+    # launches in order, so blocking on the last overflow proves all K
+    # executed; total/K bounds steady-state per-solve time WITHOUT paying
+    # the link round-trip per rep — over the axon tunnel a scalar D2H
+    # costs ~65 ms, flooring any per-rep number regardless of how fast
+    # the chip actually solves. On a co-located host the two converge.
+    pipelined_ms = None
+    if dev.platform != "cpu":
+        # 16 solves amortize the ~65 ms RTT to <5 ms of bias; more would
+        # burn scarce relay-window minutes for no added precision. Guarded:
+        # a mid-queue relay death must not discard the per-rep p99 above
+        # (same rationale as the e2e block below).
+        k = min(max(REPS, 8), 16)
+        try:
+            t0 = time.perf_counter()
+            last = None
+            for rep in range(k):
+                last = solve(problem, seed=1000 + rep)
+            float(np.asarray(last.overflow))
+            pipelined_ms = (time.perf_counter() - t0) * 1e3 / k
+        except Exception as e:  # noqa: BLE001
+            print(
+                f"bench: pipelined measurement failed: {e}", file=sys.stderr
+            )
     at_target_tier = (NUM_MODELS, NUM_INSTANCES) == BASELINE_TIER
     # With < 10 samples "p99" would be a dressed-up max — label honestly.
     stat = "p99" if REPS >= 10 else f"max-of-{REPS}"
@@ -176,6 +201,8 @@ def main() -> None:
         # against a smaller tier would overstate the win (round-1 verdict).
         "vs_baseline": round(BASELINE_MS / p99, 1) if at_target_tier else None,
     }
+    if pipelined_ms is not None:
+        result["pipelined_ms_per_solve"] = round(pipelined_ms, 3)
     # End-to-end refresh (snapshot -> build -> solve -> publish -> adopt)
     # on synthetic records — full tier on an accelerator; a reduced tier on
     # the CPU fallback so the bench terminates (stage costs outside the

@@ -199,6 +199,16 @@ def main() -> int:
         # tau=0 disables the Gumbel draw: isolates the threefry cost
         timed(f"{label}:full-solve-no-gumbel", solve_placement, problem,
               SolveConfig(tau=0.0), seed=1)
+        timed(f"{label}:full-solve-hash-noise", solve_placement, problem,
+              SolveConfig(noise_impl="hash"), seed=1)
+        timed(f"{label}:full-solve-approx-final", solve_placement, problem,
+              SolveConfig(final_select="approx"), seed=1)
+        timed(f"{label}:full-solve-none-final", solve_placement, problem,
+              SolveConfig(final_select="none"), seed=1)
+        # Candidate fast config: every cheap option at once.
+        timed(f"{label}:full-solve-fast-combo", solve_placement, problem,
+              SolveConfig(load_impl="fused", noise_impl="hash",
+                          final_select="approx"), seed=1)
     return 0
 
 

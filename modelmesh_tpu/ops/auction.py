@@ -304,7 +304,7 @@ def auction(
     price_scale: float = 1.0,
     tau: float = 1.0,
     load_impl: str = "auto",
-    noise_impl: str = "threefry",
+    noise_impl: str = "hash",
     final_select: str = "exact",
 ) -> AuctionResult:
     """Gumbel-top-k sampling + best-iterate congestion-price repair.
@@ -313,8 +313,9 @@ def auction(
     logits the useful spread is O(1), so the default 1.0 is right — the
     per-iteration step is ``eta * price_scale * clip(overload)``.
 
-    ``noise_impl``: "threefry" (JAX PRNG) or "hash" (cheap counter-based
-    draw). ``final_select``: how the epilogue competes with the tracked
+    ``noise_impl``: "hash" (default: cheap counter-based draw, identical
+    across topologies) or "threefry" (JAX PRNG). ``final_select``: how
+    the epilogue competes with the tracked
     best-iterate assignment — "exact" full-width top-k, "approx"
     approx_max_k (cheaper on TPU, recall ~0.95), "none" skips the
     epilogue candidate entirely and returns the best iterate.

@@ -44,9 +44,12 @@ class SolveConfig(NamedTuple):
     # Auction implied-load histogram: "auto" = fused compare-reduce on TPU
     # (duplicate-index scatter-add serializes there), scatter elsewhere.
     load_impl: str = "auto"
-    # Rounding-noise generator: "threefry" (JAX PRNG) or "hash" (cheap
-    # counter-based murmur mix; identical draws single-device vs sharded).
-    noise_impl: str = "threefry"
+    # Rounding-noise generator: "hash" (counter-based murmur mix — ~5x
+    # cheaper than threefry on a 1e8-element draw and identical
+    # single-device vs sharded) or "threefry" (JAX PRNG). Rounding quality
+    # is statistically indistinguishable between the two (overflow 0.04-
+    # 0.2% of demand for both across seeds at 20k x 256, matched spread).
+    noise_impl: str = "hash"
     # Epilogue competitor to the best price iterate: "exact" full top-k,
     # "approx" approx_max_k, "none" best-iterate only.
     final_select: str = "exact"

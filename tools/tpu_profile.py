@@ -199,8 +199,10 @@ def main() -> int:
         # tau=0 disables the Gumbel draw: isolates the threefry cost
         timed(f"{label}:full-solve-no-gumbel", solve_placement, problem,
               SolveConfig(tau=0.0), seed=1)
-        timed(f"{label}:full-solve-hash-noise", solve_placement, problem,
-              SolveConfig(noise_impl="hash"), seed=1)
+        # The default is now noise_impl="hash"; the threefry row is the
+        # A/B that re-validates the ~5x draw-cost claim on new hardware.
+        timed(f"{label}:full-solve-threefry-noise", solve_placement,
+              problem, SolveConfig(noise_impl="threefry"), seed=1)
         timed(f"{label}:full-solve-approx-final", solve_placement, problem,
               SolveConfig(final_select="approx"), seed=1)
         timed(f"{label}:full-solve-none-final", solve_placement, problem,

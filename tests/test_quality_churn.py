@@ -1,0 +1,70 @@
+"""Churn-simulation quality regression: the JAX plan must stay in the
+greedy oracle's quality neighborhood ACROSS refreshes, not just at one
+instant (tools/quality_eval.py is the measurement harness; this pins its
+key invariants at a small tier so regressions in the solver's stickiness,
+preference handling, or balance show up in CI)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+
+@pytest.fixture(scope="module")
+def runs():
+    import quality_eval as qe
+
+    out = {}
+    for strategy in ("greedy", "jax"):
+        rng = np.random.default_rng(3)
+        st = qe.make_state(rng, 800, 16)
+        warm = None
+        scores = []
+        for epoch in range(4):
+            qe.churn(rng, st, epoch)
+            if strategy == "greedy":
+                placements = qe.greedy_epoch(st)
+            else:
+                placements, warm = qe.jax_epoch(st, warm, seed=epoch + 1)
+            scores.append(qe.score(st, placements))
+            qe.apply_plan(st, placements)
+        out[strategy] = scores
+    return out
+
+
+def _mean(scores, key):
+    return float(np.mean([s[key] for s in scores[1:]]))  # skip cold epoch
+
+
+class TestChurnQuality:
+    def test_stickiness_comparable_to_greedy(self, runs):
+        g = _mean(runs["greedy"], "migrations")
+        j = _mean(runs["jax"], "migrations")
+        # The solver must not thrash: steady-state migrations within 1.5x
+        # of the oracle (plus slack for tiny-tier noise).
+        assert j <= 1.5 * g + 20, (g, j)
+
+    def test_preference_satisfaction_not_worse(self, runs):
+        g = _mean(runs["greedy"], "pref_sat")
+        j = _mean(runs["jax"], "pref_sat")
+        assert j >= g - 0.02, (g, j)
+
+    def test_balance_not_worse(self, runs):
+        g = _mean(runs["greedy"], "balance_cv")
+        j = _mean(runs["jax"], "balance_cv")
+        assert j <= g + 0.05, (g, j)
+
+    def test_overflow_small(self, runs):
+        # Plans are advisory — local admission enforces hard caps — but
+        # the plan's own residual must stay ~1% of demand.
+        assert _mean(runs["jax"], "overflow_pct") <= 1.0
+
+    def test_everything_placeable_placed(self, runs):
+        g = _mean(runs["greedy"], "placed")
+        j = _mean(runs["jax"], "placed")
+        assert j >= 0.98 * g, (g, j)

@@ -1,0 +1,237 @@
+"""Churn-simulation quality evaluation: greedy oracle vs JAX global plans.
+
+The single-shot oracle test (tests/test_placement_ops.py
+TestQualityVsGreedyOracle) pins assignment cost at one instant. This tool
+measures what operators actually live with: plan quality ACROSS refreshes
+as the fleet churns — rates drift, models come and go, instances die and
+join — with each epoch's applied placement becoming the next epoch's
+loaded state (so gratuitous migration shows up as cost, exactly like the
+reference's janitor/reaper loops pay it, ModelMesh.java:5876-6835).
+
+Per epoch and strategy it reports:
+  - migrations: placements not already loaded (copy loads the fleet must
+    actually perform to follow the plan)
+  - overflow_pct: implied load above capacity, % of total demand
+  - pref_sat: fraction of placements on the model type's preferred set
+  - balance_cv: coefficient of variation of instance load (lower = more
+    even)
+  - solve_ms: wall time of the strategy's full decision pass
+
+Usage: python tools/quality_eval.py [N] [M] [--epochs T] [--json PATH]
+CPU by default (MM_QUALITY_ACCEL=1 to run the solver on the accelerator).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("MM_QUALITY_ACCEL") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from modelmesh_tpu import ops
+from modelmesh_tpu.ops.costs import PlacementProblem
+from modelmesh_tpu.ops.solve import SolveInit
+
+
+def make_state(rng, n, m, types=8, slack=1.6):
+    sizes = rng.integers(16, 256, n).astype(np.float32)
+    copies = rng.choice([1, 1, 1, 2, 2, 3], n).astype(np.int32)
+    rates = rng.lognormal(2.0, 1.2, n).astype(np.float32)
+    type_idx = rng.integers(0, types, n)
+    # Hard feasibility: each type excluded from a random ~12% of instances;
+    # soft preference: each type prefers a random ~35% subset.
+    feas_t = rng.random((types, m)) > 0.12
+    pref_t = rng.random((types, m)) < 0.35
+    demand = float((sizes * copies).sum())
+    capacity = np.full(m, demand * slack / m, np.float32)
+    loaded = np.zeros((n, m), bool)
+    return dict(
+        sizes=sizes, copies=copies, rates=rates, type_idx=type_idx,
+        feas_t=feas_t, pref_t=pref_t, capacity=capacity, loaded=loaded,
+        zone=(np.arange(m) % 3).astype(np.int32),
+    )
+
+
+def churn(rng, st, epoch):
+    n, m = st["loaded"].shape
+    # Rate drift every epoch; ~1.5% of models replaced (cold, new type).
+    st["rates"] = (
+        st["rates"] * rng.lognormal(0.0, 0.25, n)
+    ).astype(np.float32)
+    reborn = rng.random(n) < 0.015
+    st["rates"][reborn] = rng.lognormal(2.0, 1.2, reborn.sum())
+    st["loaded"][reborn] = False
+    st["type_idx"][reborn] = rng.integers(0, st["feas_t"].shape[0],
+                                          reborn.sum())
+    # Every 4th epoch one instance dies (state wiped) — the reaper case.
+    if epoch % 4 == 3:
+        j = int(rng.integers(0, m))
+        st["loaded"][:, j] = False
+
+
+def to_problem(st) -> PlacementProblem:
+    n, m = st["loaded"].shape
+    feasible = st["feas_t"][st["type_idx"]]
+    preferred = st["pref_t"][st["type_idx"]]
+    return PlacementProblem(
+        sizes=jnp.asarray(st["sizes"]),
+        copies=jnp.asarray(st["copies"]),
+        rates=jnp.asarray(st["rates"]),
+        loaded=jnp.asarray(st["loaded"]),
+        feasible=jnp.asarray(feasible),
+        capacity=jnp.asarray(st["capacity"]),
+        reserved=jnp.zeros((m,), jnp.float32),
+        lru_age=jnp.zeros((m,), jnp.float32),
+        busyness=jnp.asarray(st["rates"] @ st["loaded"].astype(np.float32)),
+        zone=jnp.asarray(st["zone"]),
+        preferred=jnp.asarray(preferred),
+    )
+
+
+def greedy_epoch(st):
+    """Idealized greedy: global knowledge, rate-ordered, cheapest feasible
+    instance with room — strictly stronger than the reference's myopic
+    per-request walk (stale views, partial knowledge)."""
+    C = np.asarray(ops.assemble_cost(to_problem(st), dtype=jnp.float32))
+    n, m = st["loaded"].shape
+    feasible = st["feas_t"][st["type_idx"]]
+    load = np.zeros(m, np.float32)
+    placements = np.full((n, ops.MAX_COPIES), -1, np.int64)
+    order = np.argsort(-st["rates"])
+    for i in order:
+        row = C[i]
+        k = min(int(st["copies"][i]), ops.MAX_COPIES)
+        chosen: list[int] = []
+        # cheapest-first scan of this row
+        for j in np.argsort(row):
+            if len(chosen) >= k:
+                break
+            if not feasible[i, j]:
+                continue
+            if load[j] + st["sizes"][i] > st["capacity"][j]:
+                continue
+            chosen.append(int(j))
+            load[j] += st["sizes"][i]
+        placements[i, : len(chosen)] = chosen
+    return placements
+
+
+def jax_epoch(st, warm_g=None, seed=0):
+    p = to_problem(st)
+    # Always pass a materialized g0 (zeros when cold): switching init
+    # between None and an array changes the jit signature and forces a
+    # recompile on the first warm epoch (same rule as solve_plan).
+    g0 = (
+        np.zeros(st["capacity"].shape, np.float32)
+        if warm_g is None else warm_g
+    )
+    sol = jax.block_until_ready(
+        ops.solve_placement(p, seed=seed, init=SolveInit(g0=jnp.asarray(g0)))
+    )
+    idx = np.asarray(sol.indices)
+    valid = np.asarray(sol.valid)
+    placements = np.where(valid, idx, -1).astype(np.int64)
+    return placements, np.asarray(sol.g)
+
+
+def score(st, placements):
+    n, m = st["loaded"].shape
+    sel = placements >= 0
+    rows = np.repeat(np.arange(n), sel.sum(axis=1))
+    cols = placements[sel]
+    load = np.bincount(cols, weights=st["sizes"][rows], minlength=m)
+    overflow = float(np.maximum(load - st["capacity"], 0.0).sum())
+    demand = float(
+        (st["sizes"] * np.minimum(st["copies"], ops.MAX_COPIES)).sum()
+    )
+    pref = st["pref_t"][st["type_idx"]]
+    migrations = int((~st["loaded"][rows, cols]).sum())
+    return dict(
+        placed=int(sel.sum()),
+        migrations=migrations,
+        overflow_pct=round(100 * overflow / demand, 3),
+        pref_sat=round(float(pref[rows, cols].mean()), 4),
+        balance_cv=round(float(load.std() / max(load.mean(), 1e-9)), 4),
+    )
+
+
+def apply_plan(st, placements):
+    n, m = st["loaded"].shape
+    nxt = np.zeros((n, m), bool)
+    sel = placements >= 0
+    rows = np.repeat(np.arange(n), sel.sum(axis=1))
+    nxt[rows, placements[sel]] = True
+    st["loaded"] = nxt
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("n", type=int, nargs="?", default=4000)
+    ap.add_argument("m", type=int, nargs="?", default=64)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    lines = []
+    summary: dict[str, dict[str, list]] = {}
+    for strategy in ("greedy", "jax"):
+        rng = np.random.default_rng(args.seed)
+        st = make_state(rng, args.n, args.m)
+        warm = None
+        for epoch in range(args.epochs):
+            churn(rng, st, epoch)
+            t0 = time.perf_counter()
+            if strategy == "greedy":
+                placements = greedy_epoch(st)
+            else:
+                # Vary the rounding seed per epoch (solve_placement's
+                # contract; production's refresh loop does the same) so
+                # stickiness is measured under independent draws.
+                placements, warm = jax_epoch(
+                    st, warm, seed=args.seed * 1000 + epoch + 1
+                )
+            ms = (time.perf_counter() - t0) * 1e3
+            s = score(st, placements)
+            s.update(strategy=strategy, epoch=epoch, solve_ms=round(ms, 1))
+            lines.append(s)
+            print(json.dumps(s), flush=True)
+            apply_plan(st, placements)
+            for k in ("migrations", "overflow_pct", "pref_sat",
+                      "balance_cv", "solve_ms", "placed"):
+                summary.setdefault(strategy, {}).setdefault(k, []).append(
+                    s[k]
+                )
+    # Epoch 0 is a cold fleet (every placement is a "migration") — the
+    # steady-state summary excludes it. With a single epoch there is no
+    # steady state to summarize (avoid np.mean([]) -> NaN, invalid JSON).
+    out = {"summary": {
+        strat: {k: round(float(np.mean(v[1:])), 3)
+                for k, v in per.items()}
+        for strat, per in summary.items()
+    } if args.epochs > 1 else None,
+        "tier": f"{args.n}x{args.m}", "epochs": args.epochs}
+    print(json.dumps(out), flush=True)
+    if args.json:
+        with open(args.json, "w") as f:
+            for ln in lines:
+                f.write(json.dumps(ln) + "\n")
+            f.write(json.dumps(out) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

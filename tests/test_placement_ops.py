@@ -236,31 +236,27 @@ class TestQualityVsGreedyOracle:
         batched solve must stay within 5% on cost with the same number of
         placements, across slack regimes. Its advantages are latency
         (30 s serial -> ms batched) and plan-level coordination, never
-        bought with placement quality."""
+        bought with placement quality.
+
+        The oracle itself is tools/quality_eval.py greedy_oracle — ONE
+        definition shared with the churn-quality eval so the two
+        baselines cannot drift."""
+        import os
+        import sys
+
         import numpy as np
 
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(__file__)), "tools"
+        ))
+        from quality_eval import greedy_oracle
+
         def greedy_assign(C, sizes, copies, cap, feasible, rates):
-            N, M = C.shape
-            load = np.zeros(M)
-            total, placed = 0.0, 0
-            for i in np.argsort(-rates):
-                chosen = set()
-                for _ in range(int(copies[i])):
-                    best, best_c = -1, np.inf
-                    for j in range(M):
-                        if j in chosen or not feasible[i, j]:
-                            continue
-                        if load[j] + sizes[i] > cap[j]:
-                            continue
-                        if C[i, j] < best_c:
-                            best, best_c = j, C[i, j]
-                    if best < 0:
-                        break  # nothing changed; further copies can't fit
-                    load[best] += sizes[i]
-                    chosen.add(best)
-                    total += best_c
-                    placed += 1
-            return total, placed
+            placements = greedy_oracle(C, sizes, copies, cap, feasible,
+                                       rates)
+            sel = placements >= 0
+            rows = np.repeat(np.arange(C.shape[0]), sel.sum(axis=1))
+            return float(C[rows, placements[sel]].sum()), int(sel.sum())
 
         for slack, seed in ((1.3, 0), (1.6, 1), (2.5, 2)):
             p = ops.random_problem(

@@ -101,19 +101,19 @@ def to_problem(st) -> PlacementProblem:
     )
 
 
-def greedy_epoch(st):
-    """Idealized greedy: global knowledge, rate-ordered, cheapest feasible
-    instance with room — strictly stronger than the reference's myopic
-    per-request walk (stale views, partial knowledge)."""
-    C = np.asarray(ops.assemble_cost(to_problem(st), dtype=jnp.float32))
-    n, m = st["loaded"].shape
-    feasible = st["feas_t"][st["type_idx"]]
+def greedy_oracle(C, sizes, copies, capacity, feasible, rates):
+    """THE idealized greedy oracle: global knowledge, rate-ordered,
+    cheapest feasible instance with room — strictly stronger than the
+    reference's myopic per-request walk (stale views, partial knowledge).
+    Single definition shared by the churn eval here and the single-shot
+    cost-parity test (tests/test_placement_ops.py) so the two baselines
+    cannot drift. Returns placements i64[N, MAX_COPIES], -1 = empty."""
+    n, m = C.shape
     load = np.zeros(m, np.float32)
     placements = np.full((n, ops.MAX_COPIES), -1, np.int64)
-    order = np.argsort(-st["rates"])
-    for i in order:
+    for i in np.argsort(-rates):
         row = C[i]
-        k = min(int(st["copies"][i]), ops.MAX_COPIES)
+        k = min(int(copies[i]), ops.MAX_COPIES)
         chosen: list[int] = []
         # cheapest-first scan of this row
         for j in np.argsort(row):
@@ -121,12 +121,20 @@ def greedy_epoch(st):
                 break
             if not feasible[i, j]:
                 continue
-            if load[j] + st["sizes"][i] > st["capacity"][j]:
+            if load[j] + sizes[i] > capacity[j]:
                 continue
             chosen.append(int(j))
-            load[j] += st["sizes"][i]
+            load[j] += sizes[i]
         placements[i, : len(chosen)] = chosen
     return placements
+
+
+def greedy_epoch(st):
+    C = np.asarray(ops.assemble_cost(to_problem(st), dtype=jnp.float32))
+    return greedy_oracle(
+        C, st["sizes"], st["copies"], st["capacity"],
+        st["feas_t"][st["type_idx"]], st["rates"],
+    )
 
 
 def jax_epoch(st, warm_g=None, seed=0):
@@ -147,11 +155,18 @@ def jax_epoch(st, warm_g=None, seed=0):
     return placements, np.asarray(sol.g)
 
 
+def _pairs(placements):
+    """Flatten a placements matrix to aligned (model_row, instance_col)
+    index arrays. Row-major boolean indexing matches np.repeat order —
+    the alignment both score() and apply_plan() depend on."""
+    sel = placements >= 0
+    rows = np.repeat(np.arange(placements.shape[0]), sel.sum(axis=1))
+    return rows, placements[sel]
+
+
 def score(st, placements):
     n, m = st["loaded"].shape
-    sel = placements >= 0
-    rows = np.repeat(np.arange(n), sel.sum(axis=1))
-    cols = placements[sel]
+    rows, cols = _pairs(placements)
     load = np.bincount(cols, weights=st["sizes"][rows], minlength=m)
     overflow = float(np.maximum(load - st["capacity"], 0.0).sum())
     demand = float(
@@ -160,7 +175,7 @@ def score(st, placements):
     pref = st["pref_t"][st["type_idx"]]
     migrations = int((~st["loaded"][rows, cols]).sum())
     return dict(
-        placed=int(sel.sum()),
+        placed=len(cols),
         migrations=migrations,
         overflow_pct=round(100 * overflow / demand, 3),
         pref_sat=round(float(pref[rows, cols].mean()), 4),
@@ -169,11 +184,9 @@ def score(st, placements):
 
 
 def apply_plan(st, placements):
-    n, m = st["loaded"].shape
-    nxt = np.zeros((n, m), bool)
-    sel = placements >= 0
-    rows = np.repeat(np.arange(n), sel.sum(axis=1))
-    nxt[rows, placements[sel]] = True
+    nxt = np.zeros(st["loaded"].shape, bool)
+    rows, cols = _pairs(placements)
+    nxt[rows, cols] = True
     st["loaded"] = nxt
 
 

@@ -1,0 +1,171 @@
+"""Serving data-plane throughput/latency benchmark (CPU-only, no JAX).
+
+Measures the request hot path the reference optimizes but never publishes
+numbers for (SURVEY.md §6: qualitative "high-scale, high-density" claims
+only): client -> gRPC front door -> routing -> runtime invoke, over REAL
+localhost gRPC on both hops.
+
+Scenarios:
+  hit-local   : model loaded on the receiving instance (cache-hit fast
+                path — api.py dataplane + instance routing + runtime RPC)
+  hit-remote  : model loaded only on a peer; the receiving instance
+                forwards (adds one MeshInternal Forward hop)
+  mgmt-status : GetModelStatus management RPC rate
+
+Usage: python tools/serving_bench.py [--seconds S] [--workers W]
+Prints one JSON line per scenario: rps, p50/p99 ms, errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.proto import mesh_api_pb2 as apb
+from modelmesh_tpu.runtime import ModelInfo, grpc_defs
+from modelmesh_tpu.runtime.fake import (
+    PREDICT_METHOD,
+    FakeRuntimeServicer,
+    start_fake_runtime,
+)
+from modelmesh_tpu.runtime.sidecar import SidecarRuntime
+from modelmesh_tpu.serving.api import (
+    MeshServer,
+    PeerChannels,
+    make_grpc_peer_call,
+)
+from modelmesh_tpu.serving.instance import InstanceConfig, ModelMeshInstance
+
+
+def start_pod(kv, peer_call, iid):
+    rt_server, rt_port, _servicer = start_fake_runtime(
+        servicer=FakeRuntimeServicer(capacity_bytes=256 << 20)
+    )
+    try:
+        loader = SidecarRuntime(f"127.0.0.1:{rt_port}", startup_timeout_s=10)
+        inst = ModelMeshInstance(
+            kv, loader,
+            InstanceConfig(instance_id=iid, load_timeout_s=10,
+                           min_churn_age_ms=0),
+            peer_call=peer_call,
+        )
+        server = MeshServer(inst)
+    except Exception:
+        # The runtime server's non-daemon executor threads would keep the
+        # process alive past the traceback — stop what already started.
+        rt_server.stop(0)
+        raise
+    inst.config.endpoint = server.endpoint
+    inst.publish_instance_record(force=True)
+    return inst, server, rt_server
+
+
+def run_workers(fn, seconds, workers):
+    lat: list[float] = []
+    errors = [0]
+    stop = time.monotonic() + seconds
+    lock = threading.Lock()
+
+    def loop():
+        mine = []
+        errs = 0
+        while time.monotonic() < stop:
+            t0 = time.perf_counter()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — counted, not raised
+                errs += 1
+                continue
+            mine.append((time.perf_counter() - t0) * 1e3)
+        with lock:
+            lat.extend(mine)
+            errors[0] += errs
+
+    threads = [threading.Thread(target=loop) for _ in range(workers)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    arr = np.asarray(lat)
+    return {
+        "requests": len(arr),
+        "rps": round(len(arr) / wall, 1),
+        "p50_ms": round(float(np.percentile(arr, 50)), 2) if len(arr) else None,
+        "p99_ms": round(float(np.percentile(arr, 99)), 2) if len(arr) else None,
+        "errors": errors[0],
+        "workers": workers,
+        "seconds": seconds,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--payload-bytes", type=int, default=1024)
+    args = ap.parse_args()
+
+    kv = InMemoryKV(sweep_interval_s=0.05)
+    channels = PeerChannels()
+    peer_call = make_grpc_peer_call(channels, timeout_s=15.0)
+    pods = []
+    try:
+        for k in range(2):
+            pods.append(start_pod(kv, peer_call, f"i-{k}"))
+        for inst, _, _ in pods:
+            inst.instances_view.wait_for(lambda v: len(v) >= 2, timeout=10)
+        info = ModelInfo(model_type="example", model_path="mem://bench")
+        # m-local loaded on pod 0 (the pod we will hit), m-remote on pod 1.
+        pods[0][0].register_model("m-local", info)
+        pods[0][0].ensure_loaded("m-local", sync=True)
+        pods[1][0].register_model("m-remote", info)
+        pods[1][0].ensure_loaded("m-remote", sync=True)
+
+        import grpc
+
+        ch = grpc.insecure_channel(f"127.0.0.1:{pods[0][1].port}")
+        api = grpc_defs.make_stub(
+            ch, grpc_defs.API_SERVICE, grpc_defs.API_METHODS
+        )
+        predict = grpc_defs.raw_method(ch, PREDICT_METHOD)
+        payload = os.urandom(args.payload_bytes)
+
+        scenarios = {
+            "hit-local": lambda: predict(
+                payload, metadata=[("mm-model-id", "m-local")]
+            ),
+            "hit-remote": lambda: predict(
+                payload, metadata=[("mm-model-id", "m-remote")]
+            ),
+            "mgmt-status": lambda: api.GetModelStatus(
+                apb.GetModelStatusRequest(model_id="m-local")
+            ),
+        }
+        for name, fn in scenarios.items():
+            fn()  # warm (connection setup, first-route caches)
+            out = run_workers(fn, args.seconds, args.workers)
+            out["scenario"] = name
+            out["payload_bytes"] = args.payload_bytes
+            print(json.dumps(out), flush=True)
+    finally:
+        for inst, server, rt in pods:
+            server.stop(0.2)
+            inst.shutdown()
+            rt.stop(0)
+        kv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,8 @@ import os
 import signal
 import threading
 
+from modelmesh_tpu.utils import envs
+
 log = logging.getLogger("modelmesh_tpu.main")
 
 
@@ -81,7 +83,9 @@ def main(argv=None) -> None:
 
     honor_platform_env()
     parser = argparse.ArgumentParser()
-    parser.add_argument("--kv", default="memory://")
+    parser.add_argument(
+        "--kv", default=envs.get("MM_KV_URI") or "memory://"
+    )
     parser.add_argument("--instance-id", default=None)
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--advertise-host", default="127.0.0.1")
@@ -105,7 +109,7 @@ def main(argv=None) -> None:
     )
     args = parser.parse_args(argv)
     logging.basicConfig(
-        level=os.environ.get("MM_LOG_LEVEL", "INFO"),
+        level=envs.get("MM_LOG_LEVEL"),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s %(reqctx)s",
     )
     from modelmesh_tpu.observability.logctx import install_filter
@@ -143,13 +147,14 @@ def main(argv=None) -> None:
         PrometheusMetrics(
             port=max(args.metrics_port, 0),
             instance_id=args.instance_id or "",
+            per_model=envs.get_bool("MM_PER_MODEL_METRICS"),
         )
         if args.metrics_port >= 0
         else NoopMetrics()
     )
     constraints = None
     watcher = None
-    constraints_path = os.environ.get("MM_TYPE_CONSTRAINTS", "")
+    constraints_path = envs.get("MM_TYPE_CONSTRAINTS") or ""
     if constraints_path:
         constraints = TypeConstraints()
         watcher = ConstraintsFileWatcher(constraints_path, constraints)
@@ -176,10 +181,8 @@ def main(argv=None) -> None:
         loader,
         InstanceConfig(
             instance_id=args.instance_id,
-            zone=os.environ.get("MM_ZONE", ""),
-            labels=[
-                s for s in os.environ.get("MM_LABELS", "").split(",") if s
-            ],
+            zone=envs.get("MM_ZONE") or "",
+            labels=envs.get_list("MM_LABELS"),
             load_timeout_s=args.load_timeout_s,
         ),
         strategy=strategy,

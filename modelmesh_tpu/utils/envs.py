@@ -74,6 +74,14 @@ REGISTRY: dict[str, EnvVar] = {
         EnvVar("MM_KV_READ_ONLY", "int", "0",
                "KV-migration read-only mode: block model add/remove, "
                "suppress reaper pruning", "serving/instance.py"),
+        EnvVar("MM_KV_URI", "str", "",
+               "coordination store URI; default for --kv (the k8s "
+               "manifests also substitute it into args directly)",
+               "serving/main.py"),
+        EnvVar("MM_PER_MODEL_METRICS", "int", "0",
+               "add a model_id label to per-request metrics "
+               "(cardinality opt-in, reference's per-model flag)",
+               "serving/main.py"),
     ]
 }
 
@@ -98,6 +106,18 @@ def get_float(name: str) -> float:
         return float(os.environ.get(name, spec.default))
     except ValueError:
         return float(spec.default)
+
+
+def get_bool(name: str) -> bool:
+    """Boolean knob: accepts 1/0, true/false, yes/no, on/off (any case).
+    Junk raises — a silently-disabled opt-in is the failure mode this
+    registry exists to prevent."""
+    raw = str(os.environ.get(name, REGISTRY[name].default)).strip().lower()
+    if raw in ("1", "true", "yes", "on"):
+        return True
+    if raw in ("0", "false", "no", "off", ""):
+        return False
+    raise ValueError(f"{name}={raw!r} is not a boolean")
 
 
 def get_list(name: str) -> list[str]:

@@ -1,0 +1,120 @@
+"""Registry/consumer consistency for the MM_* env knobs.
+
+The round-2 advisor caught MM_MAX_PLAN_BYTES registered and documented
+but never read — a silently-ignored operator knob. These tests make that
+class of drift structural: every registered knob must be consumed where
+its registry entry says (or somewhere), and every env read in the source
+must go through the registry.
+"""
+
+import re
+from pathlib import Path
+
+from modelmesh_tpu.utils import envs
+
+ROOT = Path(__file__).resolve().parent.parent
+SRC = ROOT / "modelmesh_tpu"
+
+
+def _source_files():
+    """Package sources plus the repo-root entrypoints and tools that
+    consume registered knobs (bench.py, __graft_entry__.py, tools/)."""
+    files = [p for p in SRC.rglob("*.py") if "_pb2" not in p.name]
+    files += list(ROOT.glob("*.py"))
+    files += list((ROOT / "tools").glob("*.py"))
+    return files
+
+
+def _all_source():
+    return {p: p.read_text() for p in _source_files()}
+
+
+class TestEnvRegistry:
+    def test_every_registered_knob_is_consumed(self):
+        sources = _all_source()
+        envs_file = SRC / "utils" / "envs.py"
+        unconsumed = []
+        for name in envs.REGISTRY:
+            hits = [
+                p for p, text in sources.items()
+                if p != envs_file and f'"{name}"' in text
+            ]
+            if not hits:
+                unconsumed.append(name)
+        assert not unconsumed, (
+            f"registered but never read (operator knobs silently ignored): "
+            f"{unconsumed}"
+        )
+
+    def test_declared_consumer_module_actually_reads_it(self):
+        sources = {str(p): t for p, t in _all_source().items()}
+        wrong = []
+        for name, var in envs.REGISTRY.items():
+            # consumer is like "serving/main.py"; allow any listed module
+            mods = re.split(r"[,+ ]+", var.consumer)
+            ok = False
+            for mod in mods:
+                mod = mod.strip()
+                if not mod.endswith(".py"):
+                    continue
+                for path, text in sources.items():
+                    if (
+                        path.endswith("modelmesh_tpu/" + mod)
+                        or path == str(ROOT / mod)
+                    ) and f'"{name}"' in text:
+                        ok = True
+            if not ok:
+                wrong.append((name, var.consumer))
+        assert not wrong, (
+            f"registry 'consumer' field does not match any actual reader: "
+            f"{wrong}"
+        )
+
+    def test_every_env_read_is_registered(self):
+        # Any envs.get_*("MM_...") or os.environ access of an MM_ name in
+        # the PACKAGE must name a registered knob. Repo-root tools may
+        # keep tool-local knobs (MM_PROFILE_CPU etc.) outside the serving
+        # registry by design.
+        pattern = re.compile(
+            r"""(?:envs\.get(?:_\w+)?|os\.environ(?:\.get)?|os\.getenv)\(\s*
+                ["'](MM_[A-Z0-9_]+)["']""",
+            re.VERBOSE,
+        )
+        unregistered = set()
+        for p, text in _all_source().items():
+            if SRC not in p.parents:
+                continue
+            for m in pattern.finditer(text):
+                if m.group(1) not in envs.REGISTRY:
+                    unregistered.add((str(p), m.group(1)))
+        assert not unregistered, (
+            f"env reads bypassing the registry: {sorted(unregistered)}"
+        )
+
+    def test_deploy_docs_only_name_registered_knobs(self):
+        # Operator-facing docs and manifests must not advertise knobs the
+        # code no longer has.
+        root = SRC.parent
+        unregistered = set()
+        for rel in ("docs", "deploy"):
+            d = root / rel
+            if not d.exists():
+                continue
+            for p in d.rglob("*"):
+                if p.suffix not in (".md", ".yaml", ".yml", ""):
+                    continue
+                if not p.is_file():
+                    continue
+                text = p.read_text(errors="ignore")
+                for m in re.finditer(r"\bMM_[A-Z0-9_]+\b", text):
+                    name = m.group(0)
+                    if name not in envs.REGISTRY and not name.startswith(
+                        ("MM_BENCH", "MM_PROFILE", "MM_DRYRUN",
+                         "MM_QUALITY")
+                    ):  # bench/tool-only knobs live outside the serving
+                        # registry by design
+                        unregistered.add((str(p.relative_to(root)), name))
+        assert not unregistered, (
+            f"docs/deploy reference unregistered knobs: "
+            f"{sorted(unregistered)}"
+        )

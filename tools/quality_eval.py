@@ -137,7 +137,7 @@ def greedy_epoch(st):
     )
 
 
-def jax_epoch(st, warm_g=None, seed=0):
+def jax_epoch(st, warm_g=None, seed=0, config=None):
     p = to_problem(st)
     # Always pass a materialized g0 (zeros when cold): switching init
     # between None and an array changes the jit signature and forces a
@@ -146,8 +146,10 @@ def jax_epoch(st, warm_g=None, seed=0):
         np.zeros(st["capacity"].shape, np.float32)
         if warm_g is None else warm_g
     )
+    kw = {} if config is None else {"config": config}
     sol = jax.block_until_ready(
-        ops.solve_placement(p, seed=seed, init=SolveInit(g0=jnp.asarray(g0)))
+        ops.solve_placement(p, seed=seed,
+                            init=SolveInit(g0=jnp.asarray(g0)), **kw)
     )
     idx = np.asarray(sol.indices)
     valid = np.asarray(sol.valid)

@@ -275,14 +275,43 @@ def _ensure_assemble_jit(mesh=None):
 _sharded_solvers: dict = {}
 
 
-def _solver_for(mesh):
-    """jitted sharded solver per mesh (rebuilding would recompile)."""
-    solver = _sharded_solvers.get(mesh)
+def _solver_for(mesh, config=None):
+    """jitted sharded solver per (mesh, config) (rebuilding would
+    recompile)."""
+    key = (mesh, config)
+    solver = _sharded_solvers.get(key)
     if solver is None:
         from modelmesh_tpu.parallel.sharded_solver import make_sharded_solver
 
-        solver = _sharded_solvers[mesh] = make_sharded_solver(mesh)
+        solver = _sharded_solvers[key] = make_sharded_solver(
+            mesh, *(() if config is None else (config,))
+        )
     return solver
+
+
+def solve_config_from_env():
+    """SolveConfig overridden by the MM_SOLVER_* operator knobs.
+
+    Returns the plain default config when nothing is set, so the jit
+    static-arg cache key stays the literal SolveConfig() default."""
+    from modelmesh_tpu.ops.solve import SolveConfig
+    from modelmesh_tpu.utils import envs
+
+    base = SolveConfig()
+    overrides = {}
+    for field, env, cast in (
+        ("sinkhorn_iters", "MM_SOLVER_SINKHORN_ITERS", int),
+        ("auction_iters", "MM_SOLVER_AUCTION_ITERS", int),
+        ("tau", "MM_SOLVER_TAU", float),
+        ("lse_impl", "MM_SOLVER_LSE_IMPL", str),
+        ("load_impl", "MM_SOLVER_LOAD_IMPL", str),
+        ("noise_impl", "MM_SOLVER_NOISE_IMPL", str),
+        ("final_select", "MM_SOLVER_FINAL_SELECT", str),
+    ):
+        raw = envs.get(env)
+        if raw not in (None, ""):
+            overrides[field] = cast(raw)
+    return base._replace(**overrides) if overrides else base
 
 
 def build_problem(
@@ -568,6 +597,7 @@ def solve_plan(
     constraints=None,
     mesh=None,
     warm_g: Optional[Mapping[str, float]] = None,
+    config=None,
 ) -> GlobalPlan:
     """One global solve -> GlobalPlan (blocking; runs on the JAX device).
 
@@ -580,6 +610,10 @@ def solve_plan(
     (parallel/sharded_solver.py) — the 1M x 10k ladder path. Bucket sizes
     are powers of two or 3·2^k, so any power-of-two mesh axis ≤ the pad
     floors (256 rows, 64 cols) divides them evenly.
+
+    ``config``: a SolveConfig overriding the solver defaults (None keeps
+    the compiled-default cache entry). The strategy builds one from the
+    MM_SOLVER_* env knobs (solve_config_from_env).
 
     ``warm_g``: per-instance-id column potentials from the previous solve
     (``plan.warm_g``) — warm-starts Sinkhorn (SURVEY.md section 7 hard
@@ -623,14 +657,15 @@ def solve_plan(
             )
         problem = _expand_problem_device(cols, pad=True, mesh=mesh)
         sol = jax.block_until_ready(
-            _solver_for(mesh)(problem, seed=seed, g0=g0)
+            _solver_for(mesh, config)(problem, seed=seed, g0=g0)
         )
     else:
         from modelmesh_tpu.ops.solve import SolveInit
 
         problem = _expand_problem_device(cols, pad=True)
+        kw = {} if config is None else {"config": config}
         sol = jax.block_until_ready(
-            solve_placement(problem, seed=seed, init=SolveInit(g0=g0))
+            solve_placement(problem, seed=seed, init=SolveInit(g0=g0), **kw)
         )
     t2 = time.perf_counter()
     # Compact readback: u16 indices + per-row valid counts instead of the
@@ -718,6 +753,7 @@ class JaxPlacementStrategy(PlacementStrategy):
         fallback: Optional[PlacementStrategy] = None,
         constraints=None,
         mesh=None,
+        solve_config="env",
     ):
         self.plan_ttl_ms = plan_ttl_ms
         self.fallback = fallback or GreedyStrategy()
@@ -742,6 +778,14 @@ class JaxPlacementStrategy(PlacementStrategy):
             usable = 1 << (len(devs).bit_length() - 1)
             mesh = make_mesh(devices=devs[:usable]) if usable > 1 else None
         self.mesh = mesh
+        # "env" -> MM_SOLVER_* knobs (solve_config_from_env); None -> the
+        # compiled defaults; or an explicit SolveConfig.
+        if solve_config == "env":
+            cfg = solve_config_from_env()
+            from modelmesh_tpu.ops.solve import SolveConfig
+
+            solve_config = None if cfg == SolveConfig() else cfg
+        self.solve_config = solve_config
         self._plan: Optional[GlobalPlan] = None
         self._seed = 0
         self._refresh_lock = threading.Lock()
@@ -763,7 +807,7 @@ class JaxPlacementStrategy(PlacementStrategy):
             plan = solve_plan(
                 models, instances, rpm_fn, seed=self._seed,
                 constraints=self.constraints, mesh=self.mesh,
-                warm_g=self._warm_g,
+                warm_g=self._warm_g, config=self.solve_config,
             )
             if plan.warm_g is not None:
                 # Keep the carry across empty-snapshot blips (registry

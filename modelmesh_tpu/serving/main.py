@@ -193,9 +193,7 @@ def main(argv=None) -> None:
         probation=BootstrapProbation.from_env(),
     )
     vmodels = VModelManager(instance)
-    payload_proc = build_processor(
-        [u for u in os.environ.get("MM_PAYLOAD_PROCESSORS", "").split(",") if u]
-    )
+    payload_proc = build_processor(envs.get_list("MM_PAYLOAD_PROCESSORS"))
     server = MeshServer(
         instance,
         port=args.port,

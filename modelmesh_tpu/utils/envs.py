@@ -17,7 +17,7 @@ from typing import Optional
 @dataclasses.dataclass(frozen=True)
 class EnvVar:
     name: str
-    kind: str          # str | int | float | json | path | list
+    kind: str          # str | int | float | bool | json | path | list
     default: str
     help: str
     consumer: str      # module that reads it
@@ -78,10 +78,35 @@ REGISTRY: dict[str, EnvVar] = {
                "coordination store URI; default for --kv (the k8s "
                "manifests also substitute it into args directly)",
                "serving/main.py"),
-        EnvVar("MM_PER_MODEL_METRICS", "int", "0",
+        EnvVar("MM_PER_MODEL_METRICS", "bool", "0",
                "add a model_id label to per-request metrics "
-               "(cardinality opt-in, reference's per-model flag)",
+               "(accepts 1/0, true/false, yes/no, on/off; cardinality "
+               "opt-in, reference's per-model flag)",
                "serving/main.py"),
+        # MM_SOLVER_*: operator overrides of the placement solver's
+        # SolveConfig (empty = compiled default). Read ONCE at strategy
+        # construction (process start) — not live-reloaded.
+        EnvVar("MM_SOLVER_SINKHORN_ITERS", "int", "",
+               "Sinkhorn iterations per solve (default 10)",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_AUCTION_ITERS", "int", "",
+               "auction price-repair iterations (default 40)",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_TAU", "float", "",
+               "Gumbel sampling temperature; 0 = deterministic argmax",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_LSE_IMPL", "str", "",
+               "Sinkhorn LSE backend: auto | pallas | xla",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_LOAD_IMPL", "str", "",
+               "auction implied-load histogram: auto | scatter | fused",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_NOISE_IMPL", "str", "",
+               "rounding noise generator: hash | threefry",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_FINAL_SELECT", "str", "",
+               "auction epilogue selection: exact | approx | none",
+               "placement/jax_engine.py"),
     ]
 }
 
@@ -94,6 +119,8 @@ def get(name: str) -> Optional[str]:
 
 def get_int(name: str) -> int:
     spec = REGISTRY[name]
+    if not spec.default and not os.environ.get(name):
+        raise ValueError(f"{name} is unset and has no default")
     try:
         return int(os.environ.get(name, spec.default))
     except ValueError:
@@ -102,6 +129,8 @@ def get_int(name: str) -> int:
 
 def get_float(name: str) -> float:
     spec = REGISTRY[name]
+    if not spec.default and not os.environ.get(name):
+        raise ValueError(f"{name} is unset and has no default")
     try:
         return float(os.environ.get(name, spec.default))
     except ValueError:

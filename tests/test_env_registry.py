@@ -77,7 +77,8 @@ class TestEnvRegistry:
         # registry by design.
         pattern = re.compile(
             r"""(?:envs\.get(?:_\w+)?|os\.environ(?:\.get)?|os\.getenv)\(\s*
-                ["'](MM_[A-Z0-9_]+)["']""",
+                ["'](MM_[A-Z0-9_]+)["']
+              | os\.environ\[\s*["'](MM_[A-Z0-9_]+)["']\s*\]""",
             re.VERBOSE,
         )
         unregistered = set()
@@ -85,8 +86,9 @@ class TestEnvRegistry:
             if SRC not in p.parents:
                 continue
             for m in pattern.finditer(text):
-                if m.group(1) not in envs.REGISTRY:
-                    unregistered.add((str(p), m.group(1)))
+                name = m.group(1) or m.group(2)
+                if name not in envs.REGISTRY:
+                    unregistered.add((str(p), name))
         assert not unregistered, (
             f"env reads bypassing the registry: {sorted(unregistered)}"
         )

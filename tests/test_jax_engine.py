@@ -270,3 +270,49 @@ class TestClusterWithJaxStrategy:
             assert out.payload.startswith(b"mj-new:")
         finally:
             c.close()
+
+
+class TestSolverEnvKnobs:
+    """MM_SOLVER_* operator knobs reach the actual solve (they were
+    previously only plumbed through tests/tools, never production)."""
+
+    def test_env_overrides_build_config(self, monkeypatch):
+        from modelmesh_tpu.ops.solve import SolveConfig
+        from modelmesh_tpu.placement.jax_engine import solve_config_from_env
+
+        assert solve_config_from_env() == SolveConfig()
+        monkeypatch.setenv("MM_SOLVER_SINKHORN_ITERS", "6")
+        monkeypatch.setenv("MM_SOLVER_NOISE_IMPL", "threefry")
+        monkeypatch.setenv("MM_SOLVER_FINAL_SELECT", "approx")
+        cfg = solve_config_from_env()
+        assert cfg.sinkhorn_iters == 6
+        assert cfg.noise_impl == "threefry"
+        assert cfg.final_select == "approx"
+        # untouched fields keep their defaults
+        assert cfg.auction_iters == SolveConfig().auction_iters
+
+    def test_strategy_picks_up_env_and_solves(self, monkeypatch):
+        from modelmesh_tpu.placement.jax_engine import JaxPlacementStrategy
+
+        monkeypatch.setenv("MM_SOLVER_SINKHORN_ITERS", "4")
+        monkeypatch.setenv("MM_SOLVER_AUCTION_ITERS", "8")
+        strat = JaxPlacementStrategy()
+        assert strat.solve_config is not None
+        assert strat.solve_config.sinkhorn_iters == 4
+        plan = strat.refresh(_models(32), _instances(4))
+        assert plan.num_models() == 32
+
+    def test_strategy_default_config_is_none(self):
+        from modelmesh_tpu.placement.jax_engine import JaxPlacementStrategy
+
+        # No env set -> None -> solve_plan hits the compiled-default jit
+        # cache entry (no gratuitous recompile from an equal-but-distinct
+        # SolveConfig instance).
+        assert JaxPlacementStrategy().solve_config is None
+
+    def test_bad_env_value_fails_loudly(self, monkeypatch):
+        from modelmesh_tpu.placement.jax_engine import solve_config_from_env
+
+        monkeypatch.setenv("MM_SOLVER_SINKHORN_ITERS", "lots")
+        with pytest.raises(ValueError):
+            solve_config_from_env()

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from modelmesh_tpu.placement.jax_engine import (
+    GlobalPlan,
     JaxPlacementStrategy,
     build_problem,
     solve_plan,
@@ -316,3 +317,64 @@ class TestSolverEnvKnobs:
         monkeypatch.setenv("MM_SOLVER_SINKHORN_ITERS", "lots")
         with pytest.raises(ValueError):
             solve_config_from_env()
+
+
+class TestPlanWireFuzz:
+    """Randomized round-trips of the columnar v2 plan wire format —
+    framing bugs corrupt every model after the first bad row, so fuzz the
+    id shapes, counts, and dtype boundaries."""
+
+    def test_random_roundtrips(self):
+        import zlib
+
+        rng = np.random.default_rng(5)
+        for case in range(30):
+            n = int(rng.integers(0, 50))
+            n_inst = int(rng.integers(1, 30))
+            inst_ids = [f"pod-{j}-{'x' * int(rng.integers(0, 8))}"
+                        for j in range(n_inst)]
+            counts = rng.integers(0, 9, n).astype(np.uint8)
+            flat = rng.integers(0, n_inst, int(counts.sum()))
+            model_ids = [
+                f"m{case}-{i}-{'уникод' if i % 7 == 0 else 'a' * int(rng.integers(0, 20))}"
+                for i in range(n)
+            ]
+            plan = GlobalPlan.from_columnar(
+                model_ids, counts, flat, inst_ids,
+                solved_at_ms=123456, solve_ms=1.5, generation=case,
+            )
+            data = plan.to_bytes()
+            back = GlobalPlan.from_bytes(data)
+            assert back.generation == case
+            assert back.num_models() == n
+            for i, mid in enumerate(model_ids):
+                assert back.lookup(mid) == plan.lookup(mid), (case, mid)
+            # wire payload is real zlib, decodable independently
+            zlib.decompress(data)
+
+    def test_wide_index_u32_roundtrip(self):
+        # >= 65536 instances flips the flat-index dtype to u32; the
+        # header's width field must round-trip it (no silent u16 wrap).
+        n_inst = 70_000
+        inst_ids = [f"i{j}" for j in range(n_inst)]
+        model_ids = ["m-hi", "m-lo"]
+        counts = np.asarray([2, 1], np.uint8)
+        flat = np.asarray([69_999, 65_536, 3], np.int64)
+        plan = GlobalPlan.from_columnar(
+            model_ids, counts, flat, inst_ids, 1, 1.0
+        )
+        back = GlobalPlan.from_bytes(plan.to_bytes())
+        assert back.lookup("m-hi") == ["i69999", "i65536"]
+        assert back.lookup("m-lo") == ["i3"]
+
+    def test_newline_id_via_columnar_falls_back_without_corruption(self):
+        # A delimiter-bearing id arriving through the COLUMNAR path must
+        # fall through the v2 fast path to the JSON encoding (the
+        # dict-construction variant is covered in test_plan_sync).
+        plan = GlobalPlan.from_columnar(
+            ["bad\nid", "ok"], np.asarray([1, 1], np.uint8),
+            np.asarray([0, 1]), ["i0", "i1"], 5, 1.0, 2,
+        )
+        back = GlobalPlan.from_bytes(plan.to_bytes())
+        assert back.lookup("bad\nid") == ["i0"]
+        assert back.lookup("ok") == ["i1"]

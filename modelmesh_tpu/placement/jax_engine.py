@@ -740,8 +740,9 @@ class JaxPlacementStrategy(PlacementStrategy):
     """Plan-serving strategy with greedy fallback.
 
     ``refresher`` mode: call ``refresh(models, instances, rpm_fn)``
-    periodically (the reaper/janitor cadence, or a dedicated thread via
-    ``start_auto_refresh``). Decisions read the latest plan lock-free.
+    periodically — in production the leader reaper does this and
+    publishes the result fleet-wide (serving/tasks.py); followers adopt
+    via PlanFollower. Decisions read the latest plan lock-free.
     """
 
     def __init__(

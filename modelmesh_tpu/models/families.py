@@ -140,6 +140,21 @@ def build_transformer(spec: ModelSpec, model_id: str) -> ServableModel:
     head_dim = d // n_heads
     key = jax.random.PRNGKey(_seed_from(spec, model_id))
 
+    # sp=1: sequence-parallel attention (parallel/ring_attention.py) when
+    # multiple devices are visible — the long-context serving path. The
+    # parameters are identical either way (sp changes the schedule, not
+    # the function), so single- and multi-chip hosts serve the same model.
+    ring = None
+    if spec.params.get("sp", 0):
+        n_dev = len(jax.devices())
+        if n_dev > 1 and seq % n_dev == 0:
+            from modelmesh_tpu.parallel.ring_attention import (
+                make_ring_attention,
+                make_seq_mesh,
+            )
+
+            ring = make_ring_attention(make_seq_mesh(), seq, causal=True)
+
     def dense(key, a, b):
         return jax.random.normal(key, (a, b), jnp.bfloat16) / np.sqrt(a)
 
@@ -179,11 +194,15 @@ def build_transformer(spec: ModelSpec, model_id: str) -> ServableModel:
             def heads(z):
                 return z.reshape(b, t, n_heads, head_dim).transpose(0, 2, 1, 3)
             q, kk, v = heads(q), heads(kk), heads(v)
-            att = (q.astype(jnp.float32) @ kk.astype(jnp.float32).transpose(0, 1, 3, 2)
-                   ) / np.sqrt(head_dim)
-            att = jnp.where(mask[None, None], att, -1e30)
-            att = jax.nn.softmax(att, axis=-1).astype(jnp.bfloat16)
-            z = (att @ v).transpose(0, 2, 1, 3).reshape(b, t, d)
+            if ring is not None and t == seq:
+                z = ring(q, kk, v)  # [b, h, t, hd], causal, f32 softmax
+            else:
+                att = (q.astype(jnp.float32) @ kk.astype(jnp.float32).transpose(0, 1, 3, 2)
+                       ) / np.sqrt(head_dim)
+                att = jnp.where(mask[None, None], att, -1e30)
+                att = jax.nn.softmax(att, axis=-1).astype(jnp.bfloat16)
+                z = att @ v
+            z = z.transpose(0, 2, 1, 3).reshape(b, t, d)
             h = h + z @ blk["proj"]
             x = layer_norm(h, blk["ln2"])
             h = h + jax.nn.gelu(x @ blk["up"]) @ blk["down"]

@@ -142,8 +142,9 @@ def build_transformer(spec: ModelSpec, model_id: str) -> ServableModel:
 
     # sp=1: sequence-parallel attention (parallel/ring_attention.py) when
     # multiple devices are visible — the long-context serving path. The
-    # parameters are identical either way (sp changes the schedule, not
-    # the function), so single- and multi-chip hosts serve the same model.
+    # parameters are identical either way; sp changes the schedule, so
+    # outputs agree at bf16 level (block-wise softmax reassociation), not
+    # bit-for-bit.
     ring = None
     if spec.params.get("sp", 0):
         n_dev = len(jax.devices())

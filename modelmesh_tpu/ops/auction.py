@@ -231,17 +231,24 @@ def hash_gumbel(
         row_offset, jnp.uint32
     )
     cols = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
-    x = (
-        rows * jnp.uint32(0x9E3779B9)
-        + cols * jnp.uint32(0x85EBCA6B)
-        + jnp.asarray(seed, jnp.uint32) * jnp.uint32(0xC2B2AE35)
-    )
-    # murmur3 fmix32: full avalanche, pure VPU integer ops.
-    x ^= x >> 16
-    x *= jnp.uint32(0x85EBCA6B)
-    x ^= x >> 13
-    x *= jnp.uint32(0xC2B2AE35)
-    x ^= x >> 16
+
+    def fmix32(v):
+        # murmur3 finalizer: full avalanche, pure VPU integer ops.
+        v ^= v >> 16
+        v *= jnp.uint32(0x85EBCA6B)
+        v ^= v >> 13
+        v *= jnp.uint32(0xC2B2AE35)
+        v ^= v >> 16
+        return v
+
+    # Mix rows before cols touch the counter: a single linear combination
+    # rows*c1 + cols*c2 + seed*c3 repeats along any lattice direction with
+    # dr*c1 + dc*c2 == 0 (mod 2^32), putting identical noise on whole
+    # diagonals at large tiers. The intermediate fmix32 breaks additivity,
+    # and the value still depends only on (global row, col, seed) so the
+    # sharded-equals-single-device property is preserved.
+    x = fmix32(rows ^ (jnp.asarray(seed, jnp.uint32) * jnp.uint32(0xC2B2AE35)))
+    x = fmix32(x ^ (cols * jnp.uint32(0x85EBCA6B)))
     # Top 24 bits -> uniform in [eps, 1) (0 would blow up the outer log).
     u = (x >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
     u = jnp.maximum(u, 1e-7)

@@ -49,8 +49,11 @@ def _block_stats(q, k, v, mask):
     m_safe = jnp.maximum(m, _NEG_INF / 2)
     p = jnp.exp(s - m_safe[..., None])
     l = jnp.sum(p, axis=-1)                       # [B, H, Sq]
+    # P@V in the value dtype (bf16 for the model families — same as the
+    # dense attention path, and the MXU-native mode), accumulated in f32.
+    # f32 callers are unchanged.
     o = jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
     )
     return m_safe, l, o

@@ -206,10 +206,12 @@ class TestChunkedResync:
                 backing.put("big/churn", str(i).encode())
             server2, _, _ = _rebind(start_kv_server, store=backing, port=port)
             try:
+                # Generous timeout: reconnect backoff caps at 5 s and this
+                # test shares the machine with heavy jit jobs in full runs.
                 assert _wait(
                     lambda: {f"big/{i}" for i in range(6)}
                     <= {e.kv.key for e in got if e.type is EventType.PUT},
-                    timeout=20,
+                    timeout=45,
                 ), "chunked resync did not deliver all large values"
                 client.put("big/after", b"x")
                 assert _wait(

@@ -68,12 +68,12 @@ class TestFamilies:
         )
         rng = np.random.default_rng(0)
         tokens = rng.integers(0, 255, (2, 128)).astype(np.int32)
-        a = np.asarray(dense.apply_fn(dense.params, tokens))
-        b = np.asarray(ring.apply_fn(ring.params, tokens))
+        a = np.asarray(dense.apply(dense.params, tokens))
+        b = np.asarray(ring.apply(ring.params, tokens))
         np.testing.assert_allclose(a, b, atol=0.08, rtol=0.08)
         # and the ring variant is genuinely input-sensitive end to end
         tokens2 = tokens.copy(); tokens2[:, -1] ^= 1
-        b2 = np.asarray(ring.apply_fn(ring.params, tokens2))
+        b2 = np.asarray(ring.apply(ring.params, tokens2))
         assert np.abs(b - b2).max() > 1e-3
 
 

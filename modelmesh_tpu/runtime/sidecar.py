@@ -176,7 +176,13 @@ class SidecarRuntime(ModelLoader[str]):
 
     def _try_unload(self, model_id: str, attempt: int) -> None:
         try:
-            self._stub.UnloadModel(rpb.UnloadModelRequest(model_id=model_id))
+            # Deadline-bounded: a hung runtime must not wedge the caller —
+            # unloads run on the instance's small shared pool, where one
+            # unbounded RPC would block every queued unload's capacity
+            # accounting. DEADLINE_EXCEEDED lands in the retry queue below.
+            self._stub.UnloadModel(
+                rpb.UnloadModelRequest(model_id=model_id), timeout=30.0
+            )
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.NOT_FOUND:
                 return  # already gone

@@ -69,6 +69,7 @@ from modelmesh_tpu.serving.errors import (
 )
 from modelmesh_tpu.observability.metrics import Metric as MX
 from modelmesh_tpu.serving.rate import RateTracker
+from modelmesh_tpu.utils.pool import BoundedDaemonPool
 
 log = logging.getLogger(__name__)
 
@@ -268,6 +269,19 @@ class ModelMeshInstance:
         )
         self.unload_tracker = UnloadTracker(params.capacity_units)
         self.loading_pool = PrioritizedLoadingPool(params.load_concurrency)
+        # Bounded pools for janitorial work: a mass unregistration of a
+        # full cache must queue behind a few workers, not spawn a thread
+        # per model (reference ModelMesh.java:2807-2814 uses a shared
+        # pool). Two pools, not one — unload tasks release
+        # unload_tracker reservations that wait_for_space depends on, so
+        # they must never queue behind KV-heavy deletion cleanups during
+        # an outage (KV RPCs are 10 s-deadline-bounded, runtime UnloadModel
+        # 30 s-bounded, and CAS loops give up, so tasks cannot wedge
+        # forever — but head-of-line delay on accounting would still fail
+        # unrelated loads). Daemon workers: a task stuck on a dying KV
+        # must not block interpreter exit.
+        self._cleanup_pool = BoundedDaemonPool(max_workers=4, name="del-clean")
+        self._unload_pool = BoundedDaemonPool(max_workers=4, name="unloads")
         self.rate = RateTracker()
         self._model_rates: dict[str, RateTracker] = {}
         self._model_rates_lock = threading.Lock()
@@ -324,7 +338,16 @@ class ModelMeshInstance:
     # ------------------------------------------------------------------ #
 
     def cluster_view(self) -> ClusterView:
-        return ClusterView(instances=self.instances_view.items())
+        items = self.instances_view.items()
+        if not any(iid == self.instance_id for iid, _ in items):
+            # A node always knows itself: right after startup our own
+            # published record may not have round-tripped through the async
+            # KV watch yet, and an empty view would make placement reject
+            # the first request (NoCapacityError) instead of loading here.
+            items = list(items) + [
+                (self.instance_id, self._build_instance_record())
+            ]
+        return ClusterView(instances=items)
 
     # KV outage fail-fast: after a registry read error, requests for THAT
     # model fail immediately (UNAVAILABLE) for a cooldown window instead of
@@ -1152,27 +1175,22 @@ class ModelMeshInstance:
                         self.unload_tracker.unload_finished(units)
                         self.publish_instance_record()
 
-        threading.Thread(
-            target=post_evict, name=f"evict-{model_id}", daemon=True
-        ).start()
+        self._submit_unload(post_evict)
 
     def _on_registry_event(self, event, model_id: str, record) -> None:
         """Registry watch listener: prompt local-copy cleanup on deletion.
 
         Runs on the KV watch dispatcher thread, which must never block on
         KV round-trips — the actual cleanup (CAS deregister + runtime
-        unload) moves to a short-lived thread, mirroring _async_unload.
+        unload) is queued onto the bounded cleanup pool.
         """
         if event is not TableEvent.DELETED:
             return
         if self.cache.get_quietly(model_id) is None:
             return
-        threading.Thread(
-            target=self._cleanup_deleted_model,
-            args=(model_id,),
-            name=f"del-cleanup-{model_id}",
-            daemon=True,
-        ).start()
+        self._cleanup_pool.submit(self._cleanup_deleted_model, model_id)
+        # False return (pool shut down) means the instance is stopping —
+        # nothing left worth cleaning.
 
     def _cleanup_deleted_model(self, model_id: str) -> None:
         # Re-registration may race the delete event: authoritative re-read —
@@ -1228,9 +1246,16 @@ class ModelMeshInstance:
                 self.metrics.inc(MX.UNLOAD_COUNT, model_id=model_id)
                 self.publish_instance_record()
 
-        threading.Thread(
-            target=do_unload, name=f"unload-{model_id}", daemon=True
-        ).start()
+        self._submit_unload(do_unload)
+
+    def _submit_unload(self, fn) -> None:
+        """Run ``fn`` on the unload pool; after shutdown, fall back to a
+        one-off daemon thread so accounting started by the caller (the
+        unload_tracker reservation) still completes during shutdown
+        migration. (Deletion cleanup deliberately has no such fallback —
+        after shutdown there is nothing left worth cleaning.)"""
+        if not self._unload_pool.submit(fn):
+            threading.Thread(target=fn, daemon=True).start()
 
     def _deregister(self, model_id: str, record_unload_time: bool = False) -> None:
         def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
@@ -1317,6 +1342,8 @@ class ModelMeshInstance:
 
     def shutdown(self) -> None:
         self.loading_pool.shutdown()
+        self._cleanup_pool.shutdown()
+        self._unload_pool.shutdown()
         if self._plan_follower is not None:
             self._plan_follower.close()
         self._election.close()

@@ -87,6 +87,86 @@ class TestLifecycle:
         assert out2.payload.startswith(b"m-demand:")
         assert servicer.load_count == loads
 
+    def test_mass_deletion_cleanup_is_bounded(self):
+        """Wiping many registered+cached models must drain through the small
+        shared cleanup pool — not spawn one thread per deleted model
+        (reference runs these on a shared pool, ModelMesh.java:2807-2814).
+        Dedicated instance with capacity for ALL models: eviction during
+        setup would shrink the wipe set nondeterministically."""
+        import threading
+
+        store = InMemoryKV(sweep_interval_s=0.05)
+        server, port, _ = start_fake_runtime(
+            servicer=FakeRuntimeServicer(capacity_bytes=1 << 30)
+        )
+        loader = SidecarRuntime(f"127.0.0.1:{port}", startup_timeout_s=10)
+        inst = ModelMeshInstance(
+            store, loader,
+            InstanceConfig(instance_id="i-wipe", load_timeout_s=10,
+                           min_churn_age_ms=0),
+        )
+        try:
+            self._run_mass_wipe(inst)
+        finally:
+            inst.shutdown()
+            server.stop(0)
+            store.close()
+
+    def _run_mass_wipe(self, inst):
+        import threading
+
+        n = 16
+        for i in range(n):
+            inst.register_model(f"m-wipe-{i}", INFO, load_now=True, sync=True)
+        cached = [
+            f"m-wipe-{i}" for i in range(n)
+            if inst.cache.get_quietly(f"m-wipe-{i}") is not None
+        ]
+        assert len(cached) == n, f"setup evicted: only {len(cached)} cached"
+
+        ran, lock = [], threading.Lock()
+        gauge = {"cur": 0, "peak": 0}
+        real = inst._cleanup_deleted_model
+
+        def instrumented(model_id):
+            with lock:
+                gauge["cur"] += 1
+                gauge["peak"] = max(gauge["peak"], gauge["cur"])
+            time.sleep(0.05)  # hold the slot so overlap is observable
+            try:
+                real(model_id)
+            finally:
+                with lock:
+                    gauge["cur"] -= 1
+                    ran.append(model_id)
+
+        inst._cleanup_deleted_model = instrumented
+        for i in range(n):
+            inst.registry.delete(f"m-wipe-{i}")  # remote-style wipe
+        deadline = time.monotonic() + 20
+        peak_threads = 0
+        while len(ran) < len(cached) and time.monotonic() < deadline:
+            per_model = sum(
+                t.name.startswith(("del-cleanup", "unload-", "evict-"))
+                and t.name != "unload-retry"  # sidecar's one fixed thread
+                for t in threading.enumerate()
+            )
+            peak_threads = max(peak_threads, per_model)
+            time.sleep(0.02)
+        # The per-model thread names must be gone entirely — cleanup AND
+        # the nested async unloads ride the shared janitorial pool now.
+        assert peak_threads == 0, (
+            f"{peak_threads} per-model janitorial threads observed"
+        )
+        assert sorted(ran) == sorted(cached), (
+            f"only {len(ran)}/{len(cached)} cleanups ran"
+        )
+        assert gauge["peak"] <= 4, (
+            f"{gauge['peak']} concurrent cleanups — thread-per-delete is back"
+        )
+        for mid in cached:
+            assert inst.cache.get_quietly(mid) is None
+
     def test_unregister_removes_copy(self, mesh):
         inst, servicer, _ = mesh
         inst.register_model("m-gone", INFO, load_now=True, sync=True)

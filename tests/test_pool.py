@@ -1,0 +1,71 @@
+"""BoundedDaemonPool: concurrency cap, daemon-ness, shutdown semantics."""
+
+import threading
+import time
+
+from modelmesh_tpu.utils.pool import BoundedDaemonPool
+
+
+def test_concurrency_capped_and_all_tasks_run():
+    pool = BoundedDaemonPool(max_workers=3, name="t")
+    lock = threading.Lock()
+    gauge = {"cur": 0, "peak": 0}
+    done = []
+
+    def task(i):
+        with lock:
+            gauge["cur"] += 1
+            gauge["peak"] = max(gauge["peak"], gauge["cur"])
+        time.sleep(0.03)
+        with lock:
+            gauge["cur"] -= 1
+            done.append(i)
+
+    for i in range(20):
+        assert pool.submit(task, i)
+    deadline = time.monotonic() + 10
+    while len(done) < 20 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert sorted(done) == list(range(20))
+    assert gauge["peak"] <= 3
+    assert pool.active_workers <= 3
+
+
+def test_workers_are_daemon_and_lazy():
+    pool = BoundedDaemonPool(max_workers=4, name="lazy")
+    assert pool.active_workers == 0  # no threads until first submit
+    evt = threading.Event()
+    pool.submit(evt.wait)
+    time.sleep(0.05)
+    workers = [t for t in threading.enumerate() if t.name.startswith("lazy-")]
+    assert workers and all(t.daemon for t in workers)
+    assert pool.active_workers == 1  # one task -> one worker, not the cap
+    evt.set()
+
+
+def test_shutdown_rejects_new_work_and_drains_idle_workers():
+    pool = BoundedDaemonPool(max_workers=2, name="sd")
+    ran = []
+    pool.submit(ran.append, 1)
+    deadline = time.monotonic() + 5
+    while not ran and time.monotonic() < deadline:
+        time.sleep(0.01)
+    pool.shutdown()
+    assert not pool.submit(ran.append, 2)
+    deadline = time.monotonic() + 5
+    while pool.active_workers and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert pool.active_workers == 0
+    assert ran == [1]
+
+
+def test_task_exception_does_not_kill_worker():
+    pool = BoundedDaemonPool(max_workers=1, name="exc")
+    done = threading.Event()
+
+    def boom():
+        raise RuntimeError("janitorial task failure")
+
+    pool.submit(boom)
+    pool.submit(done.set)
+    assert done.wait(5), "worker died after task exception"

@@ -302,6 +302,15 @@ class EtcdKV(KVStore):
                 key=p,
                 range_end=_prefix_range_end(p),
                 start_revision=state["next_rev"],
+                # Fragmentation opt-in: a registry-scale event batch (mass
+                # txn / lease-revoke sweep) can exceed the gRPC message cap;
+                # fragments are reassembled below before delivery so resume
+                # fencing still sees whole revisions.
+                fragment=True,
+                # Progress ticks advance next_rev while idle, so a long-idle
+                # watch resubscribes near the head instead of tripping the
+                # compaction floor and forcing a full re-list.
+                progress_notify=True,
             )
             req_q: "queue.Queue" = queue.Queue()
             req_q.put(
@@ -327,6 +336,9 @@ class EtcdKV(KVStore):
             backoff = 0.1
             while not handle.cancelled.is_set():
                 req_q = None
+                # Partial fragmented batch per stream: reset on reopen —
+                # next_rev was not advanced for it, so it replays whole.
+                frag_buf: list = []
                 try:
                     call, req_q = open_stream()
                     for resp_bytes in call:
@@ -336,6 +348,23 @@ class EtcdKV(KVStore):
                         if resp.created:
                             created.set()
                             backoff = 0.1
+                        if resp.fragment:
+                            frag_buf.extend(resp.events)
+                            continue
+                        if (
+                            not resp.events
+                            and not resp.created
+                            and not resp.canceled
+                            and not frag_buf
+                        ):
+                            # Progress notification: everything up to
+                            # header.revision has been delivered to this
+                            # watch. (Skipped mid-fragment-batch: the
+                            # batch's revision is not fully delivered yet.)
+                            state["next_rev"] = max(
+                                state["next_rev"], resp.header.revision + 1
+                            )
+                            continue
                         if resp.canceled:
                             # etcd cancels a watch whose start_revision was
                             # compacted (compact_revision > 0) — without
@@ -357,6 +386,9 @@ class EtcdKV(KVStore):
                                     prefix, state["next_rev"],
                                 )
                             break  # reopen the stream at next_rev
+                        # Reassembled batch: buffered fragments + final resp.
+                        batch = frag_buf + list(resp.events)
+                        frag_buf = []
                         events = [
                             WatchEvent(
                                 type=(
@@ -366,7 +398,7 @@ class EtcdKV(KVStore):
                                 ),
                                 kv=_to_kv(ev.kv),
                             )
-                            for ev in resp.events
+                            for ev in batch
                         ]
                         if events:
                             for ev in events:

@@ -22,10 +22,14 @@ Two roles:
       python -m modelmesh_tpu.kv.etcd_server --port 2379
 
 Request options supported: prev_kv on Put/DeleteRange/Txn-put and on
-watches, keys_only/count_only ranges, watch filters (NOPUT/NODELETE).
+watches, keys_only/count_only ranges, watch filters (NOPUT/NODELETE),
+progress-notify (periodic + on-demand WatchProgressRequest, etcd watch_id
+-1 convention), and watch fragmentation (WatchCreateRequest.fragment:
+oversized event batches split across responses flagged fragment=true on
+all but the last, exactly the etcd reassembly contract).
 Limitations vs real etcd (documented, deliberate): no raft/replication, no
-auth, no watch fragmentation or progress-notify; watch ranges must be
-whole-prefix or exact-key (all this framework's clients use).
+auth; watch ranges must be whole-prefix or exact-key (all this
+framework's clients use).
 """
 
 from __future__ import annotations
@@ -85,10 +89,23 @@ def _to_mvcc(kv: KeyValue, keys_only: bool = False) -> epb.MvccKeyValue:
 
 
 class EtcdLiteServicer:
-    """etcdserverpb.KV + Lease unary methods over InMemoryKV."""
+    """etcdserverpb.KV + Lease unary methods over InMemoryKV.
 
-    def __init__(self, store: Optional[InMemoryKV] = None):
+    ``progress_interval_s`` is the periodic progress-notify cadence for
+    watches created with progress_notify (etcd defaults to ~10 min; tests
+    shrink it). ``fragment_bytes`` caps the serialized event payload per
+    WatchResponse for fragment-enabled watches (etcd uses its max request
+    bytes; shrunk in tests to force multi-fragment batches)."""
+
+    def __init__(
+        self,
+        store: Optional[InMemoryKV] = None,
+        progress_interval_s: float = 600.0,
+        fragment_bytes: int = 2 << 20,
+    ):
         self.store = store or InMemoryKV()
+        self.progress_interval_s = progress_interval_s
+        self.fragment_bytes = fragment_bytes
 
     def _header(self) -> epb.ResponseHeader:
         return epb.ResponseHeader(revision=self.store.revision)
@@ -327,6 +344,10 @@ class EtcdLiteServicer:
         ``compact_revision`` (the etcd ErrCompacted contract)."""
         out_q: "queue.Queue" = queue.Queue(maxsize=1024)
         handles: dict[int, object] = {}
+        progress_ids: set[int] = set()
+        # Guards progress_ids: mutated by the reader (create/cancel) and
+        # snapshotted by progress emissions on the ticker/dispatcher.
+        progress_lock = threading.Lock()
         next_watch_id = [0]
         closed = threading.Event()
 
@@ -336,9 +357,12 @@ class EtcdLiteServicer:
                     req = epb.WatchRequest.FromString(req_bytes)
                     if req.HasField("create_request"):
                         self._watch_create(req.create_request, out_q, handles,
-                                           next_watch_id)
+                                           next_watch_id, progress_ids,
+                                           progress_lock)
                     elif req.HasField("cancel_request"):
                         h = handles.pop(req.cancel_request.watch_id, None)
+                        with progress_lock:
+                            progress_ids.discard(req.cancel_request.watch_id)
                         if h is not None:
                             h.cancel()
                         out_q.put(
@@ -348,13 +372,53 @@ class EtcdLiteServicer:
                                 canceled=True,
                             )
                         )
+                    elif req.HasField("progress_request"):
+                        # On-demand progress: one response with watch_id -1
+                        # (the etcd manual RequestProgress convention).
+                        # Routed through the dispatcher barrier so the
+                        # advertised revision can never overtake events
+                        # still queued for this stream's watches.
+                        def answer(rev):
+                            try:
+                                out_q.put_nowait(epb.WatchResponse(
+                                    header=epb.ResponseHeader(revision=rev),
+                                    watch_id=-1,
+                                ))
+                            except queue.Full:
+                                pass  # backlogged: events matter more
+                        self.store.dispatch_barrier(answer)
             except Exception:  # noqa: BLE001 — stream torn down
                 pass
             finally:
                 closed.set()
                 out_q.put(None)
 
+        def progress_ticker():
+            # Periodic progress-notify for watches that asked for it: an
+            # empty response whose header carries the current revision, so
+            # an idle watcher can bound the staleness of its view. Emitted
+            # via the store's dispatcher barrier: a tick enqueued at
+            # revision R runs only after every event up to R has been
+            # delivered, so the client's next_rev advance on a tick can
+            # never skip an undelivered event (etcd synced-watcher rule).
+            def emit(rev):
+                with progress_lock:
+                    ids = sorted(progress_ids)
+                hdr = epb.ResponseHeader(revision=rev)
+                for wid in ids:
+                    if wid in handles:
+                        try:
+                            out_q.put_nowait(
+                                epb.WatchResponse(header=hdr, watch_id=wid)
+                            )
+                        except queue.Full:
+                            pass  # backlogged: events matter more
+
+            while not closed.wait(self.progress_interval_s):
+                self.store.dispatch_barrier(emit)
+
         threading.Thread(target=reader, daemon=True).start()
+        threading.Thread(target=progress_ticker, daemon=True).start()
         try:
             while context.is_active():
                 resp = out_q.get()
@@ -366,7 +430,10 @@ class EtcdLiteServicer:
             for h in handles.values():
                 h.cancel()
 
-    def _watch_create(self, create, out_q, handles, next_watch_id) -> None:
+    def _watch_create(
+        self, create, out_q, handles, next_watch_id, progress_ids,
+        progress_lock,
+    ) -> None:
         watch_id = next_watch_id[0]
         next_watch_id[0] += 1
         start = create.start_revision
@@ -377,6 +444,7 @@ class EtcdLiteServicer:
         drop_puts = epb.WatchCreateRequest.NOPUT in create.filters
         drop_deletes = epb.WatchCreateRequest.NODELETE in create.filters
         want_prev = create.prev_kv
+        fragment = create.fragment
 
         def to_event(ev) -> epb.MvccEvent:
             out = epb.MvccEvent(
@@ -405,10 +473,10 @@ class EtcdLiteServicer:
             if not events:
                 return
             try:
-                out_q.put_nowait(epb.WatchResponse(
-                    header=self._header(), watch_id=watch_id,
-                    events=[to_event(ev) for ev in events],
-                ))
+                for resp in self._event_responses(
+                    watch_id, [to_event(ev) for ev in events], fragment
+                ):
+                    out_q.put_nowait(resp)
             except queue.Full:
                 # NEVER block here: this runs on the store's single
                 # dispatcher thread — a blocking put on the full queue
@@ -416,6 +484,8 @@ class EtcdLiteServicer:
                 # store. Cancel and best-effort notify.
                 log.warning("etcd-lite watch backlogged; canceling %d", watch_id)
                 h = handles.pop(watch_id, None)
+                with progress_lock:
+                    progress_ids.discard(watch_id)
                 if h is not None:
                     h.cancel()
                 cancel_resp = epb.WatchResponse(
@@ -460,9 +530,50 @@ class EtcdLiteServicer:
             ))
             return
         handles[watch_id] = handle
+        if create.progress_notify:
+            # Become progress-eligible only once SYNCED: the eligibility
+            # add rides a dispatcher barrier enqueued after store.watch()
+            # queued this watch's replay, so it lands behind those events.
+            # A tick barrier already sitting in the dispatcher queue
+            # (enqueued before this create on a long-lived multiplexed
+            # stream) therefore cannot advertise a revision ahead of the
+            # replay — the synced-watcher guarantee holds for replays too.
+            def mark_synced(_rev, wid=watch_id):
+                if wid in handles:  # skip if canceled meanwhile
+                    with progress_lock:
+                        progress_ids.add(wid)
+
+            self.store.dispatch_barrier(mark_synced)
         out_q.put(epb.WatchResponse(
             header=self._header(), watch_id=watch_id, created=True,
         ))
+
+    def _event_responses(self, watch_id, mvcc_events, fragment):
+        """One WatchResponse per batch — or, for fragment-enabled watches
+        whose batch exceeds ``fragment_bytes``, several with fragment=true
+        on all but the last (the etcd reassembly contract). The header is
+        computed once so every fragment of a batch carries one revision."""
+        header = self._header()
+        if not fragment:
+            return [epb.WatchResponse(
+                header=header, watch_id=watch_id, events=mvcc_events,
+            )]
+        chunks, cur, cur_bytes = [], [], 0
+        for ev in mvcc_events:
+            sz = ev.ByteSize()
+            if cur and cur_bytes + sz > self.fragment_bytes:
+                chunks.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(ev)
+            cur_bytes += sz
+        chunks.append(cur)
+        return [
+            epb.WatchResponse(
+                header=header, watch_id=watch_id, events=chunk,
+                fragment=(i < len(chunks) - 1),
+            )
+            for i, chunk in enumerate(chunks)
+        ]
 
     def keepalive_stream(self, request_iterator, context):
         for req_bytes in request_iterator:
@@ -500,8 +611,14 @@ def start_etcd_server(
     max_workers: int = 16,
     bind_host: str = "127.0.0.1",
     tls=None,
+    progress_interval_s: float = 600.0,
+    fragment_bytes: int = 2 << 20,
 ) -> tuple[grpc.Server, int, InMemoryKV]:
-    servicer = EtcdLiteServicer(store)
+    servicer = EtcdLiteServicer(
+        store,
+        progress_interval_s=progress_interval_s,
+        fragment_bytes=fragment_bytes,
+    )
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=message_size_options(),

@@ -378,15 +378,29 @@ class InMemoryKV(KVStore):
             if matched:
                 self._events.put((w, matched))
 
+    def dispatch_barrier(self, fn) -> None:
+        """Run ``fn(revision)`` on the dispatcher thread AFTER every event
+        enqueued so far has been delivered to its watchers. ``revision`` is
+        the store revision at enqueue time — by the time ``fn`` runs, all
+        events up to it have reached their callbacks, so a progress-style
+        notification built inside ``fn`` can never advertise a revision
+        ahead of what a watcher has seen (etcd synced-watcher guarantee)."""
+        with self._lock:
+            self._events.put((None, (fn, self.revision)))
+
     def _dispatch_loop(self) -> None:
         while not self._closed.is_set():
             try:
                 w, events = self._events.get(timeout=0.1)
             except queue.Empty:
                 continue
-            if w.cancelled:
-                continue
             try:
+                if w is None:  # dispatch_barrier entry
+                    fn, rev = events
+                    fn(rev)
+                    continue
+                if w.cancelled:
+                    continue
                 w.callback(events)
             except Exception:  # watcher bugs must not kill dispatch
                 import traceback

@@ -734,3 +734,438 @@ class TestTxnWatchAtomicity:
         assert all(ev.type == epb.MvccEvent.DELETE for ev in resp.events)
         assert len({ev.kv.mod_revision for ev in resp.events}) == 1
         req_q.put(None)
+
+
+@pytest.fixture()
+def wire_fast():
+    """Wire fixture with a fast progress ticker and a tiny fragmentation
+    threshold so both behaviors are observable in test time."""
+    backing = InMemoryKV(sweep_interval_s=0.05)
+    server, port, store = start_etcd_server(
+        store=backing, progress_interval_s=0.15, fragment_bytes=4096
+    )
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    kv = grpc_defs.make_stub(channel, _KV_SERVICE, _KV_METHODS)
+    lease = grpc_defs.make_stub(channel, _LEASE_SERVICE, _LEASE_METHODS)
+    yield kv, lease, channel, store
+    channel.close()
+    server.stop(0)
+    backing.close()
+
+
+class TestProgressNotify:
+    def test_periodic_progress_carries_current_revision(self, wire_fast):
+        """A progress_notify watch gets periodic EMPTY responses whose
+        header bounds the staleness of an idle watcher's view (etcd
+        WatchCreateRequest field 4)."""
+        kv, _, channel, _ = wire_fast
+        kv.Put(epb.PutRequest(key=b"pn/seed", value=b"v"))
+        rev_after_put = kv.Range(epb.RangeRequest(key=b"pn/seed")).header.revision
+        req_q, call = _watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"pn/", range_end=_prefix_end(b"pn/"),
+            progress_notify=True)))
+        it = iter(call)
+        created = next(it)
+        assert created.created
+        wid = created.watch_id
+        resp = next(it)  # no writes since creation: this must be a tick
+        assert not resp.events and not resp.canceled
+        assert resp.watch_id == wid
+        assert resp.header.revision >= rev_after_put
+        req_q.put(None)
+
+    def test_no_progress_without_opt_in(self, wire_fast):
+        """A watch created WITHOUT progress_notify must stay silent while
+        idle — empty responses would wake every follower for nothing."""
+        _, _, channel, _ = wire_fast
+        req_q, call = _watch_stream(channel, timeout=1)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"quiet/", range_end=_prefix_end(b"quiet/"))))
+        it = iter(call)
+        assert next(it).created
+        with pytest.raises(grpc.RpcError) as e:  # deadline, not a tick
+            next(it)
+        assert e.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        req_q.put(None)
+
+    def test_tick_never_advertises_undelivered_revision(self):
+        """The etcd synced-watcher rule: when a watcher receives a progress
+        notification at revision R, every event with mod_revision <= R has
+        already been delivered to it. A tick that overtook the event
+        dispatcher would let a client fence its resume point past an event
+        it never saw (lost DELETE after reconnect)."""
+        backing = InMemoryKV(sweep_interval_s=0.05)
+        server, port, _ = start_etcd_server(
+            store=backing, progress_interval_s=0.02
+        )
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        kv = grpc_defs.make_stub(channel, _KV_SERVICE, _KV_METHODS)
+        try:
+            req_q, call = _watch_stream(channel, timeout=30)
+            req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+                key=b"sy/", range_end=_prefix_end(b"sy/"),
+                progress_notify=True)))
+            it = iter(call)
+            created = next(it)
+            assert created.created
+            base_rev = created.header.revision
+
+            stop = threading.Event()
+            errs = []
+
+            def writer():
+                try:
+                    i = 0
+                    while not stop.is_set():
+                        kv.Put(epb.PutRequest(
+                            key=f"sy/k{i % 4}".encode(), value=b"v"))
+                        i += 1
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            t = threading.Thread(target=writer, daemon=True)
+            t.start()
+            delivered_rev = base_rev
+            ticks = 0
+            deadline = time.monotonic() + 5
+            while (ticks < 20 or delivered_rev == base_rev) and (
+                time.monotonic() < deadline
+            ):
+                resp = next(it)
+                if resp.events:
+                    delivered_rev = max(
+                        delivered_rev,
+                        max(ev.kv.mod_revision for ev in resp.events),
+                    )
+                else:
+                    ticks += 1
+                    assert resp.header.revision <= delivered_rev, (
+                        f"tick advertised rev {resp.header.revision} but "
+                        f"only {delivered_rev} delivered — resume fencing "
+                        "would skip events"
+                    )
+            stop.set()
+            t.join(timeout=10)
+            assert not errs and ticks >= 1
+            req_q.put(None)
+        finally:
+            channel.close()
+            server.stop(0)
+            backing.close()
+
+    def test_tick_waits_for_replay_on_multiplexed_stream(self):
+        """A watch created with start_revision replay on a long-lived
+        stream must receive ALL its replay events before any progress tick
+        — a tick barrier already queued in the dispatcher must not
+        advertise head revision to a watch still replaying history."""
+        backing = InMemoryKV(sweep_interval_s=0.05)
+        server, port, _ = start_etcd_server(
+            store=backing, progress_interval_s=0.01
+        )
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        kv = grpc_defs.make_stub(channel, _KV_SERVICE, _KV_METHODS)
+        try:
+            n = 100
+            for i in range(n):
+                kv.Put(epb.PutRequest(key=f"rp/k{i:03d}".encode(), value=b"v"))
+            req_q, call = _watch_stream(channel, timeout=30)
+            it = iter(call)
+            # Age the stream so tick barriers are in flight, then create.
+            time.sleep(0.1)
+            req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+                key=b"rp/", range_end=_prefix_end(b"rp/"),
+                start_revision=1, progress_notify=True)))
+            assert next(it).created
+            seen = 0
+            while seen < n:
+                resp = next(it)
+                if not resp.events:
+                    pytest.fail(
+                        f"progress tick (rev {resp.header.revision}) "
+                        f"arrived after only {seen}/{n} replay events"
+                    )
+                seen += len(resp.events)
+            req_q.put(None)
+        finally:
+            channel.close()
+            server.stop(0)
+            backing.close()
+
+    def test_on_demand_progress_request(self, wire):
+        """WatchProgressRequest answers immediately with watch_id -1 and
+        the current revision (the etcd manual RequestProgress contract) —
+        on the default server, where no periodic ticker will beat it."""
+        kv, _, channel, _ = wire
+        req_q, call = _watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"pr/", range_end=_prefix_end(b"pr/"))))
+        it = iter(call)
+        assert next(it).created
+        kv.Put(epb.PutRequest(key=b"elsewhere", value=b"x"))
+        rev = kv.Range(epb.RangeRequest(key=b"elsewhere")).header.revision
+        req_q.put(epb.WatchRequest(progress_request=epb.WatchProgressRequest()))
+        resp = next(it)
+        assert resp.watch_id == -1 and not resp.events
+        assert resp.header.revision >= rev
+        req_q.put(None)
+
+
+class TestWatchFragmentation:
+    def _collect_batch(self, it):
+        """Reassemble one fragmented batch: responses flagged fragment=true
+        continue; the first fragment=false response ends the batch."""
+        events, n_resps = [], 0
+        while True:
+            resp = next(it)
+            n_resps += 1
+            events.extend(resp.events)
+            if not resp.fragment:
+                return events, n_resps, resp.header.revision
+
+    def test_oversized_batch_splits_with_fragment_flags(self, wire_fast):
+        """A txn whose events exceed fragment_bytes must arrive as several
+        responses, fragment=true on all but the last, in order, lossless
+        (the etcd fragment reassembly contract)."""
+        kv, _, channel, _ = wire_fast
+        req_q, call = _watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"fr/", range_end=_prefix_end(b"fr/"), fragment=True)))
+        it = iter(call)
+        assert next(it).created
+        n, val = 40, b"x" * 400  # ~16 KB of events >> 4 KB threshold
+        kv.Txn(epb.TxnRequest(success=[
+            epb.RequestOp(request_put=epb.PutRequest(
+                key=f"fr/k{i:03d}".encode(), value=val))
+            for i in range(n)
+        ]))
+        events, n_resps, _ = self._collect_batch(it)
+        assert n_resps > 1, "batch should have fragmented"
+        assert [ev.kv.key for ev in events] == [
+            f"fr/k{i:03d}".encode() for i in range(n)
+        ]
+        # One revision batch, every fragment carried from the same txn.
+        assert len({ev.kv.mod_revision for ev in events}) == 1
+        req_q.put(None)
+
+    def test_replay_fragments_too(self, wire_fast):
+        """start_revision replay of a large txn batch goes through the same
+        fragmentation path as live delivery."""
+        kv, _, channel, _ = wire_fast
+        n, val = 30, b"y" * 400
+        kv.Txn(epb.TxnRequest(success=[
+            epb.RequestOp(request_put=epb.PutRequest(
+                key=f"fr2/k{i:03d}".encode(), value=val))
+            for i in range(n)
+        ]))
+        req_q, call = _watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"fr2/", range_end=_prefix_end(b"fr2/"),
+            start_revision=1, fragment=True)))
+        it = iter(call)
+        assert next(it).created
+        events, n_resps, _ = self._collect_batch(it)
+        assert n_resps > 1
+        assert len(events) == n
+        req_q.put(None)
+
+    def test_without_fragment_flag_batch_stays_atomic(self, wire_fast):
+        """The same oversized txn on a NON-fragment watch arrives in one
+        response: fragmentation is strictly opt-in (clients that did not
+        opt in rely on one-revision-one-response resume fencing)."""
+        kv, _, channel, _ = wire_fast
+        req_q, call = _watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"fr3/", range_end=_prefix_end(b"fr3/"))))
+        it = iter(call)
+        assert next(it).created
+        n, val = 40, b"z" * 400
+        kv.Txn(epb.TxnRequest(success=[
+            epb.RequestOp(request_put=epb.PutRequest(
+                key=f"fr3/k{i:03d}".encode(), value=val))
+            for i in range(n)
+        ]))
+        resp = next(it)
+        assert not resp.fragment and len(resp.events) == n
+        req_q.put(None)
+
+
+class TestClientFragmentReassembly:
+    def test_etcdkv_delivers_fragmented_batch_as_one_callback(self):
+        """EtcdKV opts into fragmentation; a txn batch bigger than the
+        server's fragment threshold must still reach the watch callback as
+        ONE event list (resume fencing depends on whole revisions)."""
+        from modelmesh_tpu.kv.etcd import EtcdKV
+
+        backing = InMemoryKV(sweep_interval_s=0.05)
+        server, port, _ = start_etcd_server(
+            store=backing, fragment_bytes=2048
+        )
+        client = EtcdKV(f"127.0.0.1:{port}")
+        try:
+            batches = []
+            client.watch("cf/", lambda evs: batches.append(list(evs)))
+            n, val = 30, b"x" * 300  # ~9 KB >> 2 KB threshold
+            ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+            kv = grpc_defs.make_stub(ch, _KV_SERVICE, _KV_METHODS)
+            kv.Txn(epb.TxnRequest(success=[
+                epb.RequestOp(request_put=epb.PutRequest(
+                    key=f"cf/k{i:03d}".encode(), value=val))
+                for i in range(n)
+            ]))
+            deadline = time.monotonic() + 10
+            while sum(len(b) for b in batches) < n and (
+                time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            ch.close()
+            assert sum(len(b) for b in batches) == n
+            assert len(batches) == 1, (
+                f"fragmented batch split into {len(batches)} callbacks"
+            )
+            assert [e.kv.key for e in batches[0]] == [
+                f"cf/k{i:03d}" for i in range(n)
+            ]
+        finally:
+            client.close()
+            server.stop(0)
+            backing.close()
+
+
+class TestLeasePartition:
+    def _keepalive_call(self, channel, lease_id, stop):
+        req = epb.LeaseKeepAliveRequest(ID=lease_id).SerializeToString()
+
+        def gen():
+            while not stop.is_set():
+                yield req
+                time.sleep(0.2)
+
+        return channel.stream_stream(
+            "/etcdserverpb.Lease/LeaseKeepAlive",
+            request_serializer=lambda b: b,
+            response_deserializer=epb.LeaseKeepAliveResponse.FromString,
+        )(gen())
+
+    def test_partition_expires_lease_and_deletes_keys(self, wire):
+        """The partition contract: while keepalives flow the lease outlives
+        its TTL; when the stream dies (client partitioned) the lease
+        expires at ~TTL, attached keys are deleted, watchers see the
+        DELETEs, and a post-partition keepalive answers TTL=0."""
+        kv, lease, channel, _ = wire
+        g = lease.LeaseGrant(epb.LeaseGrantRequest(TTL=1))
+        kv.Put(epb.PutRequest(key=b"part/eph", value=b"v", lease=g.ID))
+        req_q, call = _watch_stream(channel)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"part/", range_end=_prefix_end(b"part/"))))
+        it = iter(call)
+        assert next(it).created
+
+        stop = threading.Event()
+        ka = self._keepalive_call(channel, g.ID, stop)
+
+        def drain_until_cancelled():
+            try:
+                for _ in ka:
+                    pass
+            except grpc.RpcError:
+                pass  # the deliberate ka.cancel() below
+
+        drainer = threading.Thread(target=drain_until_cancelled, daemon=True)
+        drainer.start()
+        time.sleep(1.6)  # well past TTL: only keepalives explain survival
+        r = kv.Range(epb.RangeRequest(key=b"part/eph"))
+        assert r.kvs, "lease expired despite live keepalive stream"
+
+        stop.set()  # the partition: no more keepalives reach the server
+        ka.cancel()
+        resp = next(it)  # expiry sweep deletes the attached key
+        assert resp.events[0].type == epb.MvccEvent.DELETE
+        assert resp.events[0].kv.key == b"part/eph"
+        assert not kv.Range(epb.RangeRequest(key=b"part/eph")).kvs
+        # Reconnect after the partition: the lease is gone for good.
+        ka2 = channel.stream_stream(
+            "/etcdserverpb.Lease/LeaseKeepAlive",
+            request_serializer=lambda b: b,
+            response_deserializer=epb.LeaseKeepAliveResponse.FromString,
+        )(iter([epb.LeaseKeepAliveRequest(ID=g.ID).SerializeToString()]),
+          timeout=10)
+        assert next(iter(ka2)).TTL == 0
+        req_q.put(None)
+
+
+class TestMixedOpsReplayMatchesLive:
+    def test_replay_watch_reproduces_live_history_exactly(self, wire):
+        """Concurrent writers mix puts, deletes, and txns; a live watch
+        records the event stream. A NEW watch replaying from revision 1
+        must deliver the IDENTICAL (type, key, mod_rev, version) sequence
+        — replay and live delivery are the same history, which is exactly
+        what a crashed-and-resumed follower depends on."""
+        kv, _, channel, _ = wire
+        req_q, call = _watch_stream(channel, timeout=60)
+        req_q.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"mx/", range_end=_prefix_end(b"mx/"))))
+        it = iter(call)
+        assert next(it).created
+
+        N_WRITERS, ROUNDS = 4, 25
+        errs = []
+
+        def writer(w):
+            try:
+                for j in range(ROUNDS):
+                    k = f"mx/k{(w * 3 + j) % 6}".encode()
+                    mode = (w + j) % 3
+                    if mode == 0:
+                        kv.Put(epb.PutRequest(key=k, value=f"{w}/{j}".encode()))
+                    elif mode == 1:
+                        kv.Txn(epb.TxnRequest(success=[
+                            epb.RequestOp(request_put=epb.PutRequest(
+                                key=k, value=b"t1")),
+                            epb.RequestOp(request_put=epb.PutRequest(
+                                key=k + b"-pair", value=b"t2")),
+                        ]))
+                    else:
+                        kv.DeleteRange(epb.DeleteRangeRequest(key=k))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(w,))
+            for w in range(N_WRITERS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs
+
+        # Sentinel write marks end-of-history: no-op deletes emit no event,
+        # so event counts are not predictable — but ordering is, and both
+        # streams must end at the same sentinel.
+        kv.Put(epb.PutRequest(key=b"mx/zz-sentinel", value=b"end"))
+
+        def drain(stream_it):
+            out = []
+            while True:
+                resp = next(stream_it)
+                for ev in resp.events:
+                    if ev.kv.key == b"mx/zz-sentinel":
+                        return out
+                    out.append((
+                        ev.type, ev.kv.key, ev.kv.mod_revision, ev.kv.version
+                    ))
+
+        live = drain(it)
+        req_q.put(None)
+
+        req_q2, call2 = _watch_stream(channel, timeout=60)
+        req_q2.put(epb.WatchRequest(create_request=epb.WatchCreateRequest(
+            key=b"mx/", range_end=_prefix_end(b"mx/"), start_revision=1)))
+        it2 = iter(call2)
+        assert next(it2).created
+        replay = drain(it2)
+        assert live and replay == live, (
+            "replayed history diverged from live stream"
+        )
+        req_q2.put(None)

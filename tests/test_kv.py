@@ -133,6 +133,50 @@ class TestStore:
         assert (EventType.PUT, "w/b") in types      # streamed
         assert (EventType.DELETE, "w/a") in types
 
+    def test_dispatch_barrier_runs_after_prior_deliveries(self):
+        """dispatch_barrier(fn) must observe every event enqueued before it
+        already delivered, and fn's revision argument must be the enqueue-
+        time revision (the etcd-lite progress-notify ordering contract)."""
+        import threading
+
+        from modelmesh_tpu.kv.memory import InMemoryKV
+
+        store = InMemoryKV(sweep_interval_s=0.05)
+        try:
+            order = []
+            slow = threading.Event()
+
+            def watcher(evs):
+                slow.wait(0.05)  # widen the window a tick could jump
+                order.extend(("event", e.kv.mod_rev) for e in evs)
+
+            store.watch("b/", watcher)
+            for i in range(5):
+                store.put(f"b/k{i}", b"v")
+            rev_at_enqueue = store.revision
+            done = threading.Event()
+
+            def barrier(rev):
+                order.append(("barrier", rev))
+                done.set()
+
+            store.dispatch_barrier(barrier)
+            store.put("b/late", b"v")  # after the barrier: may trail it
+            assert done.wait(10)
+            bar_i = order.index(("barrier", rev_at_enqueue))
+            delivered_before = [
+                r for kind, r in order[:bar_i] if kind == "event"
+            ]
+            assert delivered_before == [
+                r for kind, r in order if kind == "event"
+            ][: len(delivered_before)]
+            assert max(delivered_before) >= rev_at_enqueue, (
+                f"barrier at rev {rev_at_enqueue} ran before deliveries "
+                f"{delivered_before}"
+            )
+        finally:
+            store.close()
+
     def test_lease_expiry_deletes_keys(self, kv):
         # etcd TTLs are integer seconds (the client rounds up); in-process
         # stores accept fractions — size the wait to the effective TTL.

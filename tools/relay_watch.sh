@@ -1,0 +1,91 @@
+#!/usr/bin/env bash
+# Unattended TPU relay-window watcher (VERDICT r3 item 1).
+#
+# The axon relay that fronts the TPU is down most of the time; round 3 got
+# exactly one ~40-minute window and the mitigated solver never ran on
+# hardware. This watcher removes the luck: it polls the relay ports, and the
+# moment they listen it (a) confirms with a subprocess jax probe (never
+# in-process -- a wedged PJRT init hangs in tcp_recvmsg and is unkillable),
+# (b) runs tools/tpu_profile.py (the full A/B stage matrix, ~5 min), then
+# (c) python bench.py, and (d) commits the artifacts immediately -- the
+# window can close at any time.
+#
+# Discipline: SIGTERM only (coreutils `timeout` default); never SIGKILL a
+# process holding the TPU -- it wedges the relay claim for minutes.
+#
+# The polling log doubles as proof-of-coverage if the relay never rises.
+
+set -u
+cd "$(dirname "$0")/.."
+REPO="$PWD"
+CAPDIR="tools/relay_capture"
+LOG="$CAPDIR/watch.log"
+mkdir -p "$CAPDIR"
+
+POLL_S="${RELAY_POLL_S:-20}"
+COOLDOWN_S="${RELAY_COOLDOWN_S:-1800}"   # min gap between full captures
+last_capture=0
+
+say() { echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) $*" >> "$LOG"; }
+
+commit_paths() {
+    # Commit only our own artifact paths; retry around index-lock races
+    # with the builder's concurrent commits.
+    local msg="$1"; shift
+    for i in 1 2 3 4 5; do
+        if git add -- "$@" 2>>"$LOG" && \
+           git commit -q -m "$msg" -- "$@" 2>>"$LOG"; then
+            say "committed: $msg"
+            return 0
+        fi
+        sleep $((i * 7))
+    done
+    say "commit FAILED after retries: $msg"
+    return 1
+}
+
+ports_up() { ss -tln 2>/dev/null | grep -qE ':(8082|8083)\b'; }
+
+probe_ok() {
+    timeout --signal=TERM 90 python -c \
+        'import jax; ds=jax.devices(); assert ds and ds[0].platform!="cpu", ds; print(ds)' \
+        >> "$LOG" 2>&1
+}
+
+say "watcher start pid=$$ poll=${POLL_S}s cooldown=${COOLDOWN_S}s"
+polls=0
+while true; do
+    polls=$((polls + 1))
+    if ports_up; then
+        say "relay ports LISTENING (poll #$polls)"
+        if probe_ok; then
+            now=$(date +%s)
+            if (( now - last_capture < COOLDOWN_S )); then
+                say "probe ok but inside cooldown; skipping capture"
+            else
+                ts=$(date -u +%Y%m%dT%H%M%SZ)
+                say "probe ok -- CAPTURE $ts begins"
+                timeout --signal=TERM 1200 python tools/tpu_profile.py \
+                    > "$CAPDIR/${ts}_profile.jsonl" 2> "$CAPDIR/${ts}_profile.err"
+                prc=$?
+                say "tpu_profile rc=$prc"
+                commit_paths "TPU window $ts: on-hardware stage profile (relay_watch)" \
+                    "$CAPDIR"
+                timeout --signal=TERM 1200 python bench.py \
+                    > "$CAPDIR/${ts}_bench.json" 2> "$CAPDIR/${ts}_bench.err"
+                brc=$?
+                say "bench rc=$brc"
+                commit_paths "TPU window $ts: bench.py on hardware (relay_watch)" \
+                    "$CAPDIR"
+                last_capture=$(date +%s)
+                say "CAPTURE $ts done (profile rc=$prc bench rc=$brc)"
+            fi
+        else
+            say "ports up but jax probe failed/timed out"
+        fi
+    else
+        # heartbeat every ~15 min so the log proves continuous coverage
+        if (( polls % 45 == 1 )); then say "relay down (poll #$polls)"; fi
+    fi
+    sleep "$POLL_S"
+done

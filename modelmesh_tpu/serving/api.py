@@ -388,6 +388,17 @@ class InferenceFallback:
             self._observe_payload(
                 req_id, model_id, method, "response", result.payload, "OK"
             )
+            # Serving-identity trailers: which worker the connection
+            # entered (front-door balancing debug) and which instance
+            # actually served — operators and tests read these to see
+            # kernel connection spread vs internal forwards.
+            try:
+                context.set_trailing_metadata((
+                    ("mm-entry-instance", self.instance.instance_id),
+                    ("mm-served-by", result.served_by or ""),
+                ))
+            except Exception:  # noqa: BLE001 — debug info, never fatal
+                pass
             return result.payload
         except RequestCancelledError:
             # The client is gone; nothing to send. Abort with CANCELLED so
@@ -508,21 +519,40 @@ class MeshServer:
         payload_processor=None,
         dataplane=None,
         tls=None,
+        frontdoor_port: Optional[int] = None,
     ):
         """``bind_host`` is the listen address (0.0.0.0 for cross-host
         deployments); ``advertise_host`` is what peers dial — production
         config passes the pod IP / hostname. ``tls`` (serving.tls.TlsConfig)
         secures all three surfaces; with require_client_auth peers must
-        present certs signed by the configured CA."""
+        present certs signed by the configured CA. ``frontdoor_port``
+        additionally binds the external surfaces on a SHARED
+        SO_REUSEPORT listener so several worker processes on one host
+        can serve one public port (multi-core scaling; must be a fixed
+        port, not 0)."""
+        if frontdoor_port is not None and frontdoor_port <= 0:
+            # Ephemeral would give every worker a DIFFERENT port,
+            # silently defeating the shared-listener design. Checked
+            # before any server exists so failure leaks nothing.
+            raise ValueError("frontdoor_port must be a fixed positive port")
         self.instance = instance
         self._advertise_host = advertise_host
         self.tls = tls
+        # SO_REUSEPORT explicitly OFF here: the per-instance port must be
+        # unique (peer forwards are addressed to exactly this process) —
+        # gRPC's Linux default of reuseport=1 would let a copy-pasted
+        # duplicate --port bind silently and split forwards between
+        # workers. The shared front door below opts back in.
         self.server = grpc.server(
             futures.ThreadPoolExecutor(max_workers),
-            options=message_size_options(),
+            options=message_size_options() + [("grpc.so_reuseport", 0)],
+        )
+        api_servicer = MeshApiServicer(instance, vmodels)
+        fallback = InferenceFallback(
+            instance, vmodels, payload_processor, dataplane
         )
         grpc_defs.add_servicer(
-            self.server, MeshApiServicer(instance, vmodels),
+            self.server, api_servicer,
             grpc_defs.API_SERVICE, grpc_defs.API_METHODS,
         )
         grpc_defs.add_servicer(
@@ -530,11 +560,7 @@ class MeshServer:
             grpc_defs.INTERNAL_SERVICE, grpc_defs.INTERNAL_METHODS,
         )
         self.server.add_generic_rpc_handlers(
-            (grpc_defs.RawFallbackHandler(
-                InferenceFallback(
-                    instance, vmodels, payload_processor, dataplane
-                )
-            ),)
+            (grpc_defs.RawFallbackHandler(fallback),)
         )
         addr = f"{bind_host}:{port}"
         if tls is not None:
@@ -545,11 +571,57 @@ class MeshServer:
             self.port = self.server.add_insecure_port(addr)
         self.server.start()
 
+        # Optional SHARED front door (multi-core hosts): N worker
+        # processes on one host bind the SAME public port via
+        # SO_REUSEPORT; the kernel balances incoming connections across
+        # them and cache misses ride the normal internal Forward hop to
+        # the owning worker. Only the EXTERNAL surfaces live here — the
+        # per-instance port above stays unique so peer forwards reach
+        # exactly this process. This is the framework's answer to the
+        # Python GIL: scale the data plane with processes, not threads
+        # (the reference scales one JVM with threads,
+        # ModelMeshApi.java:649-819; a CPython port of that design would
+        # serialize on the interpreter lock).
+        self.frontdoor = None
+        self.frontdoor_port = None
+        if frontdoor_port is not None:
+            # Same servicer/fallback OBJECTS as the internal listener:
+            # one multi-model pool, one request-id sequence — two copies
+            # would emit payload records with colliding req_ids.
+            self.frontdoor = grpc.server(
+                futures.ThreadPoolExecutor(max_workers),
+                options=message_size_options() + [("grpc.so_reuseport", 1)],
+            )
+            grpc_defs.add_servicer(
+                self.frontdoor, api_servicer,
+                grpc_defs.API_SERVICE, grpc_defs.API_METHODS,
+            )
+            self.frontdoor.add_generic_rpc_handlers(
+                (grpc_defs.RawFallbackHandler(fallback),)
+            )
+            fd_addr = f"{bind_host}:{frontdoor_port}"
+            if tls is not None:
+                self.frontdoor_port = self.frontdoor.add_secure_port(
+                    fd_addr, tls.server_credentials()
+                )
+            else:
+                self.frontdoor_port = self.frontdoor.add_insecure_port(fd_addr)
+            if not self.frontdoor_port:
+                # The internal server is already live — release it before
+                # surfacing the failure or the caller has no handle left.
+                self.server.stop(0)
+                raise RuntimeError(
+                    f"could not bind shared front door on {fd_addr}"
+                )
+            self.frontdoor.start()
+
     @property
     def endpoint(self) -> str:
         return f"{self._advertise_host}:{self.port}"
 
     def stop(self, grace: float = 0.5) -> None:
+        if self.frontdoor is not None:
+            self.frontdoor.stop(grace)
         self.server.stop(grace)
 
 

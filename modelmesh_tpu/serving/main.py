@@ -88,6 +88,13 @@ def main(argv=None) -> None:
     )
     parser.add_argument("--instance-id", default=None)
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument(
+        "--frontdoor-port", type=int, default=-1,
+        help="shared SO_REUSEPORT public port for the external surfaces; "
+        "start N worker processes with the SAME value to spread one "
+        "host's data plane across cores (each worker keeps its own "
+        "unique --port for internal forwards)",
+    )
     parser.add_argument("--advertise-host", default="127.0.0.1")
     parser.add_argument("--runtime", default="jax")
     parser.add_argument("--capacity-mb", type=int, default=256)
@@ -201,6 +208,9 @@ def main(argv=None) -> None:
         advertise_host=args.advertise_host,
         payload_processor=payload_proc,
         tls=tls,
+        frontdoor_port=(
+            args.frontdoor_port if args.frontdoor_port >= 0 else None
+        ),
     )
     instance.config.endpoint = server.endpoint
     instance.publish_instance_record(force=True)

@@ -22,7 +22,7 @@ from modelmesh_tpu.runtime.fake import PREDICT_METHOD
 
 
 def _spawn_instance(
-    kv_port: int, iid: str, scheme: str = "mesh"
+    kv_port: int, iid: str, scheme: str = "mesh", extra_args: list = (),
 ) -> tuple[subprocess.Popen, str]:
     proc = subprocess.Popen(
         [
@@ -32,6 +32,7 @@ def _spawn_instance(
             "--runtime", "fake",
             "--capacity-mb", "64",
             "--load-timeout-s", "20",
+            *extra_args,
         ],
         stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL,
@@ -195,3 +196,87 @@ class TestMultiProcess:
         assert ok, "survivor could not serve after peer shutdown"
         ch0.close()
         ch1.close()
+
+
+class TestSharedFrontDoor:
+    def test_workers_share_one_public_port(self):
+        """Multi-core data plane: N worker processes bind ONE public port
+        via SO_REUSEPORT (the kernel balances connections); each keeps a
+        unique internal port so forwards reach the owning worker. Every
+        connection must serve correctly no matter which worker the kernel
+        hands it to."""
+        import socket
+
+        from modelmesh_tpu.kv.service import start_kv_server as _start
+
+        server, kv_port, store = _start()
+        # Reserve a front-door port: bind/close (small race, fine in CI).
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        fd_port = s.getsockname()[1]
+        s.close()
+        spawned = []
+        try:
+            for i in range(2):
+                spawned.append(_spawn_instance(
+                    kv_port, f"fd-{i}",
+                    extra_args=["--frontdoor-port", str(fd_port)],
+                ))
+            shared = f"127.0.0.1:{fd_port}"
+            ch = grpc.insecure_channel(shared)
+            api = grpc_defs.make_stub(
+                ch, grpc_defs.API_SERVICE, grpc_defs.API_METHODS
+            )
+            st = api.RegisterModel(apb.RegisterModelRequest(
+                model_id="fd-model",
+                info=apb.ModelInfo(
+                    model_type="example", model_path="mem://fd"
+                ),
+                load_now=True, sync=True,
+            ), timeout=60)
+            assert st.status == apb.LOADED
+            ch.close()
+            # Fresh channel per request: each new TCP connection may land
+            # on either worker (kernel 4-tuple hash). The serving-identity
+            # trailers prove BOTH workers take front-door connections and
+            # that a miss actually rides the internal forward — without
+            # them this test could pass with every connection landing on
+            # the owner, never exercising the path it exists for.
+            entries, forwards = set(), 0
+            for i in range(40):
+                chi = grpc.insecure_channel(shared)
+                out, call = grpc_defs.raw_method(chi, PREDICT_METHOD).with_call(
+                    f"p{i}".encode(),
+                    metadata=[("mm-model-id", "fd-model")], timeout=30,
+                )
+                assert out.startswith(b"fd-model:"), out[:40]
+                md = dict(call.trailing_metadata() or ())
+                entry = md.get("mm-entry-instance", "")
+                served = md.get("mm-served-by", "")
+                assert served, "missing mm-served-by trailer"
+                entries.add(entry)
+                if entry != served:
+                    forwards += 1
+                sti = grpc_defs.make_stub(
+                    chi, grpc_defs.API_SERVICE, grpc_defs.API_METHODS
+                ).GetModelStatus(
+                    apb.GetModelStatusRequest(model_id="fd-model"),
+                    timeout=10,
+                )
+                assert sti.status == apb.LOADED
+                chi.close()
+                if len(entries) == 2 and forwards:
+                    break
+            assert entries == {"fd-0", "fd-1"}, (
+                f"kernel never spread connections: entries={entries}"
+            )
+            assert forwards >= 1, (
+                "no front-door connection was forwarded — the non-owning "
+                "worker never took a connection with a miss"
+            )
+        finally:
+            for proc, _ in spawned:
+                if proc.poll() is None:
+                    proc.kill()
+            server.stop(0)
+            store.close()

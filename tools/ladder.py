@@ -1,0 +1,106 @@
+"""Scaling-ladder runner: sharded solve at a chosen rung of the 1M x 10k
+target (BASELINE.json ladder), on the virtual CPU mesh or real chips.
+
+Emits ONE JSON line with solve wall time, overflow (absolute + relative
+to placed copy-mass, asserted < 0.1%), and row_err. On the single-core
+CPU simulation wall time is a correctness artifact, not a perf number —
+the note field says so.
+
+Usage:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python tools/ladder.py N M [--mesh 8x1] [--seed 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("n", type=int)
+    ap.add_argument("m", type=int)
+    ap.add_argument("--mesh", default="8x1")
+    ap.add_argument("--seed", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # The ambient sitecustomize forces jax_platforms=axon; the env var
+        # alone does not stick (see .claude/skills/verify).
+        jax.config.update("jax_platforms", "cpu")
+
+    from modelmesh_tpu import ops
+    from modelmesh_tpu.parallel.mesh import make_mesh
+    from modelmesh_tpu.parallel.sharded_solver import (
+        make_sharded_solver,
+        shard_problem,
+    )
+
+    mdl_ax, inst_ax = (int(x) for x in args.mesh.split("x"))
+    devices = jax.devices()
+    if len(devices) < mdl_ax * inst_ax:
+        print(json.dumps({
+            "error": f"need {mdl_ax * inst_ax} devices, have {len(devices)}"
+        }))
+        return 1
+    mesh = make_mesh((mdl_ax, inst_ax), devices=devices[: mdl_ax * inst_ax])
+
+    n = (args.n // mdl_ax) * mdl_ax
+    m = (args.m // inst_ax) * inst_ax
+    problem = ops.random_problem(
+        jax.random.PRNGKey(args.seed), n, m, capacity_slack=2.0
+    )
+    sharded = shard_problem(problem, mesh)
+    solver = make_sharded_solver(mesh)
+
+    t0 = time.perf_counter()
+    sol = solver(sharded)
+    jax.block_until_ready(sol)
+    first_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    sol = solver(sharded, seed=args.seed + 1)
+    jax.block_until_ready(sol)
+    solve_s = time.perf_counter() - t0
+
+    import jax.numpy as jnp
+
+    copies = jnp.minimum(problem.copies, ops.MAX_COPIES)
+    copy_mass = float(jnp.sum(problem.sizes * copies.astype(jnp.float32)))
+    overflow = float(sol.overflow)
+    rel = overflow / copy_mass
+    assert sol.indices.shape == (n, ops.MAX_COPIES)
+    assert 0 <= overflow and rel < 1e-3, (
+        f"overflow {overflow:.2f} is {rel:.2%} of copy-mass (bound 0.1%)"
+    )
+    platform = devices[0].platform
+    print(json.dumps({
+        "tier": f"{n}x{m}",
+        "mesh": {"mdl": mdl_ax, "inst": inst_ax},
+        "platform": (
+            f"cpu-virtual-{len(mesh.devices.flat)}dev"
+            if platform == "cpu" else platform
+        ),
+        "sharded_solve_s": round(solve_s, 1),
+        "first_call_s": round(first_s, 1),
+        "overflow": round(overflow, 3),
+        "overflow_rel": float(f"{rel:.1e}"),
+        "row_err": round(float(sol.row_err), 4),
+        "note": (
+            "rung of the 1M x 10k ladder; on the virtual CPU mesh wall "
+            "time is single-core simulation, not TPU perf"
+        ),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -199,13 +199,18 @@ def main() -> int:
     ap.add_argument("--epochs", type=int, default=10)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", default=None)
+    ap.add_argument(
+        "--slack", type=float, default=1.6,
+        help="capacity / demand ratio; 1.1 is the tight-fleet scenario "
+        "where balance and overflow discipline actually bite",
+    )
     args = ap.parse_args()
 
     lines = []
     summary: dict[str, dict[str, list]] = {}
     for strategy in ("greedy", "jax"):
         rng = np.random.default_rng(args.seed)
-        st = make_state(rng, args.n, args.m)
+        st = make_state(rng, args.n, args.m, slack=args.slack)
         warm = None
         for epoch in range(args.epochs):
             churn(rng, st, epoch)
@@ -238,7 +243,8 @@ def main() -> int:
                 for k, v in per.items()}
         for strat, per in summary.items()
     } if args.epochs > 1 else None,
-        "tier": f"{args.n}x{args.m}", "epochs": args.epochs}
+        "tier": f"{args.n}x{args.m}", "epochs": args.epochs,
+        "slack": args.slack}
     print(json.dumps(out), flush=True)
     if args.json:
         with open(args.json, "w") as f:

@@ -156,6 +156,32 @@ def build_transformer(spec: ModelSpec, model_id: str) -> ServableModel:
 
             ring = make_ring_attention(make_seq_mesh(), seq, causal=True)
 
+    # experts=E: MoE FFN (parallel/moe.py) replaces the dense MLP.
+    # ep=1 additionally runs it expert-parallel over the visible devices.
+    # Routing capacity is grouped by `groups` token shards (part of the
+    # MODEL, not the host): a single-device host computes the identical
+    # drops via the dense oracle when groups matches the EP host's device
+    # count, so both shapes serve the same function (bf16-level).
+    n_experts = spec.params.get("experts", 0)
+    moe_groups = spec.params.get("groups", 1)
+    moe_fn = None
+    if spec.params.get("ep", 0) and n_experts:
+        n_dev = len(jax.devices())
+        if (
+            n_dev > 1
+            and n_experts % n_dev == 0
+            and seq % n_dev == 0
+            and moe_groups == n_dev
+        ):
+            from modelmesh_tpu.parallel.moe import (
+                make_expert_mesh,
+                make_expert_parallel_ffn,
+            )
+
+            moe_fn = make_expert_parallel_ffn(
+                make_expert_mesh(), n_experts
+            )
+
     def dense(key, a, b):
         return jax.random.normal(key, (a, b), jnp.bfloat16) / np.sqrt(a)
 
@@ -167,11 +193,19 @@ def build_transformer(spec: ModelSpec, model_id: str) -> ServableModel:
     }
     for layer in range(n_layers):
         k = keys[2 + 6 * layer: 8 + 6 * layer]
+        if n_experts:
+            from modelmesh_tpu.parallel.moe import init_moe_params
+
+            ffn_params = {"moe": init_moe_params(k[2], d, 4 * d, n_experts)}
+        else:
+            ffn_params = {
+                "up": dense(k[2], d, 4 * d),
+                "down": dense(k[3], 4 * d, d),
+            }
         params["blocks"].append({
             "qkv": dense(k[0], d, 3 * d),
             "proj": dense(k[1], d, d),
-            "up": dense(k[2], d, 4 * d),
-            "down": dense(k[3], 4 * d, d),
+            **ffn_params,
             "ln1": jnp.ones((d,), jnp.bfloat16),
             "ln2": jnp.ones((d,), jnp.bfloat16),
         })
@@ -206,7 +240,19 @@ def build_transformer(spec: ModelSpec, model_id: str) -> ServableModel:
             z = z.transpose(0, 2, 1, 3).reshape(b, t, d)
             h = h + z @ blk["proj"]
             x = layer_norm(h, blk["ln2"])
-            h = h + jax.nn.gelu(x @ blk["up"]) @ blk["down"]
+            if "moe" in blk:
+                flat = x.reshape(b * t, d)
+                if moe_fn is not None and t == seq:
+                    y = moe_fn(blk["moe"], flat)
+                else:
+                    from modelmesh_tpu.parallel.moe import reference_moe
+
+                    y = reference_moe(
+                        blk["moe"], flat, n_experts, n_dev=moe_groups
+                    )
+                h = h + y.reshape(b, t, d).astype(h.dtype)
+            else:
+                h = h + jax.nn.gelu(x @ blk["up"]) @ blk["down"]
         logits = h[:, -1].astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
         return logits
 

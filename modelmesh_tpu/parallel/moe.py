@@ -1,0 +1,180 @@
+"""Expert parallelism: a mixture-of-experts FFN sharded over the mesh.
+
+The canonical TPU EP recipe (GShard/Switch): tokens live data-parallel on
+each device, expert weights are SHARDED across the ``exp`` mesh axis, and
+two ``all_to_all`` collectives route token slots to the devices owning
+their routed experts and back. Everything between the collectives is a
+dense bf16 einsum over [experts_local, capacity, d] blocks — MXU-shaped,
+no gathers, no dynamic shapes.
+
+Serving context: the model zoo's transformer family uses this as its FFN
+when built with ``ep=1`` and multiple devices are visible
+(models/families.py), the long-context analog of the ``sp=1`` ring
+attention path. No reference counterpart — the reference has no model
+compute at all (SURVEY.md §2.6); this exists because MoE serving is a
+first-class target for a TPU serving framework.
+
+Top-1 (switch) routing with a per-(source device, expert) capacity:
+C = ceil(T_local * capacity_factor / E). Tokens over capacity are
+DROPPED (standard switch behavior) — the residual connection in the
+transformer block carries them through unchanged. The dense oracle
+(``reference_moe``) reproduces the same drops bit-for-bit, so parity
+tests are exact up to bf16 reassociation, not approximate.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+EXPERT_AXIS = "exp"
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts: int):
+    """Router f32 (small, precision matters for argmax stability), expert
+    FFN weights bf16 [E, d, ff] / [E, ff, d]."""
+    kg, k1, k2 = jax.random.split(key, 3)
+    return {
+        "router": jax.random.normal(kg, (d_model, n_experts), jnp.float32)
+        * 0.02,
+        "w_in": jax.random.normal(k1, (n_experts, d_model, d_ff), jnp.bfloat16)
+        / math.sqrt(d_model),
+        "w_out": jax.random.normal(k2, (n_experts, d_ff, d_model), jnp.bfloat16)
+        / math.sqrt(d_ff),
+    }
+
+
+def _route(x, router, n_experts: int, capacity: int):
+    """Top-1 routing with per-expert capacity.
+
+    x: [T, d] -> (dispatch [T, E, C] one-hot, probs [T]) — dispatch[t, e, c]
+    is 1 iff token t is slot c of expert e. Tokens beyond capacity drop.
+    """
+    logits = x.astype(jnp.float32) @ router          # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)              # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]  # [T]
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # [T, E]
+    # Slot index of each token within its expert = exclusive running count.
+    pos = jnp.cumsum(onehot, axis=0) - onehot        # [T, E]
+    slot = jnp.sum(pos * onehot, axis=1).astype(jnp.int32)  # [T]
+    keep = slot < capacity
+    dispatch = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)[:, None, :]
+        * keep[:, None, None]
+    )                                                # [T, E, C]
+    return dispatch, gate
+
+
+def _expert_ffn(blocks, w_in, w_out):
+    """blocks: [E_local, S, d] -> gelu(x @ w_in) @ w_out per local expert,
+    bf16 matmuls with f32 accumulation (MXU-native)."""
+    h = jnp.einsum(
+        "esd,edf->esf", blocks.astype(jnp.bfloat16), w_in,
+        preferred_element_type=jnp.float32,
+    )
+    h = jax.nn.gelu(h).astype(jnp.bfloat16)
+    return jnp.einsum(
+        "esf,efd->esd", h, w_out, preferred_element_type=jnp.float32,
+    )
+
+
+def make_expert_parallel_ffn(
+    mesh: Mesh,
+    n_experts: int,
+    capacity_factor: float = 1.25,
+    axis_name: str = EXPERT_AXIS,
+):
+    """Build ``fn(params, x) -> y`` over [T, d] with experts sharded on
+    ``axis_name``. T and n_experts must divide by the mesh axis size.
+    """
+    n_dev = mesh.shape[axis_name]
+    if n_experts % n_dev:
+        raise ValueError(f"{n_experts} experts not divisible by {n_dev}")
+    e_local = n_experts // n_dev
+
+    def body(params, x):
+        # x: [T_local, d] token-sharded; router replicated; w_in/w_out are
+        # the LOCAL [E_local, ...] expert shards (see in_specs).
+        t_local = x.shape[0]
+        capacity = max(1, math.ceil(t_local * capacity_factor / n_experts))
+        dispatch, gate = _route(x, params["router"], n_experts, capacity)
+        # Dispatch into [E, C, d] slots, then exchange: group the expert
+        # axis as [owner device, local expert] and all_to_all so each
+        # device receives, from every peer, the slots for ITS experts.
+        # (Global expert id e = owner * E_local + k everywhere below.)
+        slots = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+        slots = slots.reshape(n_dev, e_local, capacity, -1)
+        slots = jax.lax.all_to_all(
+            slots, axis_name, split_axis=0, concat_axis=0, tiled=False
+        )                                # [source shard, E_local, C, d]
+        blocks = slots.transpose(1, 0, 2, 3).reshape(
+            e_local, n_dev * capacity, -1
+        )                                # [E_local, all source slots, d]
+        out_blocks = _expert_ffn(blocks, params["w_in"], params["w_out"])
+        back = out_blocks.reshape(e_local, n_dev, capacity, -1).transpose(
+            1, 0, 2, 3
+        )                                # [source shard, E_local, C, d]
+        back = jax.lax.all_to_all(
+            back, axis_name, split_axis=0, concat_axis=0, tiled=False
+        )                                # [owner, E_local, C, d] (ours)
+        back = back.reshape(n_experts, capacity, -1)
+        y = jnp.einsum("tec,ecd->td", dispatch, back)
+        return (y * gate[:, None]).astype(x.dtype)
+
+    shmapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        # Expert weights genuinely SHARDED over the axis (the memory point
+        # of EP: each device holds E/n_dev experts); router replicated.
+        in_specs=(
+            {
+                "router": P(),
+                "w_in": P(axis_name),
+                "w_out": P(axis_name),
+            },
+            P(axis_name, None),
+        ),
+        out_specs=P(axis_name, None),
+        check_vma=False,
+    )
+
+    def fn(params, x):
+        if x.shape[0] % n_dev:
+            raise ValueError(
+                f"token count {x.shape[0]} not divisible by {n_dev} devices"
+            )
+        return jax.jit(shmapped)(params, x)
+
+    return fn
+
+
+def reference_moe(params, x, n_experts: int, capacity_factor: float = 1.25,
+                  n_dev: int = 1):
+    """Single-device oracle with the SAME routing, capacity, and drop
+    semantics as the sharded path on an ``n_dev`` mesh (capacity is
+    per-source-shard there, so the oracle routes each token shard
+    independently). Exact parity up to bf16 reassociation."""
+    shards = jnp.split(x, n_dev, axis=0)
+    outs = []
+    for xs in shards:
+        t_local = xs.shape[0]
+        capacity = max(1, math.ceil(t_local * capacity_factor / n_experts))
+        dispatch, gate = _route(xs, params["router"], n_experts, capacity)
+        slots = jnp.einsum("tec,td->ecd", dispatch, xs.astype(jnp.float32))
+        out_blocks = _expert_ffn(slots, params["w_in"], params["w_out"])
+        y = jnp.einsum("tec,ecd->td", dispatch, out_blocks)
+        outs.append((y * gate[:, None]).astype(xs.dtype))
+    return jnp.concatenate(outs, axis=0)
+
+
+def make_expert_mesh(devices=None, axis_name: str = EXPERT_AXIS) -> Mesh:
+    """1-D expert-parallel mesh over the visible devices."""
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))

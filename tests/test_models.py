@@ -77,6 +77,29 @@ class TestFamilies:
         assert np.abs(b - b2).max() > 1e-3
 
 
+    def test_expert_parallel_transformer_matches_dense(self):
+        """ep=1 swaps the MoE FFN's execution (expert-parallel all_to_all
+        over the device mesh) but not the function: groups=8 pins the
+        routing-capacity shards to the model, so the dense host computes
+        identical drops and the logits agree at bf16 level."""
+        dense = build_model(
+            "moe-model", "transformer",
+            "transformer://d=64,heads=4,seq=64,layers=2,experts=16,groups=8",
+        )
+        ep = build_model(
+            "moe-model", "transformer",
+            "transformer://d=64,heads=4,seq=64,layers=2,experts=16,groups=8,ep=1",
+        )
+        rng = np.random.default_rng(7)
+        tokens = rng.integers(0, 255, (2, 64)).astype(np.int32)
+        a = np.asarray(dense.apply(dense.params, tokens))
+        b = np.asarray(ep.apply(ep.params, tokens))
+        np.testing.assert_allclose(a, b, atol=0.08, rtol=0.08)
+        tokens2 = tokens.copy(); tokens2[:, -1] ^= 1
+        b2 = np.asarray(ep.apply(ep.params, tokens2))
+        assert np.abs(b - b2).max() > 1e-3
+
+
 class TestJaxRuntimeOverGrpc:
     def test_load_infer_unload(self):
         server, port, servicer = start_jax_runtime(capacity_bytes=64 << 20)

@@ -164,6 +164,15 @@ def build_transformer(spec: ModelSpec, model_id: str) -> ServableModel:
     # count, so both shapes serve the same function (bf16-level).
     n_experts = spec.params.get("experts", 0)
     moe_groups = spec.params.get("groups", 1)
+    if n_experts and moe_groups > 1 and seq % moe_groups:
+        # Both the EP path and the dense oracle shard the flattened
+        # [b*seq] token axis into `groups` pieces; a non-dividing group
+        # count would only surface later as an opaque jnp.split trace
+        # error inside apply().
+        raise ValueError(
+            f"transformer spec: groups={moe_groups} must divide "
+            f"seq={seq} (MoE routing capacity is per token-shard)"
+        )
     moe_fn = None
     if spec.params.get("ep", 0) and n_experts:
         n_dev = len(jax.devices())

@@ -143,12 +143,14 @@ def make_expert_parallel_ffn(
         check_vma=False,
     )
 
+    jitted = jax.jit(shmapped)
+
     def fn(params, x):
         if x.shape[0] % n_dev:
             raise ValueError(
                 f"token count {x.shape[0]} not divisible by {n_dev} devices"
             )
-        return jax.jit(shmapped)(params, x)
+        return jitted(params, x)
 
     return fn
 

@@ -77,6 +77,17 @@ class TestFamilies:
         assert np.abs(b - b2).max() > 1e-3
 
 
+    def test_moe_groups_must_divide_seq(self):
+        """A non-dividing group count is a spec error surfaced at build
+        time, not an opaque jnp.split failure inside the jitted apply."""
+        import pytest
+
+        with pytest.raises(ValueError, match="groups=6 must divide"):
+            build_model(
+                "moe-bad", "transformer",
+                "transformer://d=64,heads=4,seq=64,layers=1,experts=8,groups=6",
+            )
+
     def test_expert_parallel_transformer_matches_dense(self):
         """ep=1 swaps the MoE FFN's execution (expert-parallel all_to_all
         over the device mesh) but not the function: groups=8 pins the

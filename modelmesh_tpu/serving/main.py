@@ -29,7 +29,8 @@ log = logging.getLogger("modelmesh_tpu.main")
 
 
 def build_store(kv_uri: str, tls=None):
-    """mesh://host:port | etcd://host:port | memory:// (single process).
+    """mesh://host:port | etcd://host:port | zookeeper://host:port |
+    memory:// (single process).
 
     ``tls`` secures the coordination plane too — registry records carry
     model_key credential blobs, so the KV link deserves the same mTLS as
@@ -47,7 +48,14 @@ def build_store(kv_uri: str, tls=None):
         from modelmesh_tpu.kv.etcd import EtcdKV
 
         return EtcdKV(rest, tls=tls)
-    raise ValueError(f"unknown kv scheme {scheme!r} (mesh://, etcd://, memory://)")
+    if scheme == "zookeeper":
+        from modelmesh_tpu.kv.zookeeper import ZookeeperKV
+
+        return ZookeeperKV(rest, tls=tls)
+    raise ValueError(
+        f"unknown kv scheme {scheme!r} "
+        "(mesh://, etcd://, zookeeper://, memory://)"
+    )
 
 
 def build_loader(runtime: str, capacity_mb: int, tls=None):

@@ -27,17 +27,29 @@ from modelmesh_tpu.kv import (
 )
 
 
-@pytest.fixture(params=["memory", "remote", "etcd"])
+@pytest.fixture(params=["memory", "remote", "etcd", "zookeeper"])
 def kv(request):
     """Every KV test runs against the in-memory store, the gRPC-served
-    RemoteKV, AND the EtcdKV client against the etcd-v3-wire server
-    (kv/etcd_server.py) — the reference's etcd-or-zookeeper matrix, our
-    way. The image carries no etcd binary (zero egress), so the etcd leg
-    exercises the full client wire path against the in-repo etcd-lite."""
+    RemoteKV, the EtcdKV client against the etcd-v3-wire server
+    (kv/etcd_server.py), AND the ZookeeperKV client against the
+    ZooKeeper-jute wire server (kv/zk_server.py) — the reference's
+    etcd-or-zookeeper matrix (AbstractModelMeshTest vs the Zookeeper*
+    test overrides), our way. The image carries no etcd/zk binaries
+    (zero egress), so those legs exercise the full client wire paths
+    against the in-repo protocol servers."""
     if request.param == "memory":
         store = InMemoryKV(sweep_interval_s=0.05)
         yield store
         store.close()
+    elif request.param == "zookeeper":
+        from modelmesh_tpu.kv.zk_server import ZkWireServer
+        from modelmesh_tpu.kv.zookeeper import ZookeeperKV
+
+        server = ZkWireServer().start()
+        client = ZookeeperKV(f"127.0.0.1:{server.port}")
+        yield client
+        client.close()
+        server.stop()
     elif request.param == "remote":
         from modelmesh_tpu.kv.service import RemoteKV, start_kv_server
 

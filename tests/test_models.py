@@ -80,8 +80,6 @@ class TestFamilies:
     def test_moe_groups_must_divide_seq(self):
         """A non-dividing group count is a spec error surfaced at build
         time, not an opaque jnp.split failure inside the jitted apply."""
-        import pytest
-
         with pytest.raises(ValueError, match="groups=6 must divide"):
             build_model(
                 "moe-bad", "transformer",

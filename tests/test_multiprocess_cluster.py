@@ -54,16 +54,24 @@ def _spawn_instance(
     return proc, endpoint
 
 
-@pytest.fixture(scope="module", params=["mesh", "etcd"])
+@pytest.fixture(scope="module", params=["mesh", "etcd", "zookeeper"])
 def procs(request):
-    """The forked-process cluster tier runs against BOTH coordination
-    protocols: MeshKV and the etcd v3 wire (kv/etcd_server.py) — the
-    reference runs every suite against a real etcd child process
-    (AbstractModelMeshTest.java:83-192); the zero-egress CI image has no
-    etcd binary, so the in-repo etcd-wire server stands in."""
+    """The forked-process cluster tier runs against ALL THREE coordination
+    protocols: MeshKV, the etcd v3 wire (kv/etcd_server.py), and the
+    ZooKeeper jute wire (kv/zk_server.py) — the reference runs every
+    suite against a real etcd child process (AbstractModelMeshTest.java:
+    83-192) with ZooKeeper overrides (ZookeeperSidecarModelMeshTest /
+    ZookeeperVModelsTest); the zero-egress CI image has no etcd/zk
+    binaries, so the in-repo protocol servers stand in."""
     scheme = request.param
+    zk = None
     if scheme == "mesh":
         server, kv_port, store = start_kv_server()
+    elif scheme == "zookeeper":
+        from modelmesh_tpu.kv.zk_server import ZkWireServer
+
+        zk = ZkWireServer().start()
+        kv_port = zk.port
     else:
         from modelmesh_tpu.kv.etcd_server import start_etcd_server
 
@@ -79,8 +87,11 @@ def procs(request):
         for proc, _ in spawned:
             if proc.poll() is None:
                 proc.kill()
-        server.stop(0)
-        store.close()
+        if zk is not None:
+            zk.stop()
+        else:
+            server.stop(0)
+            store.close()
 
 
 class TestMultiProcess:

@@ -1,0 +1,366 @@
+"""ZooKeeper backend: wire conformance, watch durability, serving fail-fast.
+
+The reference exercises its second KV backend with dedicated suites
+(ZookeeperSidecarModelMeshTest / ZookeeperVModelsTest mirror the etcd
+defaults; ModelMeshZkFailTest kills the KV store mid-run). The shared
+KVStore contract already runs against ZookeeperKV via the tests/test_kv.py
+backend matrix and the forked-process cluster via tests/
+test_multiprocess_cluster.py; this file covers the ZK-specific seams:
+jute wire details, one-shot-watch healing across server restarts, and the
+serving instance's fail-fast behavior through a REAL ZK outage.
+"""
+
+import socket
+import time
+
+import pytest
+
+from modelmesh_tpu.kv.store import Compare, EventType, Op
+from modelmesh_tpu.kv.zk_server import ZkWireServer
+from modelmesh_tpu.kv.zookeeper import ZookeeperKV
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def zk():
+    server = ZkWireServer().start()
+    client = ZookeeperKV(f"127.0.0.1:{server.port}")
+    yield client, server
+    client.close()
+    server.stop()
+
+
+class TestWireConformance:
+    def test_key_escaping_roundtrip(self, zk):
+        kv, _ = zk
+        # "/" nests in ZK; the flat mapping must escape it (and the escape
+        # char itself) losslessly.
+        keys = ["a/b/c", "a%2Fb", "100%", "%25", "plain"]
+        for i, k in enumerate(keys):
+            kv.put(k, str(i).encode())
+        assert sorted(x.key for x in kv.range("")) == sorted(keys)
+        for i, k in enumerate(keys):
+            assert kv.get(k).value == str(i).encode()
+
+    def test_zxid_is_a_global_revision(self, zk):
+        kv, _ = zk
+        a = kv.put("r/a", b"1")
+        b = kv.put("r/b", b"2")
+        c = kv.put("r/a", b"3")
+        # Strictly increasing across keys (global), create_rev pinned.
+        assert a.mod_rev < b.mod_rev < c.mod_rev
+        assert c.create_rev == a.create_rev
+
+    def test_failed_txn_applies_nothing(self, zk):
+        kv, _ = zk
+        kv.put("t/a", b"1")
+        ok, _ = kv.txn(
+            [Compare("t/a", 1), Compare("t/missing", 3)],
+            [Op("t/a", b"CLOBBER"), Op("t/new", b"x")],
+        )
+        assert not ok
+        assert kv.get("t/a").value == b"1"
+        assert kv.get("t/new") is None
+
+    def test_txn_multi_key_promotion_shape(self, zk):
+        """The vmodel-promotion shape: two guarded updates + one guarded
+        create ride a single multi (VModelManager's atomic txn)."""
+        kv, _ = zk
+        kv.put("v/meta", b"m1")
+        kv.put("v/active", b"old")
+        ok, results = kv.txn(
+            [Compare("v/meta", 1), Compare("v/active", 1),
+             Compare("v/pending", 0)],
+            [Op("v/meta", b"m2"), Op("v/active", b"new"),
+             Op("v/pending", b"queued")],
+        )
+        assert ok
+        assert {r.key for r in results} == {"v/meta", "v/active", "v/pending"}
+        assert kv.get("v/active").value == b"new"
+        assert kv.get("v/pending").version == 1
+
+    def test_ephemeral_rebinds_to_new_lease(self, zk):
+        """etcd put-with-lease re-binds ownership; the ZK mapping recreates
+        the ephemeral under the new session atomically."""
+        kv, _ = zk
+        lease1 = kv.lease_grant(5.0)
+        kv.put("inst/i1", b"gen1", lease=lease1)
+        lease2 = kv.lease_grant(5.0)
+        rebound = kv.put("inst/i1", b"gen2", lease=lease2)
+        assert rebound.lease == lease2
+        # Revoking the OLD lease must not kill the rebound key.
+        kv.lease_revoke(lease1)
+        time.sleep(0.2)
+        got = kv.get("inst/i1")
+        assert got is not None and got.value == b"gen2"
+        kv.lease_revoke(lease2)
+        time.sleep(0.2)
+        assert kv.get("inst/i1") is None
+
+    def test_same_lease_republish_is_a_plain_update(self, zk):
+        """SessionNode.update's heartbeat path: re-putting under the SAME
+        lease must be a setData — no spurious DELETE for watch-fed
+        liveness views, and the version counter keeps climbing (review
+        regression: delete+create reset it to 1, defeating TableView's
+        stale-replay guard)."""
+        kv, _ = zk
+        lease = kv.lease_grant(5.0)
+        got = []
+        kv.watch("hb/", lambda evs: got.extend(evs))
+        kv.put("hb/i1", b"gen1", lease=lease)
+        updated = kv.put("hb/i1", b"gen2", lease=lease)
+        assert updated.version == 2
+        assert updated.value == b"gen2"
+        kv.wait_idle()
+        assert all(e.type == EventType.PUT for e in got), got
+        kv.lease_revoke(lease)
+
+    def test_txn_failure_branch_applies(self, zk):
+        """The else-branch of the txn contract (kv/store.py): guard fails
+        -> on_failure ops run and their KeyValues are returned (review
+        regression: the branch raised AttributeError)."""
+        kv, _ = zk
+        kv.put("f/a", b"1")
+        ok, results = kv.txn(
+            [Compare("f/a", 99)],
+            [Op("f/a", b"CLOBBER")],
+            [Op("f/marker", b"fallback"), Op("f/a", None)],
+        )
+        assert not ok
+        assert kv.get("f/marker").value == b"fallback"
+        assert kv.get("f/a") is None  # failure-branch delete applied
+        assert any(r.key == "f/marker" for r in results)
+
+    def test_unleased_put_detaches_lease(self, zk):
+        """etcd/InMemoryKV contract: a plain put on a leased key detaches
+        the lease — the key must survive the old lease's expiry (review
+        regression: setData left the node ephemeral)."""
+        kv, _ = zk
+        lease = kv.lease_grant(0.3)
+        kv.put("d/k", b"owned", lease=lease)
+        persisted = kv.put("d/k", b"forever")  # lease=0
+        assert persisted.lease == 0
+        kv.lease_revoke(lease)
+        time.sleep(0.3)
+        got = kv.get("d/k")
+        assert got is not None and got.value == b"forever"
+
+    def test_watches_survive_data_plane_reconnect(self, zk):
+        """If a get/put thread wins the reconnect race, the dispatcher
+        must still notice the session swap and re-arm the mirror's
+        watches (review regression: it only resynced when IT observed
+        the dead session, leaving watches permanently silent)."""
+        kv, _ = zk
+        got = []
+        kv.watch("rw/", lambda evs: got.extend(evs))
+        kv.put("rw/a", b"1")
+        kv.wait_idle()
+        assert any(e.kv.key == "rw/a" for e in got)
+        # Sever the client's socket only (server stays up); then win the
+        # reconnect from the data plane before the dispatcher notices.
+        kv._session._sock.shutdown(socket.SHUT_RDWR)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                kv.get("rw/a")
+                break
+            except ConnectionError:
+                time.sleep(0.02)
+        kv.put("rw/b", b"2")
+        deadline = time.monotonic() + 10
+        while (
+            not any(e.kv.key == "rw/b" for e in got)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert any(e.kv.key == "rw/b" for e in got), (
+            "watch went silent after a data-plane reconnect"
+        )
+
+    def test_txn_header_error_is_classified_not_failed(self, zk):
+        """A real ensemble reports a failed multi via the ReplyHeader err
+        (not OK + error results like the in-repo server). A stale-probe
+        race surfacing that way must be retried, not misreported as a
+        guard failure (review regression)."""
+        from modelmesh_tpu.kv import jute as _jute
+        from modelmesh_tpu.kv.zookeeper import _ZkReplyError
+
+        kv, _ = zk
+        kv.put("hc/a", b"1")
+        real_req = kv._req
+        tripped = []
+
+        def flaky_req(op, payload, timeout=30.0):
+            if op == _jute.OP_MULTI and not tripped:
+                tripped.append(True)
+                raise _ZkReplyError(_jute.ERR_NODE_EXISTS)
+            return real_req(op, payload, timeout)
+
+        kv._req = flaky_req
+        try:
+            ok, _res = kv.txn([Compare("hc/a", 1)], [Op("hc/b", b"2")])
+        finally:
+            kv._req = real_req
+        assert tripped, "simulated header error never hit"
+        assert ok, "holding guard misreported as failed on header error"
+        assert kv.get("hc/b").value == b"2"
+
+    def test_value_size_limit_enforced(self, zk):
+        kv, _ = zk
+        limit = kv.max_value_bytes()
+        assert limit is not None
+        with pytest.raises(ValueError):
+            kv.put("big", b"x" * (limit + 1))
+
+    def test_sessions_expire_on_silence(self, zk):
+        kv, server = zk
+        lease = kv.lease_grant(0.2)
+        kv.put("eph/silent", b"v", lease=lease)
+        time.sleep(1.0)  # no keepalives
+        assert kv.get("eph/silent") is None
+        assert kv.lease_keepalive(lease) is False
+        # The server also dropped the session record itself.
+        assert lease not in server.state.sessions
+
+
+class TestWatchDurability:
+    def test_watch_survives_server_restart(self):
+        """One-shot ZK watches + a dead session must still yield a live
+        view: the client re-establishes the session and resyncs its
+        mirror, synthesizing events for the outage gap (the ZK analog of
+        tests/test_kv_reconnect.py for MeshKV)."""
+        port = _free_port()
+        server = ZkWireServer(port=port).start()
+        client = ZookeeperKV(f"127.0.0.1:{port}", session_timeout_ms=2000)
+        got = []
+        try:
+            client.watch("w/", lambda evs: got.extend(evs))
+            client.put("w/a", b"1")
+            client.put("w/drop", b"1")
+            client.wait_idle()
+            assert any(e.kv.key == "w/a" for e in got)
+
+            server._tcp.shutdown()
+            server._tcp.server_close()
+            server.stopping.set()
+            time.sleep(0.2)
+            # Mutate the preserved tree while the client is disconnected
+            # (an ensemble reboot that kept its data directory).
+            state = server.state
+            admin = state.open_session(60_000)
+            with state.lock:
+                state.zxid += 1
+                state._create_node("/w%2Fb", b"2", 0, admin)
+                state.zxid += 1
+                state._delete_node("/w%2Fdrop")
+            server2 = ZkWireServer(port=port, state=state).start()
+            try:
+                deadline = time.monotonic() + 15
+                while time.monotonic() < deadline and not (
+                    any(e.kv.key == "w/b" for e in got)
+                    and any(
+                        e.kv.key == "w/drop" and e.type.value == "delete"
+                        for e in got
+                    )
+                ):
+                    time.sleep(0.1)
+                assert any(e.kv.key == "w/b" for e in got), (
+                    "offline PUT lost in resync"
+                )
+                assert any(
+                    e.kv.key == "w/drop" and e.type.value == "delete"
+                    for e in got
+                ), "offline DELETE not synthesized in resync"
+                # Live stream keeps flowing on the healed session.
+                client.put("w/c", b"3")
+                deadline = time.monotonic() + 10
+                while (
+                    not any(e.kv.key == "w/c" for e in got)
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.1)
+                assert any(e.kv.key == "w/c" for e in got)
+            finally:
+                server2.stop()
+        finally:
+            client.close()
+
+
+class TestZkFailFast:
+    def test_zk_outage_fails_fast_then_heals(self):
+        """ModelMeshZkFailTest analog: kill the KV store under a live
+        serving instance — requests fail fast with UNAVAILABLE instead of
+        hanging; after the ensemble returns (same tree), the instance
+        heals and serves both old and new registrations."""
+        from modelmesh_tpu.runtime.fake import (
+            PREDICT_METHOD,
+            FakeRuntimeServicer,
+            start_fake_runtime,
+        )
+        from modelmesh_tpu.runtime.sidecar import SidecarRuntime
+        from modelmesh_tpu.serving.errors import ServiceUnavailableError
+        from modelmesh_tpu.serving.instance import (
+            InstanceConfig,
+            ModelMeshInstance,
+        )
+        from modelmesh_tpu.runtime import ModelInfo
+
+        port = _free_port()
+        server = ZkWireServer(port=port).start()
+        store = ZookeeperKV(f"127.0.0.1:{port}", session_timeout_ms=2000)
+        rt_server, rt_port, _ = start_fake_runtime(
+            servicer=FakeRuntimeServicer(capacity_bytes=64 << 20)
+        )
+        loader = SidecarRuntime(f"127.0.0.1:{rt_port}", startup_timeout_s=10)
+        inst = ModelMeshInstance(
+            store, loader,
+            InstanceConfig(instance_id="i-zkff", load_timeout_s=10,
+                           min_churn_age_ms=0),
+        )
+        info = ModelInfo(model_type="example", model_path="mem://zkff")
+        server2 = None
+        try:
+            inst.register_model("m-pre", info)
+            out = inst.invoke_model("m-pre", PREDICT_METHOD, b"x", [])
+            assert out.payload.startswith(b"m-pre:")
+
+            # Kill the ensemble (state preserved, port freed).
+            server._tcp.shutdown()
+            server._tcp.server_close()
+            server.stopping.set()
+            time.sleep(0.2)
+
+            # Unknown model + dead KV -> UNAVAILABLE, quickly.
+            t0 = time.monotonic()
+            with pytest.raises(ServiceUnavailableError):
+                inst.invoke_model("m-unknown", PREDICT_METHOD, b"x", [])
+            assert time.monotonic() - t0 < 5.0
+            # Fail-fast cooldown: immediate rejection without a KV trip.
+            t0 = time.monotonic()
+            with pytest.raises(ServiceUnavailableError):
+                inst.invoke_model("m-unknown", PREDICT_METHOD, b"x", [])
+            assert time.monotonic() - t0 < 0.5
+
+            # Ensemble returns with the same tree.
+            server2 = ZkWireServer(port=port, state=server.state).start()
+            inst._kv_failfast.clear()
+            # Old registration survived the outage...
+            out = inst.invoke_model("m-pre", PREDICT_METHOD, b"x", [])
+            assert out.payload.startswith(b"m-pre:")
+            # ...and new ones work end to end.
+            inst.register_model("m-post", info)
+            out = inst.invoke_model("m-post", PREDICT_METHOD, b"x", [])
+            assert out.payload.startswith(b"m-post:")
+        finally:
+            inst.shutdown()
+            rt_server.stop(0)
+            store.close()
+            if server2 is not None:
+                server2.stop()

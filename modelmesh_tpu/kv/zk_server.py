@@ -38,6 +38,7 @@ from modelmesh_tpu.kv.jute import (
     ERR_NOT_EMPTY,
     ERR_OK,
     ERR_RUNTIME_INCONSISTENCY,
+    ERR_SESSION_EXPIRED,
     EV_NODE_CHILDREN_CHANGED,
     EV_NODE_CREATED,
     EV_NODE_DATA_CHANGED,
@@ -199,6 +200,15 @@ class ZkState:
                     pass
         return expired
 
+    def check_live(self, s: "_Session") -> None:
+        """Raise SESSIONEXPIRED if ``s`` was closed. Must be called INSIDE
+        self.lock before any mutation: the cheap closed-check in _dispatch
+        runs unlocked, so the reaper can expire the session between it and
+        the mutation — an ephemeral created after the expiry sweep would
+        be owned by a dead session and leak forever."""
+        if s.closed or s.sid not in self.sessions:
+            raise _ZkError(ERR_SESSION_EXPIRED)
+
     # -- watch plumbing ----------------------------------------------------
 
     def _arm(self, table: dict[str, set[_Session]], path: str,
@@ -270,11 +280,27 @@ class ZkState:
 
     def _check_create(self, path: str, flags: int,
                       staged_creates: set[str],
-                      staged_deletes: set[str]) -> None:
+                      staged_deletes: set[str],
+                      staged_ephemerals: set[str] = frozenset()) -> None:
         _validate_path(path)
         parent = _parent(path)
-        if parent not in self.nodes and parent not in staged_creates:
+        parent_live = (
+            (parent in self.nodes and parent not in staged_deletes)
+            or parent in staged_creates
+        )
+        if not parent_live:
+            # Includes a parent staged for deletion earlier in the SAME
+            # multi: phase 1 must reject it, or phase 2 would raise
+            # mid-apply after the delete already landed (atomicity).
             raise _ZkError(ERR_NO_NODE)
+        pnode = self.nodes.get(parent)
+        parent_ephemeral = (
+            parent in staged_ephemerals
+            or (parent not in staged_creates
+                and pnode is not None and pnode.ephemeral_owner != 0)
+        )
+        if parent_ephemeral:
+            raise _ZkError(ERR_BAD_ARGUMENTS)  # ephemerals have no children
         if not flags & FLAG_SEQUENCE:
             exists = (path in self.nodes or path in staged_creates)
             if exists and path not in staged_deletes:
@@ -424,6 +450,7 @@ class _ZkConnHandler(socketserver.BaseRequestHandler):
             read_acl_vector(r)
             flags = r.int32()
             with state.lock:
+                state.check_live(s)
                 state._check_create(path, flags, set(), set())
                 state.zxid += 1
                 actual = state._create_node(path, data, flags, s)
@@ -436,6 +463,7 @@ class _ZkConnHandler(socketserver.BaseRequestHandler):
             path = r.string()
             version = r.int32()
             with state.lock:
+                state.check_live(s)
                 state._check_delete(path, version, set())
                 state.zxid += 1
                 state._delete_node(path)
@@ -445,6 +473,7 @@ class _ZkConnHandler(socketserver.BaseRequestHandler):
             data = r.buffer()
             version = r.int32()
             with state.lock:
+                state.check_live(s)
                 state._check_set(path, version, set())
                 state.zxid += 1
                 node = state._set_data(path, data)
@@ -543,19 +572,26 @@ class _ZkConnHandler(socketserver.BaseRequestHandler):
                 raise _ZkError(ERR_BAD_ARGUMENTS)
 
         with state.lock:
+            state.check_live(s)
             # Phase 1: validate (sequential semantics via staged sets).
             staged_creates: set[str] = set()
             staged_deletes: set[str] = set()
+            staged_ephemerals: set[str] = set()
             fail_idx, fail_code = -1, ERR_OK
             for i, rec in enumerate(ops):
                 try:
                     if rec[0] in (OP_CREATE, OP_CREATE2):
                         _, path, _, flags = rec
                         state._check_create(
-                            path, flags, staged_creates, staged_deletes
+                            path, flags, staged_creates, staged_deletes,
+                            staged_ephemerals,
                         )
                         staged_creates.add(path)
                         staged_deletes.discard(path)
+                        if flags & FLAG_EPHEMERAL:
+                            staged_ephemerals.add(path)
+                        else:
+                            staged_ephemerals.discard(path)
                     elif rec[0] == OP_DELETE:
                         _, path, version = rec
                         state._check_delete(
@@ -563,6 +599,7 @@ class _ZkConnHandler(socketserver.BaseRequestHandler):
                         )
                         staged_deletes.add(path)
                         staged_creates.discard(path)
+                        staged_ephemerals.discard(path)
                     elif rec[0] == OP_SET_DATA:
                         _, path, _, version = rec
                         state._check_set(

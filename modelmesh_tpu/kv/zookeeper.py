@@ -624,20 +624,19 @@ class ZookeeperKV(KVStore):
             w_h.write(w)
             w.string(_esc(c.key)).int32(c.version - 1)
 
-        # Probe existence only for ops whose shape isn't pinned by a compare.
-        probed: dict[str, bool] = {}
+        # Probe current state for every op key (shape + lease ownership;
+        # compares guard correctness, the probe only picks op shapes —
+        # a stale probe fails the multi with NoNode/NodeExists -> retry).
+        probed: dict[str, Optional[KeyValue]] = {}
         for op in ops:
             if op.key in must_absent:
                 continue
-            if any(c.key == op.key and c.version > 0 for c in compares):
-                probed[op.key] = True
-            else:
-                probed[op.key] = self.get(op.key) is not None
+            probed[op.key] = self.get(op.key)
 
         for op in ops:
+            cur = probed.get(op.key)
             if op.value is None:
-                exists = probed.get(op.key, False)
-                if op.key in must_absent or not exists:
+                if op.key in must_absent or cur is None:
                     # etcd deletes of absent keys are a no-op; ZK would
                     # fail the multi with NoNode, so the op is elided (the
                     # compares still guard the decision, and a race shows
@@ -645,12 +644,27 @@ class ZookeeperKV(KVStore):
                     continue
                 MultiHeader(OP_DELETE, False, -1).write(w)
                 w.string(_esc(op.key)).int32(-1)
-            elif op.key in must_absent or not probed.get(op.key, False):
+            elif op.key in must_absent or cur is None:
                 MultiHeader(OP_CREATE2, False, -1).write(w)
                 w.string(_esc(op.key)).buffer(op.value)
                 write_acl_vector(w)
                 w.int32(FLAG_EPHEMERAL if op.lease else 0)
                 creates_for.add(op.key)
+            elif op.lease or cur.lease:
+                # Ownership changes on an EXISTING key (bind to a lease,
+                # rebind to another, or DETACH on an unleased put — the
+                # etcd/InMemoryKV txn semantics) cannot ride a setData:
+                # ZK fixes ephemerality at creation, so the pair deletes
+                # and recreates with the target flags. Residual TOCTOU:
+                # an ownership change between probe and multi keeps the
+                # setData shape only when BOTH sides are unleased, where
+                # it is also correct.
+                MultiHeader(OP_DELETE, False, -1).write(w)
+                w.string(_esc(op.key)).int32(-1)
+                MultiHeader(OP_CREATE2, False, -1).write(w)
+                w.string(_esc(op.key)).buffer(op.value)
+                write_acl_vector(w)
+                w.int32(FLAG_EPHEMERAL if op.lease else 0)
             else:
                 MultiHeader(OP_SET_DATA, False, -1).write(w)
                 w.string(_esc(op.key)).buffer(op.value).int32(-1)

@@ -29,7 +29,17 @@ def now_ms() -> int:
 
 
 # Load-failure bookkeeping windows (reference: ModelMesh.java:219-224).
+# Overridable via MM_LOAD_FAILURE_EXPIRY_MS (the reference exposes its
+# time heuristics as system properties the same way — SURVEY.md section 4);
+# read through failure_expiry_ms() (utils/envs registry accessor, live per
+# call) so tests/operators can adjust at runtime.
 LOAD_FAILURE_EXPIRY_MS = 15 * 60 * 1000
+
+
+def failure_expiry_ms() -> int:
+    from modelmesh_tpu.utils import envs
+
+    return envs.get_int("MM_LOAD_FAILURE_EXPIRY_MS") or LOAD_FAILURE_EXPIRY_MS
 MAX_LOAD_FAILURES = 3
 MAX_LOAD_LOCATIONS = 5
 
@@ -96,10 +106,11 @@ class ModelRecord(Record):
 
     def expire_load_failures(
         self, now: Optional[int] = None,
-        expiry_ms: int = LOAD_FAILURE_EXPIRY_MS,
+        expiry_ms: Optional[int] = None,
     ) -> bool:
         """Drop stale failure entries; returns True if anything changed."""
         now = now if now is not None else now_ms()
+        expiry_ms = expiry_ms if expiry_ms is not None else failure_expiry_ms()
         stale = [
             iid for iid, (ts, _msg) in self.load_failures.items()
             if now - ts > expiry_ms
@@ -108,19 +119,25 @@ class ModelRecord(Record):
             del self.load_failures[iid]
         return bool(stale)
 
-    def active_failure_count(self, now: Optional[int] = None) -> int:
+    def active_failures(self, now: Optional[int] = None) -> set[str]:
+        """Instance ids with a NON-expired load failure (one expiry read
+        for the whole set — the routing hot path calls this per miss)."""
         now = now if now is not None else now_ms()
-        return sum(
-            1 for ts, _ in self.load_failures.values()
-            if now - ts <= LOAD_FAILURE_EXPIRY_MS
-        )
+        expiry = failure_expiry_ms()
+        return {
+            iid for iid, (ts, _msg) in self.load_failures.items()
+            if now - ts <= expiry
+        }
+
+    def active_failure_count(self, now: Optional[int] = None) -> int:
+        return len(self.active_failures(now))
 
     def failed_on(self, instance_id: str, now: Optional[int] = None) -> bool:
         entry = self.load_failures.get(instance_id)
         if entry is None:
             return False
         now = now if now is not None else now_ms()
-        return now - entry[0] <= LOAD_FAILURE_EXPIRY_MS
+        return now - entry[0] <= failure_expiry_ms()
 
     def load_exhausted(self, now: Optional[int] = None) -> bool:
         """Too many failures or too many attempted locations

@@ -649,6 +649,28 @@ class ModelMeshInstance:
             if mr is None:
                 raise ModelNotFoundError(model_id)
 
+            # Registration-out-of-date self-heal: the record lists a copy
+            # on THIS instance but the cache has none (lost to a KV-outage
+            # load crash, an eviction race, or a restart under a preserved
+            # registry). Unpruned, the serve loop skips self and the miss
+            # loop hard-excludes self via all_placements — a one-instance
+            # cluster could never serve the model again. The reference's
+            # hit loop prunes its own stale registration the same way.
+            if (
+                not skip_local
+                and (
+                    self.instance_id in mr.instance_ids
+                    or self.instance_id in mr.loading_instances
+                )
+                and (ce is None or ce.state is EntryState.REMOVED)
+            ):
+                # Covers stale LOADING claims too: a load that crashed
+                # into a KV outage leaves its claim in loading_instances
+                # with no cache entry behind it. The cache insert precedes
+                # the registry claim in _load_local, so a genuinely
+                # in-flight local load (ce present) is never pruned.
+                mr = self._prune_stale_self(model_id) or mr
+
             if ctx.hop == RoutingContext.LOAD_LOCAL_ONLY:
                 ce = self._load_local(model_id, mr, ctx)
                 if ce is None:
@@ -694,8 +716,11 @@ class ModelMeshInstance:
             # Hard exclusions forbid loading there at all; visited peers are
             # additionally excluded from *forward* targets (loop prevention)
             # but do not forbid loading on ourselves.
+            # Failure exclusion is time-aware: an entry past
+            # MM_LOAD_FAILURE_EXPIRY_MS stops excluding immediately,
+            # without waiting for the leader reaper to prune the record.
             hard_exclude = (
-                ctx.exclude_load | mr.all_placements | set(mr.load_failures)
+                ctx.exclude_load | mr.all_placements | mr.active_failures()
             )
             views = self.instances_view.items()
             if self.constraints is not None:
@@ -1256,6 +1281,34 @@ class ModelMeshInstance:
         after shutdown there is nothing left worth cleaning.)"""
         if not self._unload_pool.submit(fn):
             threading.Thread(target=fn, daemon=True).start()
+
+    def _prune_stale_self(self, model_id: str) -> Optional["ModelRecord"]:
+        """Drop OUR stale entry from a record's loaded set (cache disagrees
+        with the registry about us). Returns the updated record, or None
+        when the CAS gave up — the caller keeps its current view and the
+        next iteration (or the reaper) retries."""
+
+        def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
+            if cur is None:
+                return None
+            was_loaded = cur.instance_ids.pop(self.instance_id, None)
+            was_loading = cur.loading_instances.pop(self.instance_id, None)
+            if was_loaded is not None or was_loading is not None:
+                log.info(
+                    "pruned stale self-%s of %s (registry disagrees with "
+                    "the local cache)",
+                    "registration" if was_loaded is not None
+                    else "loading claim", model_id,
+                )
+            return cur
+
+        try:
+            return self.registry.update_or_create(model_id, mutate)
+        except CasFailed:
+            log.warning("stale-self prune CAS gave up for %s", model_id)
+            return None
+        except Exception:  # noqa: BLE001 - KV outage: fail-fast covers it
+            return None
 
     def _deregister(self, model_id: str, record_unload_time: bool = False) -> None:
         def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:

@@ -10,6 +10,18 @@ via modelmesh_tpu.runtime.fake's __main__).
 from __future__ import annotations
 
 import dataclasses
+import socket
+
+
+def free_port() -> int:
+    """Bind-port-0 helper shared by restart tests that need a FIXED port
+    to bring a server back on."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
 
 from modelmesh_tpu.kv import InMemoryKV
 from modelmesh_tpu.runtime.fake import FakeRuntimeServicer, start_fake_runtime

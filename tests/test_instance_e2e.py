@@ -87,6 +87,28 @@ class TestLifecycle:
         assert out2.payload.startswith(b"m-demand:")
         assert servicer.load_count == loads
 
+    def test_stale_self_registration_heals_on_invoke(self, mesh):
+        """Registry says a copy lives HERE, but the cache has none (lost
+        to a KV-outage load crash or a restart under a preserved
+        registry). The invoke must prune the stale self-entry and load a
+        fresh copy instead of hard-excluding itself via all_placements —
+        on a one-instance cluster that exclusion was permanent
+        (regression for the etcd/zk outage-heal tests)."""
+        inst, servicer, _ = mesh
+        inst.register_model("m-stale", INFO)
+
+        def corrupt(cur):
+            cur.instance_ids[inst.instance_id] = 12345
+            return cur
+
+        inst.registry.update_or_create("m-stale", corrupt)
+        assert inst.cache.get("m-stale") is None
+        out = inst.invoke_model("m-stale", PREDICT_METHOD, b"x", [])
+        assert out.payload.startswith(b"m-stale:")
+        mr = inst.registry.get("m-stale")
+        # The healed record reflects the REAL copy (fresh timestamp).
+        assert mr.instance_ids[inst.instance_id] != 12345
+
     def test_mass_deletion_cleanup_is_bounded(self):
         """Wiping many registered+cached models must drain through the small
         shared cleanup pool — not spawn one thread per deleted model

@@ -5,26 +5,21 @@ client replays from its last-seen revision) must not leave client-side
 watch-fed views frozen.
 """
 
-import socket
 import time
 
 import pytest
+
+from cluster_util import free_port
 
 from modelmesh_tpu.kv.memory import InMemoryKV
 from modelmesh_tpu.kv.service import RemoteKV, start_kv_server
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 class TestWatchReconnect:
     def test_watch_survives_server_restart(self):
-        port = _free_port()
+        port = free_port()
         backing = InMemoryKV(sweep_interval_s=0.05)
         server, _, _ = start_kv_server(port=port, store=backing)
         client = RemoteKV(f"127.0.0.1:{port}")

@@ -247,37 +247,50 @@ class TestSharedFrontDoor:
             ), timeout=60)
             assert st.status == apb.LOADED
             ch.close()
-            # Fresh channel per request: each new TCP connection may land
-            # on either worker (kernel 4-tuple hash). The serving-identity
-            # trailers prove BOTH workers take front-door connections and
-            # that a miss actually rides the internal forward — without
-            # them this test could pass with every connection landing on
-            # the owner, never exercising the path it exists for.
+            # CONCURRENT channels, one per connection: serial
+            # connect-close-connect tends to reuse the just-freed source
+            # port, so the kernel's 4-tuple reuseport hash picks the SAME
+            # worker every time (observed: 40/40 on one worker). Held-open
+            # channels get distinct source ports and genuinely spread.
+            # The serving-identity trailers prove BOTH workers take
+            # front-door connections and that a miss actually rides the
+            # internal forward — without them this test could pass with
+            # every connection landing on the owner, never exercising the
+            # path it exists for.
             entries, forwards = set(), 0
-            for i in range(40):
-                chi = grpc.insecure_channel(shared)
-                out, call = grpc_defs.raw_method(chi, PREDICT_METHOD).with_call(
-                    f"p{i}".encode(),
-                    metadata=[("mm-model-id", "fd-model")], timeout=30,
+            chans = [
+                grpc.insecure_channel(
+                    shared,
+                    options=[("grpc.use_local_subchannel_pool", 1)],
                 )
-                assert out.startswith(b"fd-model:"), out[:40]
-                md = dict(call.trailing_metadata() or ())
-                entry = md.get("mm-entry-instance", "")
-                served = md.get("mm-served-by", "")
-                assert served, "missing mm-served-by trailer"
-                entries.add(entry)
-                if entry != served:
-                    forwards += 1
-                sti = grpc_defs.make_stub(
-                    chi, grpc_defs.API_SERVICE, grpc_defs.API_METHODS
-                ).GetModelStatus(
-                    apb.GetModelStatusRequest(model_id="fd-model"),
-                    timeout=10,
-                )
-                assert sti.status == apb.LOADED
-                chi.close()
-                if len(entries) == 2 and forwards:
-                    break
+                for _ in range(16)
+            ]
+            try:
+                for i, chi in enumerate(chans):
+                    out, call = grpc_defs.raw_method(
+                        chi, PREDICT_METHOD
+                    ).with_call(
+                        f"p{i}".encode(),
+                        metadata=[("mm-model-id", "fd-model")], timeout=30,
+                    )
+                    assert out.startswith(b"fd-model:"), out[:40]
+                    md = dict(call.trailing_metadata() or ())
+                    entry = md.get("mm-entry-instance", "")
+                    served = md.get("mm-served-by", "")
+                    assert served, "missing mm-served-by trailer"
+                    entries.add(entry)
+                    if entry != served:
+                        forwards += 1
+                    sti = grpc_defs.make_stub(
+                        chi, grpc_defs.API_SERVICE, grpc_defs.API_METHODS
+                    ).GetModelStatus(
+                        apb.GetModelStatusRequest(model_id="fd-model"),
+                        timeout=10,
+                    )
+                    assert sti.status == apb.LOADED
+            finally:
+                for chi in chans:
+                    chi.close()
             assert entries == {"fd-0", "fd-1"}, (
                 f"kernel never spread connections: entries={entries}"
             )

@@ -650,15 +650,17 @@ class ZookeeperKV(KVStore):
                 write_acl_vector(w)
                 w.int32(FLAG_EPHEMERAL if op.lease else 0)
                 creates_for.add(op.key)
-            elif op.lease or cur.lease:
-                # Ownership changes on an EXISTING key (bind to a lease,
+            elif cur.lease != op.lease:
+                # Ownership CHANGES on an EXISTING key (bind to a lease,
                 # rebind to another, or DETACH on an unleased put — the
                 # etcd/InMemoryKV txn semantics) cannot ride a setData:
                 # ZK fixes ephemerality at creation, so the pair deletes
-                # and recreates with the target flags. Residual TOCTOU:
-                # an ownership change between probe and multi keeps the
-                # setData shape only when BOTH sides are unleased, where
-                # it is also correct.
+                # and recreates with the target flags. A SAME-lease
+                # republish falls through to setData below — no spurious
+                # DELETE/version reset for watch-fed liveness views.
+                # Residual TOCTOU: an ownership change between probe and
+                # multi keeps the setData shape only when both sides
+                # agree, where it is also correct.
                 MultiHeader(OP_DELETE, False, -1).write(w)
                 w.string(_esc(op.key)).int32(-1)
                 MultiHeader(OP_CREATE2, False, -1).write(w)

@@ -39,7 +39,11 @@ LOAD_FAILURE_EXPIRY_MS = 15 * 60 * 1000
 def failure_expiry_ms() -> int:
     from modelmesh_tpu.utils import envs
 
-    return envs.get_int("MM_LOAD_FAILURE_EXPIRY_MS") or LOAD_FAILURE_EXPIRY_MS
+    # No falsy fallback: an explicit 0 means "failures expire immediately"
+    # (re-load exclusion disabled), which must be honored.
+    return envs.get_int("MM_LOAD_FAILURE_EXPIRY_MS")
+
+
 MAX_LOAD_FAILURES = 3
 MAX_LOAD_LOCATIONS = 5
 

@@ -1288,22 +1288,32 @@ class ModelMeshInstance:
         when the CAS gave up — the caller keeps its current view and the
         next iteration (or the reaper) retries."""
 
+        class _NothingToPrune(Exception):
+            pass
+
         def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
             if cur is None:
                 return None
             was_loaded = cur.instance_ids.pop(self.instance_id, None)
             was_loading = cur.loading_instances.pop(self.instance_id, None)
-            if was_loaded is not None or was_loading is not None:
-                log.info(
-                    "pruned stale self-%s of %s (registry disagrees with "
-                    "the local cache)",
-                    "registration" if was_loaded is not None
-                    else "loading claim", model_id,
-                )
+            if was_loaded is None and was_loading is None:
+                # The trigger came from a lagging watch view; the REAL
+                # record is already clean. Abort instead of CAS-writing
+                # identical content (version bump + spurious cluster-wide
+                # watch event), and hand the fresh record back.
+                raise _NothingToPrune(cur)
+            log.info(
+                "pruned stale self-%s of %s (registry disagrees with "
+                "the local cache)",
+                "registration" if was_loaded is not None
+                else "loading claim", model_id,
+            )
             return cur
 
         try:
             return self.registry.update_or_create(model_id, mutate)
+        except _NothingToPrune as e:
+            return e.args[0]
         except CasFailed:
             log.warning("stale-self prune CAS gave up for %s", model_id)
             return None

@@ -83,7 +83,7 @@ REGISTRY: dict[str, EnvVar] = {
                "(accepts 1/0, true/false, yes/no, on/off; cardinality "
                "opt-in, reference's per-model flag)",
                "serving/main.py"),
-        EnvVar("MM_LOAD_FAILURE_EXPIRY_MS", "int", "900000",
+        EnvVar("MM_LOAD_FAILURE_EXPIRY_MS", "int", str(15 * 60 * 1000),
                "how long a recorded load failure excludes an instance "
                "from re-load placement (default 15 min; reference "
                "ModelMesh.java:219-224)", "records.py"),

@@ -12,6 +12,13 @@ from __future__ import annotations
 import dataclasses
 import socket
 
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.runtime.fake import FakeRuntimeServicer, start_fake_runtime
+from modelmesh_tpu.runtime.sidecar import SidecarRuntime
+from modelmesh_tpu.serving.api import MeshServer, PeerChannels, make_grpc_peer_call
+from modelmesh_tpu.serving.instance import InstanceConfig, ModelMeshInstance
+from modelmesh_tpu.serving.vmodels import VModelManager
+
 
 def free_port() -> int:
     """Bind-port-0 helper shared by restart tests that need a FIXED port
@@ -21,14 +28,6 @@ def free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
-
-
-from modelmesh_tpu.kv import InMemoryKV
-from modelmesh_tpu.runtime.fake import FakeRuntimeServicer, start_fake_runtime
-from modelmesh_tpu.runtime.sidecar import SidecarRuntime
-from modelmesh_tpu.serving.api import MeshServer, PeerChannels, make_grpc_peer_call
-from modelmesh_tpu.serving.instance import InstanceConfig, ModelMeshInstance
-from modelmesh_tpu.serving.vmodels import VModelManager
 
 
 @dataclasses.dataclass

@@ -1,0 +1,367 @@
+"""Raw-protocol conformance for the in-repo ZooKeeper wire server.
+
+The sibling of tests/test_etcd_wire.py: the KVStore-level suites only
+reach what the flat-key client uses, so the ZooKeeper contract's
+HIERARCHICAL semantics — parent existence, NOTEMPTY deletes, cversion/
+pzxid bookkeeping, sequence nodes, exists-watches on absent paths,
+one-shot watch consumption, multi atomicity across staged parents —
+are exercised here at the jute frame level, through the same _ZkSession
+codec a real client would use (reference: the Zookeeper* test classes
+run against a real ensemble; this is the zero-egress stand-in's proof
+it behaves like one).
+"""
+
+import time
+
+import pytest
+
+from modelmesh_tpu.kv import jute
+from modelmesh_tpu.kv.jute import (
+    ERR_BAD_ARGUMENTS,
+    ERR_BAD_VERSION,
+    ERR_NO_NODE,
+    ERR_NODE_EXISTS,
+    ERR_NOT_EMPTY,
+    EV_NODE_CHILDREN_CHANGED,
+    EV_NODE_CREATED,
+    EV_NODE_DATA_CHANGED,
+    EV_NODE_DELETED,
+    FLAG_EPHEMERAL,
+    FLAG_SEQUENCE,
+    OP_CHECK,
+    OP_CREATE2,
+    OP_DELETE,
+    OP_EXISTS,
+    OP_GET_CHILDREN2,
+    OP_GET_DATA,
+    OP_MULTI,
+    OP_SET_DATA,
+    MultiHeader,
+    Stat,
+    Writer,
+    write_acl_vector,
+)
+from modelmesh_tpu.kv.zk_server import ZkWireServer
+from modelmesh_tpu.kv.zookeeper import _ZkReplyError, _ZkSession
+
+
+@pytest.fixture()
+def wire():
+    server = ZkWireServer().start()
+    session = _ZkSession(
+        f"127.0.0.1:{server.port}", timeout_ms=10_000, auto_ping=True
+    )
+    yield session, server
+    session.close()
+    server.stop()
+
+
+def create(s, path, data=b"", flags=0):
+    w = Writer()
+    w.string(path).buffer(data)
+    write_acl_vector(w)
+    w.int32(flags)
+    _, r = s.request(OP_CREATE2, w.getvalue())
+    actual = r.string()
+    return actual, Stat.read(r)
+
+
+def get_data(s, path, watch=False):
+    w = Writer()
+    w.string(path).boolean(watch)
+    _, r = s.request(OP_GET_DATA, w.getvalue())
+    return r.buffer(), Stat.read(r)
+
+
+def set_data(s, path, data, version=-1):
+    w = Writer()
+    w.string(path).buffer(data).int32(version)
+    _, r = s.request(OP_SET_DATA, w.getvalue())
+    return Stat.read(r)
+
+
+def delete(s, path, version=-1):
+    w = Writer()
+    w.string(path).int32(version)
+    s.request(OP_DELETE, w.getvalue())
+
+
+def children(s, path, watch=False):
+    w = Writer()
+    w.string(path).boolean(watch)
+    _, r = s.request(OP_GET_CHILDREN2, w.getvalue())
+    n = r.int32()
+    names = sorted(r.string() for _ in range(n))
+    return names, Stat.read(r)
+
+
+def exists(s, path, watch=False):
+    w = Writer()
+    w.string(path).boolean(watch)
+    _, r = s.request(OP_EXISTS, w.getvalue())
+    return Stat.read(r)
+
+
+def next_event(s, timeout=5.0):
+    ev = s.watch_events.get(timeout=timeout)
+    return ev
+
+
+class TestHierarchy:
+    def test_create_requires_parent(self, wire):
+        s, _ = wire
+        with pytest.raises(_ZkReplyError) as e:
+            create(s, "/a/b")
+        assert e.value.code == ERR_NO_NODE
+        create(s, "/a")
+        create(s, "/a/b")
+        names, st = children(s, "/a")
+        assert names == ["b"] and st.num_children == 1
+
+    def test_delete_nonempty_fails(self, wire):
+        s, _ = wire
+        create(s, "/p")
+        create(s, "/p/c")
+        with pytest.raises(_ZkReplyError) as e:
+            delete(s, "/p")
+        assert e.value.code == ERR_NOT_EMPTY
+        delete(s, "/p/c")
+        delete(s, "/p")
+        with pytest.raises(_ZkReplyError):
+            get_data(s, "/p")
+
+    def test_cversion_and_pzxid_track_child_churn(self, wire):
+        s, _ = wire
+        create(s, "/cv")
+        st0 = exists(s, "/cv")
+        create(s, "/cv/a")
+        delete(s, "/cv/a")
+        st1 = exists(s, "/cv")
+        assert st1.cversion == st0.cversion + 2
+        assert st1.pzxid > st0.pzxid
+        assert st1.version == st0.version  # data untouched
+
+    def test_ephemeral_cannot_have_children(self, wire):
+        s, _ = wire
+        create(s, "/eph", flags=FLAG_EPHEMERAL)
+        with pytest.raises(_ZkReplyError) as e:
+            create(s, "/eph/kid")
+        assert e.value.code == ERR_BAD_ARGUMENTS
+
+    def test_sequence_nodes_monotonic(self, wire):
+        s, _ = wire
+        create(s, "/q")
+        a, _ = create(s, "/q/n-", flags=FLAG_SEQUENCE)
+        b, _ = create(s, "/q/n-", flags=FLAG_SEQUENCE)
+        assert a != b and a < b
+        assert a.startswith("/q/n-") and len(a) == len("/q/n-") + 10
+
+    def test_bad_paths_rejected(self, wire):
+        s, _ = wire
+        for path in ("noslash", "/trail/", "/dou//ble", "/nul\x00"):
+            with pytest.raises(_ZkReplyError) as e:
+                create(s, path)
+            assert e.value.code == ERR_BAD_ARGUMENTS
+
+
+class TestVersionsAndStat:
+    def test_set_data_version_guard(self, wire):
+        s, _ = wire
+        create(s, "/v", b"0")
+        st = set_data(s, "/v", b"1", version=0)
+        assert st.version == 1
+        with pytest.raises(_ZkReplyError) as e:
+            set_data(s, "/v", b"x", version=0)
+        assert e.value.code == ERR_BAD_VERSION
+        set_data(s, "/v", b"2", version=-1)  # wildcard
+        with pytest.raises(_ZkReplyError) as e:
+            delete(s, "/v", version=1)
+        assert e.value.code == ERR_BAD_VERSION
+        delete(s, "/v", version=2)
+
+    def test_mzxid_moves_czxid_does_not(self, wire):
+        s, _ = wire
+        _, st0 = create(s, "/z", b"0")
+        st1 = set_data(s, "/z", b"1")
+        assert st1.czxid == st0.czxid
+        assert st1.mzxid > st0.mzxid
+        assert st1.data_length == 1
+
+
+class TestWatches:
+    def test_data_watch_fires_once(self, wire):
+        s, _ = wire
+        create(s, "/w", b"0")
+        get_data(s, "/w", watch=True)
+        set_data(s, "/w", b"1")
+        ev = next_event(s)
+        assert (ev.type, ev.path) == (EV_NODE_DATA_CHANGED, "/w")
+        # One-shot: a second mutation without re-arming fires nothing.
+        set_data(s, "/w", b"2")
+        time.sleep(0.2)
+        assert s.watch_events.empty()
+
+    def test_exists_watch_on_absent_path_fires_on_create(self, wire):
+        s, _ = wire
+        with pytest.raises(_ZkReplyError) as e:
+            exists(s, "/future", watch=True)
+        assert e.value.code == ERR_NO_NODE
+        create(s, "/future")
+        ev = next_event(s)
+        assert (ev.type, ev.path) == (EV_NODE_CREATED, "/future")
+
+    def test_child_watch_fires_on_membership_not_data(self, wire):
+        s, _ = wire
+        create(s, "/cw")
+        children(s, "/cw", watch=True)
+        create(s, "/cw/kid", b"")
+        ev = next_event(s)
+        assert (ev.type, ev.path) == (EV_NODE_CHILDREN_CHANGED, "/cw")
+        children(s, "/cw", watch=True)
+        set_data(s, "/cw/kid", b"data")  # child DATA change: no child event
+        time.sleep(0.2)
+        assert s.watch_events.empty()
+
+    def test_delete_fires_data_and_parent_child_watches(self, wire):
+        s, _ = wire
+        create(s, "/dp")
+        create(s, "/dp/x", b"v")
+        get_data(s, "/dp/x", watch=True)
+        children(s, "/dp", watch=True)
+        delete(s, "/dp/x")
+        # Two events, order server-defined: NodeDeleted on the node and
+        # NodeChildrenChanged on the parent.
+        ev1, ev2 = next_event(s), next_event(s)
+        got = {(ev1.type, ev1.path), (ev2.type, ev2.path)}
+        assert got == {
+            (EV_NODE_DELETED, "/dp/x"),
+            (EV_NODE_CHILDREN_CHANGED, "/dp"),
+        }
+
+
+class TestMultiWire:
+    def _multi(self, s, ops):
+        w = Writer()
+        for kind, *rest in ops:
+            MultiHeader(kind, False, -1).write(w)
+            if kind == OP_CREATE2:
+                path, data, flags = rest
+                w.string(path).buffer(data)
+                write_acl_vector(w)
+                w.int32(flags)
+            elif kind == OP_DELETE:
+                path, version = rest
+                w.string(path).int32(version)
+            elif kind == OP_SET_DATA:
+                path, data, version = rest
+                w.string(path).buffer(data).int32(version)
+            elif kind == OP_CHECK:
+                path, version = rest
+                w.string(path).int32(version)
+        MultiHeader(-1, True, -1).write(w)
+        _, r = s.request(OP_MULTI, w.getvalue())
+        results = []
+        while True:
+            h = MultiHeader.read(r)
+            if h.done:
+                break
+            if h.type == jute.OP_ERROR:
+                results.append(("err", r.int32()))
+            elif h.type == OP_CREATE2:
+                results.append(("create", r.string(), Stat.read(r)))
+            elif h.type == OP_SET_DATA:
+                results.append(("set", Stat.read(r)))
+            else:
+                results.append(("ok",))
+        return results
+
+    def test_multi_is_atomic_on_failure(self, wire):
+        s, _ = wire
+        create(s, "/m", b"0")
+        res = self._multi(s, [
+            (OP_SET_DATA, "/m", b"1", -1),
+            (OP_CHECK, "/m", 99),       # fails
+            (OP_CREATE2, "/mnew", b"", 0),
+        ])
+        assert all(kind == "err" for kind, *_ in res)
+        assert get_data(s, "/m")[0] == b"0"      # rolled back
+        with pytest.raises(_ZkReplyError):
+            get_data(s, "/mnew")
+
+    def test_multi_one_zxid_for_all_ops(self, wire):
+        s, _ = wire
+        create(s, "/t")
+        res = self._multi(s, [
+            (OP_CREATE2, "/t/a", b"", 0),
+            (OP_CREATE2, "/t/b", b"", 0),
+        ])
+        (_, _, st_a), (_, _, st_b) = res
+        assert st_a.czxid == st_b.czxid  # one transaction, one zxid
+
+    def test_multi_create_under_staged_deleted_parent_rejected(self, wire):
+        """Phase-1 must see the staged parent delete, or phase 2 would
+        blow up mid-apply after the delete landed (review regression)."""
+        s, _ = wire
+        create(s, "/sp")
+        res = self._multi(s, [
+            (OP_DELETE, "/sp", -1),
+            (OP_CREATE2, "/sp/kid", b"", 0),
+        ])
+        assert all(kind == "err" for kind, *_ in res)
+        # Atomicity held: the parent delete did NOT apply.
+        exists(s, "/sp")
+
+    def test_multi_delete_then_recreate_same_path(self, wire):
+        s, _ = wire
+        create(s, "/r", b"old")
+        res = self._multi(s, [
+            (OP_DELETE, "/r", -1),
+            (OP_CREATE2, "/r", b"new", 0),
+        ])
+        assert [k for k, *_ in res] == ["ok", "create"]
+        data, st = get_data(s, "/r")
+        assert data == b"new" and st.version == 0  # fresh node
+
+    def test_multi_create_under_staged_ephemeral_parent_rejected(self, wire):
+        s, _ = wire
+        res = self._multi(s, [
+            (OP_CREATE2, "/ep", b"", FLAG_EPHEMERAL),
+            (OP_CREATE2, "/ep/kid", b"", 0),
+        ])
+        assert all(kind == "err" for kind, *_ in res)
+        with pytest.raises(_ZkReplyError):
+            exists(s, "/ep")  # nothing applied
+
+
+class TestSessionsWire:
+    def test_expired_session_mutation_rejected(self, wire):
+        """A mutation racing the reaper's expiry sweep must not land (the
+        ephemeral would leak forever — review regression). Driven
+        directly: close the session state server-side, then mutate."""
+        s, server = wire
+        sess = server.state.sessions[s.session_id]
+        server.state.close_session(sess)
+        with pytest.raises((
+            _ZkReplyError, ConnectionError, TimeoutError
+        )) as e:
+            create(s, "/late", flags=FLAG_EPHEMERAL)
+        if isinstance(e.value, _ZkReplyError):
+            assert e.value.code == jute.ERR_SESSION_EXPIRED
+        assert "/late" not in server.state.nodes
+
+    def test_ephemerals_die_with_clean_close(self, wire):
+        s, server = wire
+        s2 = _ZkSession(
+            f"127.0.0.1:{server.port}", timeout_ms=10_000, auto_ping=False
+        )
+        w = Writer()
+        w.string("/mine").buffer(b"")
+        write_acl_vector(w)
+        w.int32(FLAG_EPHEMERAL)
+        s2.request(OP_CREATE2, w.getvalue())
+        st = exists(s, "/mine")
+        assert st.ephemeral_owner == s2.session_id
+        s2.close(clean=True)
+        with pytest.raises(_ZkReplyError) as e:
+            exists(s, "/mine")
+        assert e.value.code == ERR_NO_NODE

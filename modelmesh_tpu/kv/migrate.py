@@ -63,7 +63,8 @@ def main() -> None:
 
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--kv", required=True,
-                        help="mesh://host:port or etcd://host:port")
+                        help="mesh://host:port, etcd://host:port, or "
+                             "zookeeper://host:port")
     parser.add_argument("--prefix", default="mm")
     parser.add_argument("--buckets", type=int, default=128)
     args = parser.parse_args()

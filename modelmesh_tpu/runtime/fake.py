@@ -58,7 +58,8 @@ class FakeRuntimeServicer:
         self._ready_at = time.monotonic() + ready_delay_s
         self.load_concurrency = load_concurrency
         self.loaded: dict[str, int] = {}  # model_id -> size
-        self.load_count = 0
+        self.load_count = 0      # successful loads
+        self.load_attempts = 0   # LoadModel RPCs incl. injected failures
         self.unload_count = 0
         self._lock = threading.Lock()
 
@@ -81,6 +82,8 @@ class FakeRuntimeServicer:
 
     def LoadModel(self, request, context):
         mid = request.model_id
+        with self._lock:
+            self.load_attempts += 1
         if mid.startswith(FAIL_LOAD_PREFIX):
             context.abort(grpc.StatusCode.INTERNAL, f"injected load failure: {mid}")
         delay = self.load_delay_s

@@ -211,6 +211,32 @@ class TestLifecycle:
         assert "i-test" not in mr.instance_ids
         assert inst.cache.get_quietly(mid) is None
 
+    def test_load_failure_exclusion_expires(self, mesh, monkeypatch):
+        """A recorded load failure excludes this instance from re-load
+        placement only for MM_LOAD_FAILURE_EXPIRY_MS; once it lapses the
+        next invoke retries the load — no reaper prune required (the
+        routing exclusion is time-aware)."""
+        inst, servicer, _ = mesh
+        monkeypatch.setenv("MM_LOAD_FAILURE_EXPIRY_MS", "2000")
+        mid = FAIL_LOAD_PREFIX + "retry"
+        inst.register_model(mid, INFO)
+        with pytest.raises(Exception):
+            inst.invoke_model(mid, PREDICT_METHOD, b"x", [])
+        mr = inst.registry.get(mid)
+        assert "i-test" in mr.load_failures
+        # Inside the window: the failure still hard-excludes us (the only
+        # instance), so routing gives up without another runtime load.
+        attempts_before = servicer.load_attempts
+        with pytest.raises(Exception):
+            inst.invoke_model(mid, PREDICT_METHOD, b"x", [])
+        assert servicer.load_attempts == attempts_before
+        # Past the window: the invoke retries the load (still fails — the
+        # runtime is told to — but the RETRY proves the exclusion lapsed).
+        time.sleep(2.2)
+        with pytest.raises(Exception):
+            inst.invoke_model(mid, PREDICT_METHOD, b"x", [])
+        assert servicer.load_attempts > attempts_before
+
     def test_hit_only_hop_semantics(self, mesh):
         inst, _, _ = mesh
         from modelmesh_tpu.serving.errors import ModelNotHereError

@@ -220,20 +220,22 @@ class TestLifecycle:
         monkeypatch.setenv("MM_LOAD_FAILURE_EXPIRY_MS", "2000")
         mid = FAIL_LOAD_PREFIX + "retry"
         inst.register_model(mid, INFO)
-        with pytest.raises(Exception):
+        from modelmesh_tpu.serving.errors import NoCapacityError
+
+        with pytest.raises(ModelLoadException):
             inst.invoke_model(mid, PREDICT_METHOD, b"x", [])
         mr = inst.registry.get(mid)
         assert "i-test" in mr.load_failures
         # Inside the window: the failure still hard-excludes us (the only
         # instance), so routing gives up without another runtime load.
         attempts_before = servicer.load_attempts
-        with pytest.raises(Exception):
+        with pytest.raises((NoCapacityError, ModelLoadException)):
             inst.invoke_model(mid, PREDICT_METHOD, b"x", [])
         assert servicer.load_attempts == attempts_before
         # Past the window: the invoke retries the load (still fails — the
         # runtime is told to — but the RETRY proves the exclusion lapsed).
         time.sleep(2.2)
-        with pytest.raises(Exception):
+        with pytest.raises(ModelLoadException):
             inst.invoke_model(mid, PREDICT_METHOD, b"x", [])
         assert servicer.load_attempts > attempts_before
 

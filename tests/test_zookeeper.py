@@ -258,6 +258,80 @@ class TestWireConformance:
         assert lease not in server.state.sessions
 
 
+class TestConcurrencyStress:
+    def test_concurrent_writers_and_watcher_converge(self, zk):
+        """The ZK analog of the etcd concurrent-writer fuzz: several
+        threads hammer overlapping keys with put/CAS/delete while a
+        watcher mirrors a prefix; at the end the watcher's view equals
+        the store, every CAS outcome was consistent, and revisions are
+        strictly monotonic per key."""
+        import threading
+
+        kv, server = zk
+        view: dict[str, bytes] = {}
+        view_lock = threading.Lock()
+
+        def on_events(evs):
+            with view_lock:
+                for e in evs:
+                    if e.type == EventType.PUT:
+                        view[e.kv.key] = e.kv.value
+                    else:
+                        view.pop(e.kv.key, None)
+
+        kv.watch("s/", on_events)
+        errors: list[str] = []
+        cas_wins = [0] * 4
+
+        def worker(wid: int):
+            import random
+
+            rnd = random.Random(wid)
+            try:
+                for i in range(40):
+                    key = f"s/k{rnd.randrange(6)}"
+                    roll = rnd.random()
+                    if roll < 0.5:
+                        kv.put(key, f"w{wid}-{i}".encode())
+                    elif roll < 0.8:
+                        cur = kv.get(key)
+                        ver = cur.version if cur else 0
+                        ok, _ = kv.txn(
+                            [Compare(key, ver)],
+                            [Op(key, f"cas{wid}-{i}".encode())],
+                        )
+                        if ok:
+                            cas_wins[wid] += 1
+                    else:
+                        kv.delete(key)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"w{wid}: {type(e).__name__}: {e}")
+
+        threads = [
+            threading.Thread(target=worker, args=(w,)) for w in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "stress worker hung"
+        assert not errors, errors
+        assert any(cas_wins), "no CAS ever succeeded across 4 writers"
+        kv.wait_idle(timeout=10.0)
+        # Watcher view converged to the store's final truth.
+        final = {x.key: x.value for x in kv.range("s/")}
+        with view_lock:
+            assert view == final, (
+                f"watch mirror diverged: view={sorted(view)} "
+                f"store={sorted(final)}"
+            )
+        # Server-side: per-key version counters and global zxid sane.
+        with server.state.lock:
+            assert server.state.zxid > 0
+            for path, node in server.state.nodes.items():
+                assert node.czxid <= node.mzxid <= server.state.zxid
+
+
 class TestWatchDurability:
     def test_watch_survives_server_restart(self):
         """One-shot ZK watches + a dead session must still yield a live

@@ -347,7 +347,20 @@ class _ZkConnHandler(socketserver.BaseRequestHandler):
     _ThreadingTCP instance, which carries ``.state`` (ZkState) and
     ``.stopping`` (Event) attached by ZkWireServer."""
 
+    HANDSHAKE_TIMEOUT_S = 10.0
+
     def setup(self) -> None:
+        tls_ctx = getattr(self.server, "tls_ctx", None)
+        if tls_ctx is not None:
+            # Per-connection TLS handshake in the handler thread (never
+            # the accept loop — a plaintext or wedged client must not
+            # stall other sessions), BOUNDED: a connect-and-hold peer
+            # must not pin this thread forever. Failure raises here;
+            # socketserver drops the connection.
+            self.request.settimeout(self.HANDSHAKE_TIMEOUT_S)
+            self.request = tls_ctx.wrap_socket(
+                self.request, server_side=True
+            )
         self.session: Optional[_Session] = None
         self._send_lock = threading.Lock()
         # Watch events are queued and sent by a dedicated drain thread:
@@ -409,6 +422,15 @@ class _ZkConnHandler(socketserver.BaseRequestHandler):
             if self.session is not None:
                 self.session.conn = None
             self._outq.put(None)  # stop the event drain thread
+
+    def finish(self) -> None:
+        # Under TLS, wrap_socket DETACHED the socket socketserver's
+        # shutdown_request knows about — close the live (possibly
+        # wrapped) one deterministically instead of waiting for GC.
+        try:
+            self.request.close()
+        except OSError:
+            pass
 
     def _reply(self, xid: int, err: int, body: bytes = b"") -> None:
         w = Writer()
@@ -654,12 +676,24 @@ class _ThreadingTCP(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
 
+    def handle_error(self, request, client_address):
+        # Dropped/garbage/failed-TLS connections are expected traffic for
+        # a network server — one debug line, not a stderr traceback.
+        import ssl as _ssl
+        import sys
+
+        exc = sys.exception()
+        if isinstance(exc, (_ssl.SSLError, OSError, jute.JuteError)):
+            log.debug("connection from %s dropped: %s", client_address, exc)
+            return
+        super().handle_error(request, client_address)
+
 
 class ZkWireServer:
     """Embeddable single-node ZooKeeper-protocol server."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 state: Optional[ZkState] = None):
+                 state: Optional[ZkState] = None, tls=None):
         # Passing a previous instance's ``state`` simulates an ensemble
         # restart that kept its on-disk tree: sessions whose connections
         # died with the old process expire by timeout (reaper), deleting
@@ -671,6 +705,9 @@ class ZkWireServer:
         # server instance socketserver hands it).
         self._tcp.state = self.state          # type: ignore[attr-defined]
         self._tcp.stopping = self.stopping    # type: ignore[attr-defined]
+        self._tcp.tls_ctx = (                 # type: ignore[attr-defined]
+            tls.ssl_server_context() if tls is not None else None
+        )
         self.port = self._tcp.server_address[1]
         self._serve_thread = threading.Thread(
             target=self._tcp.serve_forever, name="zk-server", daemon=True

@@ -120,12 +120,27 @@ class _ZkSession:
     watch-event queue, optional heartbeat."""
 
     def __init__(self, endpoint: str, timeout_ms: int, auto_ping: bool,
-                 connect_timeout_s: float = 5.0):
+                 connect_timeout_s: float = 5.0, ssl_ctx=None,
+                 ssl_hostname: Optional[str] = None):
         host, _, port = endpoint.rpartition(":")
+        host = host or "127.0.0.1"
         self._sock = socket.create_connection(
-            (host or "127.0.0.1", int(port)), timeout=connect_timeout_s
+            (host, int(port)), timeout=connect_timeout_s
         )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if ssl_ctx is not None:
+            # A real ensemble's secureClientPort: TLS-wrap the raw socket
+            # before the jute handshake. The context is built ONCE by the
+            # owning ZookeeperKV (an mTLS context stages the private key
+            # through temp files — not something to repeat per reconnect
+            # or per lease session).
+            try:
+                self._sock = ssl_ctx.wrap_socket(
+                    self._sock, server_hostname=ssl_hostname or host
+                )
+            except (OSError, ValueError) as e:
+                self._sock.close()
+                raise ZkSessionLost(f"zk TLS handshake failed: {e}") from e
         self._send_lock = threading.Lock()
         self._xid = 0
         self._xid_lock = threading.Lock()
@@ -302,16 +317,16 @@ class ZookeeperKV(KVStore):
 
     def __init__(self, endpoint: str, session_timeout_ms: int = 10_000,
                  tls=None):
-        if tls is not None:
-            raise NotImplementedError(
-                "zookeeper:// TLS requires a Netty-TLS-enabled ensemble; "
-                "terminate TLS at a local sidecar or use etcd:// for "
-                "an mTLS coordination plane"
-            )
         self._endpoint = endpoint
         self._session_timeout_ms = session_timeout_ms
+        host = endpoint.rpartition(":")[0] or "127.0.0.1"
+        self._ssl_ctx = tls.ssl_client_context() if tls is not None else None
+        self._ssl_hostname = (
+            tls.server_hostname(host) if tls is not None else None
+        )
         self._session = _ZkSession(endpoint, session_timeout_ms,
-                                   auto_ping=True)
+                                   auto_ping=True, ssl_ctx=self._ssl_ctx,
+                                   ssl_hostname=self._ssl_hostname)
         self._closed = threading.Event()
         # Guards the session swap ONLY. Lock order: never hold
         # _session_lock while taking _watch_lock (the dispatcher holds
@@ -347,7 +362,8 @@ class ZookeeperKV(KVStore):
             if cur is not failed and not cur.dead.is_set():
                 return cur  # another thread already reconnected
             fresh = _ZkSession(
-                self._endpoint, self._session_timeout_ms, auto_ping=True
+                self._endpoint, self._session_timeout_ms, auto_ping=True,
+                ssl_ctx=self._ssl_ctx, ssl_hostname=self._ssl_hostname,
             )
             self._session = fresh
         log.info("zk session re-established (%s)", hex(fresh.session_id))
@@ -936,7 +952,8 @@ class ZookeeperKV(KVStore):
 
     def lease_grant(self, ttl_s: float) -> int:
         session = _ZkSession(
-            self._endpoint, int(ttl_s * 1000), auto_ping=False
+            self._endpoint, int(ttl_s * 1000), auto_ping=False,
+            ssl_ctx=self._ssl_ctx, ssl_hostname=self._ssl_hostname,
         )
         if session.timeout_ms < ttl_s * 1000:
             # The ensemble clamped the session timeout below the requested

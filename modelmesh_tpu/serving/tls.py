@@ -63,6 +63,63 @@ class TlsConfig:
             certificate_chain=self.cert_pem if self.require_client_auth else None,
         )
 
+    # -- raw-socket (non-gRPC) transports ----------------------------------
+    # The ZooKeeper jute protocol rides plain TCP, so its TLS wraps the
+    # socket directly (a real ensemble's secureClientPort does the same).
+    # Both contexts are derived from the SAME PEM material as the gRPC
+    # credentials — one coordination-plane identity per pod.
+
+    def _load_identity(self, ctx) -> None:
+        import os
+        import tempfile
+
+        # ssl.load_cert_chain only takes file paths; stage the in-memory
+        # PEMs in private temp files for the duration of the call.
+        cf = tempfile.NamedTemporaryFile("wb", suffix=".pem", delete=False)
+        kf = tempfile.NamedTemporaryFile("wb", suffix=".pem", delete=False)
+        try:
+            cf.write(self.cert_pem)
+            cf.close()
+            kf.write(self.key_pem)
+            kf.close()
+            ctx.load_cert_chain(cf.name, kf.name)
+        finally:
+            os.unlink(cf.name)
+            os.unlink(kf.name)
+
+    def ssl_server_context(self):
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        self._load_identity(ctx)
+        if self.require_client_auth:
+            if not self.ca_pem:
+                raise ValueError(
+                    "client-auth (mTLS) requires trust roots: provide "
+                    "ca_pem alongside require_client_auth"
+                )
+            ctx.verify_mode = ssl.CERT_REQUIRED
+            ctx.load_verify_locations(cadata=self.ca_pem.decode())
+        return ctx
+
+    def ssl_client_context(self):
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        if self.ca_pem:
+            ctx.load_verify_locations(cadata=self.ca_pem.decode())
+        else:
+            ctx.load_default_certs()
+        if self.require_client_auth:
+            self._load_identity(ctx)
+        return ctx
+
+    def server_hostname(self, dialed_host: str) -> str:
+        """The name the client verifies the server cert against —
+        override_authority when set (shared test certs), else the dialed
+        host (production default)."""
+        return self.override_authority or dialed_host
+
 
 def secure_channel(endpoint: str, tls: Optional[TlsConfig],
                    override_authority: Optional[str] = None) -> grpc.Channel:

@@ -81,3 +81,60 @@ class TestEtcdTls:
             client.close()
             server.stop(0)
             backing.close()
+
+
+class TestZookeeperTls:
+    def test_roundtrip_watch_and_lease_over_tls(self, tls):
+        from modelmesh_tpu.kv.zk_server import ZkWireServer
+        from modelmesh_tpu.kv.zookeeper import ZookeeperKV
+
+        server = ZkWireServer(tls=tls).start()
+        client = ZookeeperKV(f"127.0.0.1:{server.port}", tls=tls)
+        try:
+            got = []
+            client.watch("z/", lambda evs: got.extend(evs))
+            client.put("z/x", b"secret")
+            assert client.get("z/x").value == b"secret"
+            assert _wait(lambda: any(e.kv.key == "z/x" for e in got))
+            # Leases open ADDITIONAL TLS sessions; the whole liveness
+            # path must ride the secure transport too.
+            lease = client.lease_grant(5.0)
+            client.put("z/eph", b"live", lease=lease)
+            assert client.get("z/eph").lease == lease
+            client.lease_revoke(lease)
+            assert _wait(lambda: client.get("z/eph") is None)
+        finally:
+            client.close()
+            server.stop()
+
+    def test_plaintext_client_rejected(self, tls):
+        from modelmesh_tpu.kv.zk_server import ZkWireServer
+        from modelmesh_tpu.kv.zookeeper import ZkSessionLost, ZookeeperKV
+
+        server = ZkWireServer(tls=tls).start()
+        try:
+            with pytest.raises((ZkSessionLost, ConnectionError, OSError)):
+                ZookeeperKV(f"127.0.0.1:{server.port}")
+        finally:
+            server.stop()
+
+    def test_mtls_requires_client_certificate(self, tls):
+        import dataclasses
+
+        from modelmesh_tpu.kv.zk_server import ZkWireServer
+        from modelmesh_tpu.kv.zookeeper import ZkSessionLost, ZookeeperKV
+
+        mtls = dataclasses.replace(tls, require_client_auth=True)
+        server = ZkWireServer(tls=mtls).start()
+        client = None
+        try:
+            client = ZookeeperKV(f"127.0.0.1:{server.port}", tls=mtls)
+            client.put("m/x", b"1")
+            assert client.get("m/x").value == b"1"
+            certless = dataclasses.replace(tls, require_client_auth=False)
+            with pytest.raises((ZkSessionLost, ConnectionError, OSError)):
+                ZookeeperKV(f"127.0.0.1:{server.port}", tls=certless)
+        finally:
+            if client is not None:
+                client.close()
+            server.stop()

@@ -125,6 +125,100 @@ def build_linear(spec: ModelSpec, model_id: str) -> ServableModel:
     return ServableModel(apply, params, (d_in,), np.float32)
 
 
+def build_conv(spec: ModelSpec, model_id: str) -> ServableModel:
+    """Small conv classifier: f32 image -> class logits.
+
+    The classic vision-classifier shape the reference's deployments serve
+    through Triton/MLServer. TPU-first: NHWC convs lower straight onto
+    the MXU (conv-as-matmul tiling), bf16 weights, strided downsampling
+    instead of pooling ops, one dense readout.
+    """
+    size = spec.params.get("size", 32)          # square input, HW
+    chans = spec.params.get("chans", 3)
+    width = spec.params.get("width", 16)        # first conv channels
+    depth = spec.params.get("depth", 3)         # conv blocks, stride 2 each
+    classes = spec.params.get("classes", 10)
+    key = jax.random.PRNGKey(_seed_from(spec, model_id))
+
+    params = {"convs": []}
+    c_in = chans
+    for i in range(depth):
+        c_out = width << i
+        key, k1 = jax.random.split(key)
+        params["convs"].append({
+            # float(...) keeps the scale weak-typed: a np.float64 factor
+            # would silently promote the bf16 weights to f32 (conv
+            # demands matching dtypes, unlike matmul's auto-promotion).
+            "w": jax.random.normal(
+                k1, (3, 3, c_in, c_out), jnp.bfloat16
+            ) * float(1.0 / np.sqrt(9 * c_in)),
+            "b": jnp.zeros((c_out,), jnp.bfloat16),
+        })
+        c_in = c_out
+    # SAME padding + stride 2 gives ceil(hw/2) per block — floor division
+    # would mis-size the head for any size not divisible by 2**depth.
+    final_hw = size
+    for _ in range(depth):
+        final_hw = max(1, (final_hw + 1) // 2)
+    key, k2 = jax.random.split(key)
+    params["head"] = jax.random.normal(
+        k2, (final_hw * final_hw * c_in, classes), jnp.bfloat16
+    ) * float(1.0 / np.sqrt(final_hw * final_hw * c_in))
+
+    @jax.jit
+    def apply(params, x):
+        # x: f32[batch, H, W, C] (NHWC: TPU's native conv layout)
+        h = x.astype(jnp.bfloat16)
+        for layer in params["convs"]:
+            h = jax.lax.conv_general_dilated(
+                h, layer["w"], window_strides=(2, 2), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + layer["b"]
+            h = jax.nn.gelu(h)
+        h = h.reshape(h.shape[0], -1)
+        return (h @ params["head"]).astype(jnp.float32)
+
+    return ServableModel(apply, params, (size, size, chans), np.float32)
+
+
+def build_embedding(spec: ModelSpec, model_id: str) -> ServableModel:
+    """Embedding-bag scorer: int32 id bag -> similarity logits.
+
+    The lookup-heavy retrieval/rec workload model-mesh fleets classically
+    serve (many small per-tenant embedding models, exactly the
+    high-model-count regime the serving layer exists for). TPU-first: the
+    gather is expressed as a one-hot matmul — the same
+    duplicate-index-free pattern as the solver's fused histogram
+    (ops/auction.py _implied_load_fused) — so it rides the MXU instead of
+    TPU's serialized dynamic-gather path; mean-pool then a dense score
+    against item embeddings.
+    """
+    vocab = spec.params.get("vocab", 4096)
+    dim = spec.params.get("dim", 64)
+    bag = spec.params.get("bag", 16)            # ids per request
+    items = spec.params.get("items", 128)       # scored catalog size
+    key = jax.random.PRNGKey(_seed_from(spec, model_id))
+    k1, k2 = jax.random.split(key)
+    params = {
+        "table": jax.random.normal(k1, (vocab, dim), jnp.bfloat16) * 0.05,
+        "items": jax.random.normal(k2, (items, dim), jnp.bfloat16) * 0.05,
+    }
+
+    @jax.jit
+    def apply(params, ids):
+        # ids: i32[batch, bag]; LITERAL id 0 is the padding slot. The mask
+        # comes from the pre-modulo ids: an out-of-range id that wraps
+        # onto slot 0 for the lookup still COUNTS (collision, not drop).
+        mask = (ids != 0).astype(jnp.bfloat16)[..., None]
+        ids = ids % vocab
+        onehot = jax.nn.one_hot(ids, vocab, dtype=jnp.bfloat16)  # [b,bag,V]
+        emb = jnp.einsum("bkv,vd->bkd", onehot, params["table"])
+        pooled = (emb * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
+        return (pooled @ params["items"].T).astype(jnp.float32)
+
+    return ServableModel(apply, params, (bag,), np.int32)
+
+
 def build_transformer(spec: ModelSpec, model_id: str) -> ServableModel:
     """Tiny causal transformer LM: int32 token payload -> next-token logits.
 
@@ -271,6 +365,8 @@ def build_transformer(spec: ModelSpec, model_id: str) -> ServableModel:
 FAMILIES: dict[str, Callable[[ModelSpec, str], ServableModel]] = {
     "mlp": build_mlp,
     "linear": build_linear,
+    "conv": build_conv,
+    "embedding": build_embedding,
     "transformer": build_transformer,
     # The fake-runtime type used across tests maps to the cheapest family.
     "example": build_linear,

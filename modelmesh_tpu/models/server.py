@@ -102,6 +102,23 @@ def predict_size_estimate(model_type: str, model_path: str) -> int:
         layers, seq = p.get("layers", 2), p.get("seq", 64)
         per_layer = 3 * d * d + d * d + 8 * d * d + 2 * d
         return 2 * (vocab * d + seq * d + layers * per_layer)
+    if spec.family == "conv":
+        size, chans = p.get("size", 32), p.get("chans", 3)
+        width, depth = p.get("width", 16), p.get("depth", 3)
+        classes = p.get("classes", 10)
+        n, c_in = 0, chans
+        for i in range(depth):
+            c_out = width << i
+            n += 9 * c_in * c_out + c_out
+            c_in = c_out
+        hw = size
+        for _ in range(depth):
+            hw = max(1, (hw + 1) // 2)  # ceil: SAME + stride 2 per block
+        return 2 * (n + hw * hw * c_in * classes)
+    if spec.family == "embedding":
+        vocab, dim = p.get("vocab", 4096), p.get("dim", 64)
+        items = p.get("items", 128)
+        return 2 * (vocab * dim + items * dim)
     return 1 << 20
 
 

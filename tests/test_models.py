@@ -43,6 +43,52 @@ class TestFamilies:
         assert out.shape == (64,)  # vocab logits
         assert np.isfinite(out).all()
 
+    def test_conv_classifier_runs(self):
+        m = build_model(
+            "c", "conv", "conv://size=16,chans=3,width=8,depth=2,classes=5"
+        )
+        # Non-power-of-two input: SAME+stride-2 spatial dims are ceil'd,
+        # so the head must be sized by ceil division (review regression).
+        m_odd = build_model(
+            "c2", "conv", "conv://size=10,chans=3,width=8,depth=2,classes=5"
+        )
+        odd = np.random.RandomState(3).rand(1, 10, 10, 3).astype(np.float32)
+        out_odd = np.frombuffer(m_odd.predict_bytes(odd.tobytes()), np.float32)
+        assert out_odd.shape == (5,) and np.isfinite(out_odd).all()
+        img = np.random.RandomState(1).rand(2, 16, 16, 3).astype(np.float32)
+        out = np.frombuffer(m.predict_bytes(img.tobytes()), np.float32)
+        assert out.shape == (10,)  # 2 x 5 class logits
+        assert np.isfinite(out).all()
+        # Deterministic across copies (scale-up/failover parity).
+        m2 = build_model(
+            "c", "conv", "conv://size=16,chans=3,width=8,depth=2,classes=5"
+        )
+        out2 = np.frombuffer(m2.predict_bytes(img.tobytes()), np.float32)
+        np.testing.assert_array_equal(out, out2)
+
+    def test_embedding_bag_scores_and_masks_padding(self):
+        m = build_model(
+            "e", "embedding", "embedding://vocab=512,dim=32,bag=8,items=16"
+        )
+        ids = np.array([[5, 9, 2, 0, 0, 0, 0, 0]], np.int32)
+        out = np.frombuffer(m.predict_bytes(ids.tobytes()), np.float32)
+        assert out.shape == (16,)
+        assert np.isfinite(out).all()
+        # All-padding bag: masked mean pools to zero -> zero scores.
+        pad_only = np.zeros((1, 8), np.int32)
+        out_pad = np.frombuffer(m.predict_bytes(pad_only.tobytes()), np.float32)
+        np.testing.assert_array_equal(out_pad, np.zeros(16, np.float32))
+        # A real duplicate id changes the pooled score; and an id that is
+        # an exact multiple of vocab (wraps onto slot 0 for lookup) still
+        # COUNTS as a real id (mask from pre-modulo ids), shifting the
+        # mean versus the padded 3-id bag.
+        ids3 = np.array([[5, 9, 2, 2, 0, 0, 0, 0]], np.int32)
+        out3 = np.frombuffer(m.predict_bytes(ids3.tobytes()), np.float32)
+        assert np.abs(out - out3).max() > 1e-6
+        ids4 = np.array([[5, 9, 2, 512, 0, 0, 0, 0]], np.int32)
+        out4 = np.frombuffer(m.predict_bytes(ids4.tobytes()), np.float32)
+        assert np.abs(out - out4).max() > 1e-6
+
     def test_size_estimate_close_to_actual(self):
         path = "mlp://in=64,hidden=128,out=10"
         m = build_model("m", "mlp", path)

@@ -67,7 +67,6 @@ from modelmesh_tpu.kv.jute import (
     Stat,
     Writer,
     read_acl_vector,
-    write_acl_vector,
 )
 
 log = logging.getLogger("modelmesh_tpu.kv.zk_server")

@@ -42,7 +42,7 @@ import logging
 import queue
 import socket
 import threading
-from typing import Callable, Iterable, Optional, Sequence
+from typing import Iterable, Optional
 
 from modelmesh_tpu.kv import jute
 from modelmesh_tpu.kv.jute import (

@@ -20,7 +20,6 @@ from modelmesh_tpu.kv.jute import (
     ERR_BAD_ARGUMENTS,
     ERR_BAD_VERSION,
     ERR_NO_NODE,
-    ERR_NODE_EXISTS,
     ERR_NOT_EMPTY,
     EV_NODE_CHILDREN_CHANGED,
     EV_NODE_CREATED,

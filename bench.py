@@ -14,6 +14,19 @@ than an apples-to-oranges ratio.
 
 Env overrides (for the smaller BASELINE.json ladder tiers / CPU smoke):
 MM_BENCH_MODELS, MM_BENCH_INSTANCES, MM_BENCH_REPS, MM_BENCH_FORCE_CPU=1.
+
+MM_BENCH_E2E=1 additionally measures one full cold refresh end to end
+(registry snapshot -> device solve -> KV publish -> follower adoption).
+
+MM_BENCH_STEADY=1 measures the steady-state refresh fast path: one cold
+refresh, then a churn loop (~1% of models touched per cycle) driven
+through the pipelined refresher — delta snapshots (dirty tracking),
+warm-started solves (Sinkhorn g + auction prices), and convergence-gated
+early exit. Reports cold vs warm e2e refresh (publish + adoption
+included) with per-phase timings under the "steady" key. The early-exit
+gates honor the MM_SOLVER_SINKHORN_TOL / MM_SOLVER_SINKHORN_CHUNK /
+MM_SOLVER_AUCTION_STALL_TOL knobs and default to the gates documented in
+docs/performance.md when unset.
 """
 
 from __future__ import annotations
@@ -107,6 +120,155 @@ def _measure_e2e_refresh(n: int, m: int) -> dict:
             "publish_ms": round((t_pub - t_solve) * 1e3, 1),
             "adopt_ms": round((t_adopt - t_pub) * 1e3, 1),
             "planned_models": plan.num_models(),
+        }
+    finally:
+        pf.close()
+        kv.close()
+
+
+# The steady-state measurement runs the cluster LOADED (fraction of total
+# capacity demanded): a production fleet in steady state is sized near its
+# working set, and utilization is what gives the solver real work. At the
+# synthetic default (50k units/instance, ~20% utilization at 20k x 256)
+# the transport problem is degenerate — even a cold solve probe-exits in
+# one iteration — and cold-vs-warm would only measure snapshot overhead.
+STEADY_UTILIZATION = 0.85
+
+
+def _measure_steady_refresh(n: int, m: int, cycles: int = 5) -> dict:
+    """Cold-vs-warm e2e refresh under continuous small churn.
+
+    Cold: one full refresh (fresh snapshot, zero carries) with the same
+    early-exit solver config the steady loop uses — an honest baseline.
+    Warm: ``cycles`` refreshes through the PipelinedRefresher, each after
+    touching ~1% of models (+2 instances), using delta snapshots and the
+    device-chained warm carries. Every produced plan is published to a KV
+    and awaited at a watch-fed follower, so both numbers are e2e. Reports
+    the median warm cycle and the per-phase stats of the last warm plan.
+    Instance capacities are scaled so demand is STEADY_UTILIZATION of the
+    fleet (see above).
+    """
+    import numpy as np
+
+    from modelmesh_tpu.cache.lru import now_ms
+    from modelmesh_tpu.kv import InMemoryKV
+    from modelmesh_tpu.placement.jax_engine import (
+        JaxPlacementStrategy,
+        solve_config_from_env,
+    )
+    from modelmesh_tpu.placement.plan_sync import PlanFollower, publish_plan
+    from modelmesh_tpu.placement.refresh_loop import PipelinedRefresher
+    from modelmesh_tpu.placement.synthetic import synthetic_records
+
+    # Steady-mode defaults: enable the convergence gates unless the
+    # operator pinned them via MM_SOLVER_* (solve_config_from_env) —
+    # including an explicit =0 pin, which means "measure the steady loop
+    # WITHOUT gates" and must not be confused with unset.
+    cfg = solve_config_from_env()
+    # Empty-string matches the parser's unset semantics, so `VAR= cmd`
+    # still gets the gate defaults; only a real value (incl. "0") pins.
+    if not os.environ.get("MM_SOLVER_SINKHORN_TOL"):
+        cfg = cfg._replace(sinkhorn_tol=0.02)
+    if not os.environ.get("MM_SOLVER_AUCTION_STALL_TOL"):
+        cfg = cfg._replace(auction_stall_tol=1e-3)
+
+    models, instances = synthetic_records(n, m)
+    demand = sum(mr.size_units for _, mr in models)
+    cap = max(1, round(demand / (STEADY_UTILIZATION * m)))
+    for _, rec in instances:
+        rec.capacity_units = cap
+    rng = np.random.default_rng(0)
+    rpm = {f"m{i}": int(v) for i, v in enumerate(rng.integers(0, 50, n))}
+
+    # Compile warmup out of band (throwaway strategy, same shapes/config).
+    # Two pipelined submits + drain: the second chains a device carry, so
+    # on accelerator backends this also primes the DONATED jit entry the
+    # steady loop dispatches through — a separate compile cache from the
+    # plain entry, which alone would leave a full XLA compile inside the
+    # first measured warm cycle.
+    _warm = PipelinedRefresher(JaxPlacementStrategy(solve_config=cfg))
+    for _ in range(2):
+        _warm.submit(models, instances, rpm, incremental=True)
+    _warm.drain()
+
+    kv = InMemoryKV()
+    follower = JaxPlacementStrategy()
+    pf = PlanFollower(kv, "bench-steady", follower)
+
+    def publish_and_adopt(plan) -> float:
+        t0 = time.perf_counter()
+        gen = plan.generation
+        publish_plan(kv, "bench-steady", plan)
+        deadline = time.monotonic() + 60
+        while (
+            follower.plan is None or follower.plan.generation != gen
+        ) and time.monotonic() < deadline:
+            time.sleep(0.0005)
+        assert (
+            follower.plan is not None and follower.plan.generation == gen
+        ), "follower never adopted"
+        return (time.perf_counter() - t0) * 1e3
+
+    def churn(step: int) -> None:
+        """Touch ~1% of models + 2 instances, honestly marked dirty."""
+        k = max(1, n // 100)
+        idx = rng.integers(0, n, k)
+        now = now_ms()
+        dirty_m = []
+        for i in idx:
+            mid, mr = models[int(i)]
+            mr.last_used = now
+            rpm[mid] = int(rng.integers(0, 50))
+            dirty_m.append(mid)
+        dirty_i = []
+        for j in (step % m, (step * 7 + 1) % m):
+            iid, rec = instances[j]
+            rec.used_units = 500 + int(rng.integers(0, 200))
+            dirty_i.append(iid)
+        strat.mark_dirty(dirty_m, dirty_i)
+
+    strat = JaxPlacementStrategy(solve_config=cfg)
+    try:
+        # Cold: full snapshot, no carries, blocking refresh + publish.
+        t0 = time.perf_counter()
+        cold_plan = strat.refresh(models, instances, rpm)
+        cold_solve_ms = (time.perf_counter() - t0) * 1e3
+        cold_ms = cold_solve_ms + publish_and_adopt(cold_plan)
+        cold_stats = dict(cold_plan.stats)
+
+        # Steady loop: pipelined, delta snapshots, device-chained carries.
+        refresher = PipelinedRefresher(strat)
+        warm_cycles = []
+        warm_stats: dict = {}
+        for step in range(cycles + 1):
+            churn(step)
+            t0 = time.perf_counter()
+            plan = refresher.submit(models, instances, rpm, incremental=True)
+            if plan is not None:
+                publish_and_adopt(plan)
+                # Cycle time = this submit (snapshot N overlapping solve
+                # N-1 + finalize N-1) + publish/adopt of the emitted plan.
+                # Skip the priming call (step 0, no plan emitted).
+                warm_cycles.append((time.perf_counter() - t0) * 1e3)
+                warm_stats = dict(plan.stats)
+        tail = refresher.drain()
+        if tail is not None:
+            publish_and_adopt(tail)
+        warm_ms = float(np.median(warm_cycles))
+        return {
+            "tier": f"{n}x{m}",
+            "cycles": len(warm_cycles),
+            "cold_e2e_ms": round(cold_ms, 1),
+            "warm_e2e_ms": round(warm_ms, 1),
+            "speedup": round(cold_ms / warm_ms, 2),
+            "cold_phases": {
+                k: (round(v, 1) if isinstance(v, float) else v)
+                for k, v in cold_stats.items()
+            },
+            "warm_phases": {
+                k: (round(v, 1) if isinstance(v, float) else v)
+                for k, v in warm_stats.items()
+            },
         }
     finally:
         pf.close()
@@ -219,6 +381,20 @@ def main() -> None:
             result["e2e_refresh"] = e2e
         except Exception as e:  # noqa: BLE001
             print(f"bench: e2e refresh measurement failed: {e}", file=sys.stderr)
+    # Steady-state refresh fast path: cold vs warm (pipelined + delta +
+    # early exit) under churn. Failure must not lose the kernel line.
+    if envs.get_int("MM_BENCH_STEADY"):
+        if dev.platform == "cpu":
+            st_n, st_m = min(NUM_MODELS, 20_000), min(NUM_INSTANCES, 256)
+        else:
+            st_n, st_m = NUM_MODELS, NUM_INSTANCES
+        try:
+            result["steady"] = _measure_steady_refresh(st_n, st_m)
+        except Exception as e:  # noqa: BLE001
+            print(
+                f"bench: steady refresh measurement failed: {e}",
+                file=sys.stderr,
+            )
     print(json.dumps(result))
 
 

@@ -369,7 +369,11 @@ class _ZkConnHandler(socketserver.BaseRequestHandler):
         self._sender = threading.Thread(
             target=self._drain_outq, name="zk-conn-send", daemon=True
         )
-        self.request.settimeout(None)
+        # Keep the handshake deadline armed for the ConnectRequest read
+        # too — plaintext or TLS, a connect-and-hold peer must not pin
+        # this handler thread forever. handle() disarms it once the
+        # session is established.
+        self.request.settimeout(self.HANDSHAKE_TIMEOUT_S)
         self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def _send(self, payload: bytes) -> None:
@@ -396,8 +400,13 @@ class _ZkConnHandler(socketserver.BaseRequestHandler):
         state = self.server.state
         try:
             req = jute.ConnectRequest.decode(jute.read_frame(self.request))
-        except (ConnectionError, jute.JuteError):
+        except (ConnectionError, OSError, jute.JuteError):
+            # OSError covers socket.timeout: a silent client that never
+            # sent its ConnectRequest inside HANDSHAKE_TIMEOUT_S.
             return
+        # Handshake done — steady-state reads block indefinitely (liveness
+        # is the ping/reaper protocol's job, not the socket's).
+        self.request.settimeout(None)
         self.session = state.open_session(req.timeout_ms)
         self.session.conn = self
         self._sender.start()

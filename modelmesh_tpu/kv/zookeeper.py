@@ -495,7 +495,18 @@ class ZookeeperKV(KVStore):
                     # (etcd/InMemoryKV contract): recreate persistent.
                     # Unavoidable ZK deviation: watchers see DELETE+PUT
                     # and the version counter restarts.
-                    out = self._recreate_multi(key, value, 0, None)
+                    try:
+                        out = self._recreate_multi(key, value, 0, None)
+                    except _ZkReplyError as e:
+                        # NO_NODE / NODE_EXISTS / BAD_VERSION from the
+                        # multi = a concurrent writer won the race between
+                        # our probe and the delete+create (e.g. the owner
+                        # expired and someone recreated) — retry, don't
+                        # surface a transient as a hard failure.
+                        if e.code not in (ERR_NO_NODE, ERR_NODE_EXISTS,
+                                          ERR_BAD_VERSION):
+                            raise
+                        continue
                     if out is None:
                         continue  # owner expired mid-detach; retry
                     return out
@@ -543,7 +554,15 @@ class ZookeeperKV(KVStore):
             # the owner at creation, so the node is recreated under the
             # new session). None = lost a race (e.g. the old owner
             # expired between probe and delete): retry from the create.
-            out = self._recreate_multi(key, value, FLAG_EPHEMERAL, session)
+            # A NO_NODE / NODE_EXISTS / BAD_VERSION reply is the same
+            # lost race surfacing as an error instead of a failed multi.
+            try:
+                out = self._recreate_multi(key, value, FLAG_EPHEMERAL, session)
+            except _ZkReplyError as e:
+                if e.code not in (ERR_NO_NODE, ERR_NODE_EXISTS,
+                                  ERR_BAD_VERSION):
+                    raise
+                continue
             if out is not None:
                 return out
         raise RuntimeError(

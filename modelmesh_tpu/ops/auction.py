@@ -54,10 +54,15 @@ class AuctionResult(NamedTuple):
     indices: jax.Array   # i32[N, MAX_COPIES] chosen instance per copy slot
     valid: jax.Array     # bool[N, MAX_COPIES] slot is a real, feasible pick
     load: jax.Array      # f32[M] implied memory load of the assignment
-    prices: jax.Array    # f32[M] LAST-iterate prices (diagnostic only:
-                         # when the best-seen assignment is returned, these
-                         # need not reproduce `indices` via re-selection)
+    prices: jax.Array    # f32[M] prices the returned assignment was
+                         # selected at (the warm-start carry for the next
+                         # refresh's price0 — best-iterate, NOT last-
+                         # iterate: last prices are mid-cobweb and
+                         # re-selecting at them can overflow ~100x worse)
     overflow: jax.Array  # f32[] sum of capacity overflow (diagnostic)
+    # i32[] price iterations actually run (== iters when stall_tol=0; an
+    # early-exit solve — warm prices converge immediately — reports fewer).
+    iters_run: jax.Array = None
 
 
 def _finalize_topk(vals, idx, copies):
@@ -214,6 +219,35 @@ def final_candidate(scores_minus_price, copies, final_select: str):
     return _select(scores_minus_price, copies)
 
 
+def warm_probe(scores_f32, p_init, copies, cap, final_select: str,
+               load_fn, eta_eff, stall_tol: float, total_demand):
+    """Single-step warm probe shared by ``auction`` and
+    ``parallel/sharded_solver._sharded_auction`` (parameterized by the
+    load reducer so the gate arithmetic — selection mode, overflow noise
+    floor, price-stall condition — cannot drift between the two).
+
+    One full-width selection (in the configured ``final_select`` mode,
+    so "approx" tiers never pay the exact top-k it exists to avoid) at
+    the carried prices, one price step. ``probe_ok`` certifies the
+    carry: the step stalled, or the overflow is already below the stall
+    noise floor (``stall_tol`` of total demand — the same threshold the
+    round loop treats as a non-improvement). ``load_fn`` is the plain
+    implied-load histogram on a single device and the psum'd one on a
+    mesh — with psum'd load/demand every probe scalar is replicated, so
+    all devices take the same cond branch. Returns
+    (idx_p, valid_p, load_p, of_p, p_probe, probe_ok)."""
+    of_tol = stall_tol * jnp.maximum(total_demand, 1e-30)
+    idx_p, valid_p = final_candidate(
+        scores_f32 - p_init[None, :], copies, final_select
+    )
+    load_p = load_fn(idx_p, valid_p)
+    of_p = jnp.sum(jnp.maximum(load_p - cap, 0.0))
+    p_probe = price_step(load_p, cap, p_init, eta_eff)
+    dprice = jnp.max(jnp.abs(p_probe - p_init))
+    probe_ok = (dprice <= stall_tol) | (of_p <= of_tol)
+    return idx_p, valid_p, load_p, of_p, p_probe, probe_ok
+
+
 def hash_gumbel(
     shape: tuple[int, int],
     seed: jax.Array,
@@ -291,11 +325,63 @@ def price_step(load, cap, price, eta_t):
     return jnp.clip(price + eta_t * step, 0.0, None)
 
 
+def _stall_gated_rounds(narrow_round, carry, iters: int, stall_tol: float,
+                        total_demand):
+    """Convergence-gated round loop, shared by both solvers.
+
+    Runs rounds of RESHORTLIST_EVERY price iterations under a
+    ``lax.while_loop`` (each round body is the same fixed-length scan the
+    unrolled path uses, so the compiled program stays stable) and exits
+    once a full round stalls on ANY of:
+
+    - price movement <= stall_tol: the selection depends on state only
+      through prices, so a round that left them (essentially) in place
+      proves further rounds would reproduce themselves. This is the
+      warm-start fast exit — carried-in prices are already at equilibrium
+      and round one confirms it.
+    - best overflow hit zero: the loop minimizes overflow; there is
+      nothing left to repair.
+    - best-overflow improvement <= stall_tol * total_demand: prices are
+      limit-cycling (the cobweb pattern) without finding better
+      assignments — the cold-side exit. Guarded against the first round's
+      inf sentinel, which would read as zero improvement.
+
+    Returns (carry, iterations_run)."""
+    n_rounds = -(-iters // RESHORTLIST_EVERY)
+    of_tol = stall_tol * jnp.maximum(total_demand, 1e-30)
+
+    def cond(state):
+        rnd, stalled, _carry = state
+        return (~stalled) & (rnd < n_rounds)
+
+    def body(state):
+        rnd, _stalled, carry = state
+        # Positional unpack kept loose: both solvers' carries lead with the
+        # price vector and end with the best overflow (what sits in between
+        # — best assignment, best prices — is the caller's business).
+        price_in, bo_in = carry[0], carry[-1]
+        carry = narrow_round(carry, RESHORTLIST_EVERY)
+        price_out, bo_out = carry[0], carry[-1]
+        dprice = jnp.max(jnp.abs(price_out - price_in))
+        improved = jnp.where(jnp.isinf(bo_in), jnp.inf, bo_in - bo_out)
+        stalled = (
+            (dprice <= stall_tol)
+            | (bo_out <= 0.0)
+            | (improved <= of_tol)
+        )
+        return rnd + 1, stalled, carry
+
+    rnd, _stalled, carry = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), jnp.asarray(False), carry)
+    )
+    return carry, rnd * RESHORTLIST_EVERY
+
+
 @partial(
     jax.jit,
     static_argnames=(
         "iters", "eta", "price_scale", "tau", "load_impl", "noise_impl",
-        "final_select",
+        "final_select", "stall_tol",
     ),
 )
 def auction(
@@ -313,6 +399,8 @@ def auction(
     load_impl: str = "auto",
     noise_impl: str = "hash",
     final_select: str = "exact",
+    stall_tol: float = 0.0,
+    price0: jax.Array | None = None,
 ) -> AuctionResult:
     """Gumbel-top-k sampling + best-iterate congestion-price repair.
 
@@ -326,6 +414,22 @@ def auction(
     best-iterate assignment — "exact" full-width top-k, "approx"
     approx_max_k (cheaper on TPU, recall ~0.95), "none" skips the
     epilogue candidate entirely and returns the best iterate.
+
+    ``price0`` warm-starts the congestion prices from the previous
+    refresh's last iterate (steady-state churn barely moves the price
+    equilibrium, so warm prices are a round from converged). ``stall_tol``
+    > 0 enables early exit: rounds of RESHORTLIST_EVERY price iterations
+    run under a ``lax.while_loop`` and the loop stops once a whole round
+    neither moved prices more than ``stall_tol`` (price units) nor
+    improved the best overflow by more than ``stall_tol`` of total demand
+    — further rounds would reproduce the same iterates. A one-step probe
+    at the carried prices runs first: when it stalls, or its overflow is
+    already below ``stall_tol`` of total demand (the round loop's own
+    noise floor), the probe's full-width selection is returned directly
+    with ``iters_run == 1`` — the steady-state warm-price fast exit. The
+    ``iters`` budget rounds up to probe + whole rounds in this mode.
+    ``final_select="none"`` skips the probe (it is itself a full-width
+    selection, exactly what "none" avoids) and gates the rounds only.
     """
     check_rounding_config(noise_impl, final_select, iters)
     num_instances = capacity.shape[0]
@@ -349,68 +453,129 @@ def auction(
     load_impl = resolve_load_impl(load_impl)
 
     def narrow_round(carry, length):
-        price, best_idx, best_valid, best_load, best_of = carry
+        price, best_price, best_idx, best_valid, best_load, best_of = carry
         cand_vals, cand_idx = shortlist(scores_f32, price, kc)
 
         def body(carry, _):
-            price, bi, bv, bl, bo = carry
+            price, bp, bi, bv, bl, bo = carry
             idx, valid = select_from_candidates(
                 cand_vals, cand_idx, copies, price
             )
             load = _implied_load(idx, valid, sizes, num_instances, load_impl)
             of = jnp.sum(jnp.maximum(load - cap, 0.0))
             better = of < bo
+            # Track the price the best assignment was SELECTED at — the
+            # warm-start carry. Last-iterate prices are mid-cobweb (the
+            # synchronous dynamics limit-cycle) and re-selecting at them
+            # can overflow ~100x worse than the best iterate.
+            bp = jnp.where(better, price, bp)
             bi = jnp.where(better, idx, bi)
             bv = jnp.where(better, valid, bv)
             bl = jnp.where(better, load, bl)
             bo = jnp.minimum(of, bo)
             return (
                 price_step(load, cap, price, eta * price_scale),
-                bi, bv, bl, bo,
+                bp, bi, bv, bl, bo,
             ), None
 
-        carry, _ = jax.lax.scan(
-            body, (price, best_idx, best_valid, best_load, best_of), None,
-            length=length,
-        )
+        carry, _ = jax.lax.scan(body, carry, None, length=length)
         return carry
 
-    price0 = jnp.zeros((num_instances,), jnp.float32)
+    p_init = (
+        jnp.maximum(price0.astype(jnp.float32), 0.0)  # price >= 0 invariant
+        if price0 is not None
+        else jnp.zeros((num_instances,), jnp.float32)
+    )
     carry = (
-        price0,
+        p_init,
+        p_init,
         jnp.zeros((n, MAX_COPIES), jnp.int32),
         jnp.zeros((n, MAX_COPIES), bool),
         jnp.zeros((num_instances,), jnp.float32),
         jnp.asarray(jnp.inf, jnp.float32),
     )
-    # Honor `iters` exactly: full rounds of RESHORTLIST_EVERY plus one
-    # partial round for the remainder.
-    for length in [RESHORTLIST_EVERY] * (iters // RESHORTLIST_EVERY) + (
-        [iters % RESHORTLIST_EVERY] if iters % RESHORTLIST_EVERY else []
-    ):
-        carry = narrow_round(carry, length)
-    price, best_idx, best_valid, best_load, best_of = carry
-    # One full-width selection at the final prices competes with the best
-    # recorded assignment; whichever overflows less wins. The winner's
-    # load rides the carry — no histogram recompute in the epilogue.
-    if final_select == "none":
-        # With iters >= 1 the first narrow round always improves on the
-        # inf sentinel, so the best-iterate carry is a real assignment.
-        return AuctionResult(
-            indices=best_idx, valid=best_valid, load=best_load,
-            prices=price, overflow=best_of,
+    def epilogue(carry, iters_run):
+        # One full-width selection at the final prices competes with the
+        # best recorded assignment; whichever overflows less wins. The
+        # winner's load rides the carry — no histogram recompute here —
+        # and the returned prices are the ones the WINNING assignment was
+        # selected at (the warm-start carry the next refresh probes).
+        price, best_price, best_idx, best_valid, best_load, best_of = carry
+        if final_select == "none":
+            # With iters >= 1 the first narrow round always improves on
+            # the inf sentinel, so the best-iterate carry is a real
+            # assignment.
+            return AuctionResult(
+                indices=best_idx, valid=best_valid, load=best_load,
+                prices=best_price, overflow=best_of, iters_run=iters_run,
+            )
+        idx_l, valid_l = final_candidate(
+            scores_f32 - price[None, :], copies, final_select
         )
-    idx_l, valid_l = final_candidate(
-        scores_f32 - price[None, :], copies, final_select
+        load_l = _implied_load(idx_l, valid_l, sizes, num_instances,
+                               load_impl)
+        of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
+        use_last = of_l <= best_of
+        idx = jnp.where(use_last, idx_l, best_idx)
+        valid = jnp.where(use_last, valid_l, best_valid)
+        load = jnp.where(use_last, load_l, best_load)
+        overflow = jnp.minimum(of_l, best_of)
+        return AuctionResult(
+            indices=idx, valid=valid, load=load,
+            prices=jnp.where(use_last, price, best_price),
+            overflow=overflow, iters_run=iters_run,
+        )
+
+    if stall_tol <= 0.0:
+        # Honor `iters` exactly: full rounds of RESHORTLIST_EVERY plus one
+        # partial round for the remainder.
+        for length in [RESHORTLIST_EVERY] * (iters // RESHORTLIST_EVERY) + (
+            [iters % RESHORTLIST_EVERY] if iters % RESHORTLIST_EVERY else []
+        ):
+            carry = narrow_round(carry, length)
+        return epilogue(carry, jnp.asarray(iters, jnp.int32))
+
+    total_demand = jnp.sum(sizes * copies.astype(jnp.float32))
+    if final_select == "none":
+        # "none" exists to keep full-width selections out of huge tiers,
+        # and the warm probe below IS one — so this mode goes straight to
+        # the stall-gated rounds and keeps its best-iterate-only contract
+        # (the round loop still early-exits on the price/overflow gates).
+        carry2, iters_run = _stall_gated_rounds(
+            narrow_round, carry, iters, stall_tol, total_demand,
+        )
+        return epilogue(carry2, iters_run)
+
+    # Stall-gated path: a single-step warm probe first (warm_probe — the
+    # selection is exactly what the epilogue would compute). When it
+    # certifies the carry, the probe's assignment IS the answer and the
+    # solve exits after ONE price iteration — no shortlist, no narrow
+    # rounds, no duplicate epilogue selection. Cold zero prices herd the
+    # full-width argmax, fail the probe, and fall into the round loop
+    # with the probe's assignment seeding the best-iterate carry
+    # (replacing the inf sentinel — the first round's improvement test
+    # becomes real).
+    idx_p, valid_p, load_p, of_p, p_probe, probe_ok = warm_probe(
+        scores_f32, p_init, copies, cap, final_select,
+        lambda i, v: _implied_load(i, v, sizes, num_instances, load_impl),
+        eta * price_scale, stall_tol, total_demand,
     )
-    load_l = _implied_load(idx_l, valid_l, sizes, num_instances, load_impl)
-    of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
-    use_last = of_l <= best_of
-    idx = jnp.where(use_last, idx_l, best_idx)
-    valid = jnp.where(use_last, valid_l, best_valid)
-    load = jnp.where(use_last, load_l, best_load)
-    overflow = jnp.minimum(of_l, best_of)
-    return AuctionResult(
-        indices=idx, valid=valid, load=load, prices=price,
-        overflow=overflow,
-    )
+
+    def _probe_exit(_):
+        # Return the STEPPED prices, not p_init: steady-state drift then
+        # keeps nudging the carry toward the current load pattern instead
+        # of freezing it, and with of_p under the noise floor the step is
+        # tiny anyway.
+        return AuctionResult(
+            indices=idx_p, valid=valid_p, load=load_p, prices=p_probe,
+            overflow=of_p, iters_run=jnp.asarray(1, jnp.int32),
+        )
+
+    def _rounds(_):
+        seeded = (p_probe, p_init, idx_p, valid_p, load_p, of_p)
+        carry2, iters_run = _stall_gated_rounds(
+            narrow_round, seeded, iters, stall_tol, total_demand,
+        )
+        return epilogue(carry2, iters_run + 1)
+
+    return jax.lax.cond(probe_ok, _probe_exit, _rounds, None)

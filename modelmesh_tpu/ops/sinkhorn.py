@@ -8,9 +8,14 @@ cost matrix from ops.costs. The output potentials define a soft assignment
 
 TPU notes: the cost matrix stays bf16 in HBM (bandwidth is the bottleneck at
 100k x 1k and above); all potentials and log-sum-exp accumulation are f32.
-The loop is a ``lax.scan`` so the whole solve is one XLA program; no
-data-dependent Python control flow (fixed iteration count — this is a prior,
-not an exact solve, so tight convergence is unnecessary).
+With ``tol=0`` (default) the loop is a fixed-length ``lax.scan`` so the whole
+solve is one XLA program with no data-dependent control flow. With ``tol>0``
+the loop becomes a ``lax.while_loop`` over K-iteration *chunks* (the chunk
+body is still a fixed-length scan, so the compiled program is one stable
+XLA computation regardless of where the exit lands) that stops as soon as
+the row-marginal error drops below ``tol`` — the steady-state refresh path:
+a warm-started solve is already a chunk or two from its fixed point, and
+iterating to the full budget anyway throws that convergence away.
 """
 
 from __future__ import annotations
@@ -26,6 +31,9 @@ class SinkhornResult(NamedTuple):
     f: jax.Array        # f32[N] row potentials
     g: jax.Array        # f32[M] column potentials
     row_err: jax.Array  # f32[] final L1 row-marginal error (diagnostic)
+    # i32[] iterations actually run (== iters when tol=0; a warm-started
+    # early-exit solve reports fewer — the steady-state win, observable).
+    iters_run: jax.Array = None
 
 
 def _row_lse(C: jax.Array, g: jax.Array, eps: float) -> jax.Array:
@@ -58,7 +66,7 @@ def resolve_lse_impl(lse_impl: str) -> str:
     return "pallas" if on_tpu else "xla"
 
 
-@partial(jax.jit, static_argnames=("eps", "iters", "lse_impl"))
+@partial(jax.jit, static_argnames=("eps", "iters", "lse_impl", "tol", "chunk"))
 def sinkhorn(
     C: jax.Array,
     row_mass: jax.Array,
@@ -68,6 +76,8 @@ def sinkhorn(
     iters: int = 12,
     lse_impl: str = "auto",
     g0: jax.Array | None = None,
+    tol: float = 0.0,
+    chunk: int = 4,
 ) -> SinkhornResult:
     """Semi-unbalanced log-domain Sinkhorn: rows are equalities (every
     model's copy-mass must place), columns are CAPS.
@@ -86,6 +96,16 @@ def sinkhorn(
     iterations from the new fixed point — the same iteration budget
     converges tighter. Only g needs carrying: the first iteration derives
     f entirely from g, so a row-potential input would be dead code.
+
+    ``tol`` > 0 enables convergence-gated early exit: one probe iteration
+    runs first, and if it moved g by no more than ``tol * eps`` (bounding
+    the relative row-marginal error by ~tol) the solve returns immediately
+    with ``iters_run == 1`` — the steady-state warm-start fast exit.
+    Otherwise iterations continue in ``chunk``-sized blocks and the loop
+    stops once the relative L1 row-marginal error is <= tol (or the
+    ``iters`` budget, rounded up to probe + whole chunks, is spent). The
+    error check costs one extra row-LSE per chunk, amortized by the chunk
+    width.
     """
     row_mass = row_mass.astype(jnp.float32)
     col_mass = col_mass.astype(jnp.float32)
@@ -120,19 +140,99 @@ def sinkhorn(
         g = jnp.minimum(0.0, eps * (log_b - col_fn(C, f)))
         return (f, g), None
 
+    def run_iters(f, g, length):
+        (f, g), _ = jax.lax.scan(body, (f, g), None, length=length)
+        return f, g
+
+    def marginal_err(f, g):
+        # Relative L1 row-marginal violation of the implied plan.
+        row_sum = jnp.exp((f + eps * row_fn(C, g)) / eps)
+        return jnp.mean(jnp.abs(row_sum - row_mass)) / jnp.maximum(
+            jnp.mean(row_mass), 1e-30
+        )
+
     f_init = jnp.zeros_like(log_a)
     g_init = (
         jnp.minimum(0.0, g0.astype(jnp.float32))  # g <= 0 invariant
         if g0 is not None else jnp.zeros_like(log_b)
     )
-    (f, g), _ = jax.lax.scan(body, (f_init, g_init), None, length=iters)
-
-    # Diagnostic: row-marginal violation of the implied plan.
-    row_sum = jnp.exp((f + eps * row_fn(C, g)) / eps)
-    row_err = jnp.mean(jnp.abs(row_sum - row_mass)) / jnp.maximum(
-        jnp.mean(row_mass), 1e-30
+    # iters <= 0 keeps the fixed path: the gate's probe would run one
+    # unbudgeted iteration (and a zero chunk clamp would divide by zero).
+    if tol <= 0.0 or chunk <= 0 or iters <= 0:
+        f, g = run_iters(f_init, g_init, iters)
+        return SinkhornResult(
+            f=f, g=g, row_err=marginal_err(f, g),
+            iters_run=jnp.asarray(iters, jnp.int32),
+        )
+    f, g, row_err, iters_run = gated_sinkhorn_loop(
+        run_iters, marginal_err, f_init, g_init,
+        eps=eps, iters=iters, tol=tol, chunk=chunk,
     )
-    return SinkhornResult(f=f, g=g, row_err=row_err)
+    return SinkhornResult(f=f, g=g, row_err=row_err, iters_run=iters_run)
+
+
+def gated_sinkhorn_loop(
+    run_iters, marginal_err, f_init, g_init, *,
+    eps: float, iters: int, tol: float, chunk: int, dg_reduce=None,
+):
+    """Convergence-gated iteration driver shared by this module and
+    ``parallel/sharded_solver._sharded_sinkhorn`` (parameterized by the
+    iteration/error callbacks so the gate logic — probe bound, budget
+    rounding, iteration accounting — cannot drift between the two; the
+    parity tests pin potentials AND iters_run).
+
+    A single-iteration warm probe, then a while_loop over fixed-size
+    chunks. The probe runs one full iteration from the (possibly carried)
+    potentials and measures how far it moved g: the whole solve state is
+    a function of g, so a g-move of dg bounds the relative row-marginal
+    error by ~dg/eps — dg <= tol*eps means the carry was already at the
+    fixed point and the solve exits after ONE iteration instead of a
+    whole chunk (the steady-state fast exit; a cold zeros-g start fails
+    the probe and pays one extra iteration). The budget rounds UP to
+    probe + whole chunks (iters is a budget, not an exact count) and the
+    error carried out of the last chunk doubles as the final diagnostic —
+    no extra LSE at the end.
+
+    ``dg_reduce`` replicates the probe scalar across a device mesh (pmax
+    over the axis g is sharded on) so every device takes the same cond
+    branch; None on a single device. Returns (f, g, row_err, iters_run).
+    """
+    # The warm probe doesn't depend on chunking, so a small budget must
+    # not disable the gate — just clamp the chunk to the budget.
+    chunk = min(chunk, iters)
+    n_chunks = -(-iters // chunk)
+
+    def cond(carry):
+        step, _f, _g, err = carry
+        return (err > tol) & (step < n_chunks)
+
+    def wbody(carry):
+        step, f, g, _err = carry
+        f, g = run_iters(f, g, chunk)
+        return step + 1, f, g, marginal_err(f, g)
+
+    f1, g1 = run_iters(f_init, g_init, 1)
+    dg = jnp.max(jnp.abs(g1 - g_init))
+    if dg_reduce is not None:
+        dg = dg_reduce(dg)
+
+    def _probe_exit(_):
+        # dg/eps is the error bound the gate certified — reporting it
+        # instead of the exact marginal saves a row-LSE on the fast path.
+        return jnp.asarray(0, jnp.int32), f1, g1, dg / eps
+
+    def _chunked(_):
+        return jax.lax.while_loop(
+            cond,
+            wbody,
+            (jnp.asarray(0, jnp.int32), f1, g1,
+             jnp.asarray(jnp.inf, jnp.float32)),
+        )
+
+    step, f, g, row_err = jax.lax.cond(
+        dg <= tol * eps, _probe_exit, _chunked, None
+    )
+    return f, g, row_err, step * chunk + 1
 
 
 def plan_logits(

@@ -29,6 +29,16 @@ class SolveConfig(NamedTuple):
     sinkhorn_iters: int = 10
     auction_iters: int = 40
     eta: float = 0.5
+    # Convergence-gated early exit (steady-state refresh fast path). 0
+    # disables both gates (fixed iteration budgets — the cold-solve
+    # default). sinkhorn_tol gates on relative L1 row-marginal error,
+    # checked every sinkhorn_chunk iterations; auction_stall_tol gates on
+    # per-round price movement / best-overflow improvement (see
+    # ops.auction._stall_gated_rounds). With a gate enabled the iteration
+    # budgets round up to whole chunks/rounds.
+    sinkhorn_tol: float = 0.0
+    sinkhorn_chunk: int = 4
+    auction_stall_tol: float = 0.0
     # Gumbel sampling temperature for integral rounding; 0 disables
     # sampling. Scores are plan log-probs ((f+g-C)/eps), so tau=1.0 means
     # Gumbel-top-k samples placements ~ the transport plan itself — the
@@ -66,30 +76,35 @@ class Placement(NamedTuple):
     row_err: jax.Array   # f32[] sinkhorn marginal diagnostic
     f: jax.Array | None = None  # f32[N] row potentials (warm-start carry)
     g: jax.Array | None = None  # f32[M] column potentials
+    # f32[M] last-iterate congestion prices (warm-start carry for the next
+    # refresh's SolveInit.price0).
+    prices: jax.Array | None = None
+    # i32[] iterations each stage actually ran (== the configured budgets
+    # when the early-exit gates are off; fewer on a converged warm solve).
+    sinkhorn_iters_run: jax.Array | None = None
+    auction_iters_run: jax.Array | None = None
 
 
 class SolveInit(NamedTuple):
     """Warm-start carry from a previous solve (SURVEY.md section 7 hard
     part #4: incremental solves as cluster state churns). Columns must be
     id-aligned to the CURRENT problem's column order by the caller
-    (placement/jax_engine.py scatters by instance id). Only g is carried:
-    Sinkhorn's first iteration derives f entirely from g."""
+    (placement/jax_engine.py scatters by instance id). Only column state is
+    carried: Sinkhorn's first iteration derives f entirely from g, and the
+    auction's selection derives entirely from prices."""
 
     g0: jax.Array        # f32[M] column potentials
+    # f32[M] congestion prices (None = cold prices; kept optional so
+    # existing g0-only carries keep their jit cache entries).
+    price0: jax.Array | None = None
 
 
-@partial(jax.jit, static_argnames=("config",))
-def solve_placement(
+def _solve_placement_impl(
     problem: costs_mod.PlacementProblem,
-    config: SolveConfig = SolveConfig(),
-    seed: jax.Array | int = 0x5EED,
-    init: SolveInit | None = None,
+    config: SolveConfig,
+    seed: jax.Array | int,
+    init: SolveInit | None,
 ) -> Placement:
-    """Solve one global placement. ``seed`` is traced — vary it per solve
-    (e.g. janitor pass counter) so an unlucky rounding draw isn't frozen
-    forever; changing it never recompiles. ``init`` warm-starts the
-    Sinkhorn potentials from the previous refresh (same iteration budget,
-    tighter convergence)."""
     C = costs_mod.assemble_cost(problem, weights=config.weights, dtype=config.dtype)
     # Clamp copies to what rounding can actually place, BEFORE building the
     # transport marginals — otherwise the prior reserves phantom capacity.
@@ -100,6 +115,7 @@ def solve_placement(
         C, row_mass, free, eps=config.eps, iters=config.sinkhorn_iters,
         lse_impl=config.lse_impl,
         g0=None if init is None else init.g0,
+        tol=config.sinkhorn_tol, chunk=config.sinkhorn_chunk,
     )
     logits = _plan_logits(C, sk.f, sk.g, config.eps)
     res = _auction(
@@ -115,6 +131,8 @@ def solve_placement(
         load_impl=config.load_impl,
         noise_impl=config.noise_impl,
         final_select=config.final_select,
+        stall_tol=config.auction_stall_tol,
+        price0=None if init is None else init.price0,
     )
     return Placement(
         indices=res.indices,
@@ -124,4 +142,38 @@ def solve_placement(
         row_err=sk.row_err,
         f=sk.f,
         g=sk.g,
+        prices=res.prices,
+        sinkhorn_iters_run=sk.iters_run,
+        auction_iters_run=res.iters_run,
     )
+
+
+@partial(jax.jit, static_argnames=("config",))
+def solve_placement(
+    problem: costs_mod.PlacementProblem,
+    config: SolveConfig = SolveConfig(),
+    seed: jax.Array | int = 0x5EED,
+    init: SolveInit | None = None,
+) -> Placement:
+    """Solve one global placement. ``seed`` is traced — vary it per solve
+    (e.g. janitor pass counter) so an unlucky rounding draw isn't frozen
+    forever; changing it never recompiles. ``init`` warm-starts the
+    Sinkhorn potentials (and, when ``init.price0`` is set, the auction
+    prices) from the previous refresh — same iteration budgets, tighter
+    convergence, and with the config's early-exit gates enabled the
+    budgets are actually cut short once converged."""
+    return _solve_placement_impl(problem, config, seed, init)
+
+
+# Steady-state variant: identical program, but the warm-start carry (init:
+# g0 + price0) is DONATED — XLA reuses those HBM buffers for the outputs,
+# so a double-buffered refresh loop (placement/refresh_loop.py) never
+# reallocates the carry buffers. Kept as a SEPARATE jit entry: donation is
+# part of the executable signature, and the plain entry must keep accepting
+# non-donatable inputs (e.g. a numpy g0 the host still owns). CPU backends
+# ignore donation (harmless warning), so callers gate on platform.
+solve_placement_donated = partial(
+    jax.jit,
+    static_argnames=("config",),
+    donate_argnames=("init",),
+)(_solve_placement_impl)

@@ -22,6 +22,24 @@ MODEL_AXIS = "mdl"
 INSTANCE_AXIS = "inst"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` compat shim: the API graduated out of
+    ``jax.experimental`` (renaming ``check_rep`` -> ``check_vma``) in newer
+    releases; dispatch to whichever this jax provides so the sharded paths
+    run on both sides of the move."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def make_mesh(
     shape: Sequence[int] | None = None,
     devices: Sequence[jax.Device] | None = None,

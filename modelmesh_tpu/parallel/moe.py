@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from modelmesh_tpu.parallel import mesh as mesh_helpers
+
 EXPERT_AXIS = "exp"
 
 
@@ -126,7 +128,7 @@ def make_expert_parallel_ffn(
         y = jnp.einsum("tec,ecd->td", dispatch, back)
         return (y * gate[:, None]).astype(x.dtype)
 
-    shmapped = jax.shard_map(
+    shmapped = mesh_helpers.shard_map(
         body,
         mesh=mesh,
         # Expert weights genuinely SHARDED over the axis (the memory point

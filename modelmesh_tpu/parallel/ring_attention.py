@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from modelmesh_tpu.parallel import mesh as mesh_helpers
+
 SEQ_AXIS = "seq"
 
 _NEG_INF = -1.0e30
@@ -115,7 +117,7 @@ def make_ring_attention(mesh: Mesh, seq_len: int, *, causal: bool = True,
         _ring_body, n_dev=n_dev, block=block, causal=causal,
         axis_name=axis_name,
     )
-    shmapped = jax.shard_map(
+    shmapped = mesh_helpers.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )

@@ -26,13 +26,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from modelmesh_tpu.ops.sinkhorn import resolve_lse_impl
+from modelmesh_tpu.ops.sinkhorn import gated_sinkhorn_loop, resolve_lse_impl
 from modelmesh_tpu.ops.auction import (
     K_CAND,
     MAX_COPIES,
     RESHORTLIST_EVERY,
     _NEG_INF,
     _implied_load,
+    _stall_gated_rounds,
     check_rounding_config,
     final_candidate,
     hash_gumbel,
@@ -40,6 +41,7 @@ from modelmesh_tpu.ops.auction import (
     resolve_load_impl,
     select_from_candidates,
     shortlist,
+    warm_probe,
 )
 from modelmesh_tpu.ops.costs import INFEASIBLE, CostWeights, PlacementProblem
 from modelmesh_tpu.ops.solve import Placement, SolveConfig
@@ -96,7 +98,8 @@ def _lse(z_blk: jax.Array, axis: int, axis_name: str) -> jax.Array:
 
 
 def _sharded_sinkhorn(C, row_mass, col_mass, eps: float, iters: int,
-                      lse_impl: str = "xla", g0=None):
+                      lse_impl: str = "xla", g0=None,
+                      tol: float = 0.0, chunk: int = 4):
     # Semi-unbalanced (rows equality, columns CAPS via g <= 0) — must match
     # ops/sinkhorn.py exactly; the parity tests compare potentials.
     log_a = jnp.log(jnp.maximum(row_mass, 1e-30))
@@ -141,23 +144,45 @@ def _sharded_sinkhorn(C, row_mass, col_mass, eps: float, iters: int,
         g = jnp.minimum(0.0, eps * (log_b - col_lse(f)))
         return (f, g), None
 
+    def run_iters(f, g, length):
+        (f, g), _ = jax.lax.scan(body, (f, g), None, length=length)
+        return f, g
+
+    total = jax.lax.psum(jnp.sum(row_mass), MODEL_AXIS)
+
+    def marginal_err(f, g):
+        # Mirrors ops.sinkhorn's relative-L1 diagnostic: mean|violation| /
+        # mean(mass) == sum|violation| / sum(mass), psum'd so every device
+        # sees the identical (replicated) scalar — the while_loop cond
+        # below must agree across the mesh.
+        row_sum = jnp.exp((f + eps * row_lse(g)) / eps)
+        err = jax.lax.psum(jnp.sum(jnp.abs(row_sum - row_mass)), MODEL_AXIS)
+        return err / jnp.maximum(total, 1e-30)
+
     f_init = jnp.zeros_like(log_a)
     g_init = (
         jnp.minimum(0.0, g0.astype(jnp.float32))
         if g0 is not None else jnp.zeros_like(log_b)
     )
-    (f, g), _ = jax.lax.scan(body, (f_init, g_init), None, length=iters)
-
-    row_sum = jnp.exp((f + eps * row_lse(g)) / eps)
-    err = jax.lax.psum(jnp.sum(jnp.abs(row_sum - row_mass)), MODEL_AXIS)
-    total = jax.lax.psum(jnp.sum(row_mass), MODEL_AXIS)
-    err = err / jnp.maximum(total, 1e-30)
-    return f, g, err
+    if tol <= 0.0 or chunk <= 0 or iters <= 0:
+        f, g = run_iters(f_init, g_init, iters)
+        return f, g, marginal_err(f, g), jnp.asarray(iters, jnp.int32)
+    # Shared gate driver (probe + chunked while_loop) from ops.sinkhorn —
+    # the parity tests compare potentials AND iters_run, so the logic
+    # must not fork. g is sharded on the instance axis (and replicated
+    # across the model axis), so the probe scalar is pmax'd over
+    # INSTANCE_AXIS — every device takes the same cond branch.
+    return gated_sinkhorn_loop(
+        run_iters, marginal_err, f_init, g_init,
+        eps=eps, iters=iters, tol=tol, chunk=chunk,
+        dg_reduce=lambda dg: jax.lax.pmax(dg, INSTANCE_AXIS),
+    )
 
 
 def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
                      eta: float, load_impl: str = "auto",
-                     final_select: str = "exact"):
+                     final_select: str = "exact",
+                     stall_tol: float = 0.0, price0=None):
     """scores_full: [n_blk, M] (rows sharded on mdl, full instance width).
 
     Gumbel perturbation is folded in by the caller (per-shard key) so the
@@ -182,69 +207,120 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
     n_blk = scores_full.shape[0]
 
     def narrow_round(carry, length):
-        price, best_idx, best_valid, best_load, best_of = carry
+        price, best_price, best_idx, best_valid, best_load, best_of = carry
         cand_vals, cand_idx = shortlist(scores_full, price, kc)
 
         def body(carry, _):
-            price, bi, bv, bl, bo = carry
+            price, bp, bi, bv, bl, bo = carry
             idx, valid = select_from_candidates(
                 cand_vals, cand_idx, copies, price
             )
             load = implied_load(idx, valid)
             of = jnp.sum(jnp.maximum(load - cap, 0.0))
             better = of < bo
+            # Best-iterate SELECTION prices — the warm-start carry, same
+            # as ops.auction (last-iterate prices are mid-cobweb).
+            bp = jnp.where(better, price, bp)
             bi = jnp.where(better, idx, bi)
             bv = jnp.where(better, valid, bv)
             bl = jnp.where(better, load, bl)
             bo = jnp.minimum(of, bo)
-            return (price_step(load, cap, price, eta), bi, bv, bl, bo), None
+            return (
+                price_step(load, cap, price, eta), bp, bi, bv, bl, bo,
+            ), None
 
-        carry, _ = jax.lax.scan(
-            body, (price, best_idx, best_valid, best_load, best_of), None,
-            length=length,
-        )
+        carry, _ = jax.lax.scan(body, carry, None, length=length)
         return carry
 
-    price0 = jnp.zeros((num_instances,), jnp.float32)
+    p_init = (
+        jnp.maximum(price0.astype(jnp.float32), 0.0)
+        if price0 is not None
+        else jnp.zeros((num_instances,), jnp.float32)
+    )
+
+    def epilogue(carry, iters_run):
+        price, best_price, best_idx, best_valid, best_load, best_of = carry
+        if final_select == "none":
+            return (best_idx, best_valid, best_load, best_price, best_of,
+                    iters_run)
+        idx_l, valid_l = final_candidate(
+            scores_full - price[None, :], copies, final_select
+        )
+        load_l = implied_load(idx_l, valid_l)
+        of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
+        use_last = of_l <= best_of
+        idx = jnp.where(use_last, idx_l, best_idx)
+        valid = jnp.where(use_last, valid_l, best_valid)
+        # Winner's load rides the carry (saves a recompute AND its psum).
+        load = jnp.where(use_last, load_l, best_load)
+        overflow = jnp.minimum(of_l, best_of)
+        return (idx, valid, load, jnp.where(use_last, price, best_price),
+                overflow, iters_run)
+
+    # Cold carry (price, best_price, best_idx, best_valid, best_load,
+    # best_of) — one definition for every branch, matching ops.auction,
+    # so a future layout change cannot desync them.
     carry = (
-        price0,
+        p_init,
+        p_init,
         jnp.zeros((n_blk, MAX_COPIES), jnp.int32),
         jnp.zeros((n_blk, MAX_COPIES), bool),
         jnp.zeros((num_instances,), jnp.float32),
         jnp.asarray(jnp.inf, jnp.float32),
     )
-    for length in [RESHORTLIST_EVERY] * (iters // RESHORTLIST_EVERY) + (
-        [iters % RESHORTLIST_EVERY] if iters % RESHORTLIST_EVERY else []
-    ):
-        carry = narrow_round(carry, length)
-    price, best_idx, best_valid, best_load, best_of = carry
-    if final_select == "none":
-        return best_idx, best_valid, best_load, price, best_of
-    idx_l, valid_l = final_candidate(
-        scores_full - price[None, :], copies, final_select
+    if stall_tol <= 0.0:
+        for length in [RESHORTLIST_EVERY] * (iters // RESHORTLIST_EVERY) + (
+            [iters % RESHORTLIST_EVERY] if iters % RESHORTLIST_EVERY else []
+        ):
+            carry = narrow_round(carry, length)
+        return epilogue(carry, jnp.asarray(iters, jnp.int32))
+
+    total_demand = jax.lax.psum(
+        jnp.sum(sizes * copies.astype(jnp.float32)), MODEL_AXIS
     )
-    load_l = implied_load(idx_l, valid_l)
-    of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
-    use_last = of_l <= best_of
-    idx = jnp.where(use_last, idx_l, best_idx)
-    valid = jnp.where(use_last, valid_l, best_valid)
-    # Winner's load rides the carry (saves a recompute AND its psum).
-    load = jnp.where(use_last, load_l, best_load)
-    overflow = jnp.minimum(of_l, best_of)
-    return idx, valid, load, price, overflow
+    if final_select == "none":
+        # Mirror ops.auction: "none" avoids full-width selections, and
+        # the warm probe is one — gate the rounds only.
+        carry, iters_run = _stall_gated_rounds(
+            narrow_round, carry, iters, stall_tol, total_demand,
+        )
+        return epilogue(carry, iters_run)
+
+    # Shared warm probe (ops.auction.warm_probe — the gate arithmetic
+    # must not fork between the solvers). implied_load psums over the
+    # model axis, so every probe scalar is replicated and all devices
+    # take the same cond branch.
+    idx_p, valid_p, load_p, of_p, p_probe, probe_ok = warm_probe(
+        scores_full, p_init, copies, cap, final_select,
+        implied_load, eta, stall_tol, total_demand,
+    )
+
+    def _probe_exit(_):
+        return (idx_p, valid_p, load_p, p_probe, of_p,
+                jnp.asarray(1, jnp.int32))
+
+    def _rounds(_):
+        seeded = (p_probe, p_init, idx_p, valid_p, load_p, of_p)
+        carry, iters_run = _stall_gated_rounds(
+            narrow_round, seeded, iters, stall_tol, total_demand,
+        )
+        return epilogue(carry, iters_run + 1)
+
+    return jax.lax.cond(probe_ok, _probe_exit, _rounds, None)
 
 
 def _solve_kernel(
-    p: PlacementProblem, seed: jax.Array, g0: jax.Array,
+    p: PlacementProblem, seed: jax.Array, g0: jax.Array, price0: jax.Array,
     config: SolveConfig, weights: CostWeights,
 ):
     C = _cost_block(p, weights, config.dtype)
     copies = jnp.minimum(p.copies, MAX_COPIES)
     row_mass = p.sizes * copies.astype(jnp.float32)
     free = jnp.maximum(p.capacity - p.reserved, 0.0)
-    f, g, row_err = _sharded_sinkhorn(
+    f, g, row_err, sk_iters = _sharded_sinkhorn(
         C, row_mass, free, config.eps, config.sinkhorn_iters,
         lse_impl=resolve_lse_impl(config.lse_impl), g0=g0,
+        tol=config.sinkhorn_tol, chunk=config.sinkhorn_chunk,
     )
     # Quantize to the cost dtype exactly like ops.sinkhorn.plan_logits does,
     # so single-device and sharded rounding see identical scores.
@@ -276,14 +352,22 @@ def _solve_kernel(
             logits_full > _NEG_INF / 2, logits_full + noise, logits_full
         )
     free_full = jax.lax.all_gather(free, INSTANCE_AXIS, axis=0, tiled=True)
-    idx, valid, load, _price, overflow = _sharded_auction(
+    price0_full = jax.lax.all_gather(price0, INSTANCE_AXIS, axis=0, tiled=True)
+    idx, valid, load, price, overflow, au_iters = _sharded_auction(
         logits_full, p.sizes, copies, free_full, config.auction_iters,
         config.eta, load_impl=config.load_impl,
         final_select=config.final_select,
+        stall_tol=config.auction_stall_tol, price0=price0_full,
     )
+    # Prices are full-width and identical on every device; slice this
+    # shard's block so the output can ride the ``inst``-sharded spec like g.
+    m_blk = free.shape[0]
+    blk = jax.lax.axis_index(INSTANCE_AXIS) * m_blk
+    price_blk = jax.lax.dynamic_slice_in_dim(price, blk, m_blk)
     return Placement(
         indices=idx, valid=valid, load=load, overflow=overflow,
-        row_err=row_err, f=f, g=g,
+        row_err=row_err, f=f, g=g, prices=price_blk,
+        sinkhorn_iters_run=sk_iters, auction_iters_run=au_iters,
     )
 
 
@@ -309,15 +393,16 @@ def make_sharded_solver(
         config.noise_impl, config.final_select, config.auction_iters
     )
     col = P(INSTANCE_AXIS)
-    in_specs = (mesh_mod.problem_pspec(), P(), col)
+    in_specs = (mesh_mod.problem_pspec(), P(), col, col)
     row = P(MODEL_AXIS)
     out_specs = Placement(
         indices=row, valid=row, load=P(), overflow=P(), row_err=P(),
-        f=row, g=col,
+        f=row, g=col, prices=col,
+        sinkhorn_iters_run=P(), auction_iters_run=P(),
     )
     kernel = partial(_solve_kernel, config=config, weights=weights)
-    shmapped = jax.shard_map(
-        lambda prob, seed, g0: kernel(prob, seed, g0),
+    shmapped = mesh_mod.shard_map(
+        lambda prob, seed, g0, price0: kernel(prob, seed, g0, price0),
         mesh=mesh,
         in_specs=in_specs,
         out_specs=out_specs,
@@ -325,10 +410,12 @@ def make_sharded_solver(
     )
     jitted = jax.jit(shmapped)
 
-    def solver(problem: PlacementProblem, seed=0x5EED, g0=None):
+    def solver(problem: PlacementProblem, seed=0x5EED, g0=None, price0=None):
         if g0 is None:
             g0 = jnp.zeros(problem.capacity.shape, jnp.float32)
-        return jitted(problem, jnp.asarray(seed, jnp.uint32), g0)
+        if price0 is None:
+            price0 = jnp.zeros(problem.capacity.shape, jnp.float32)
+        return jitted(problem, jnp.asarray(seed, jnp.uint32), g0, price0)
 
     return solver
 

@@ -69,6 +69,71 @@ class ProblemColumns(NamedTuple):
     placeable: np.ndarray   # bool[M] not shutting down / not disabled
 
 
+class SnapshotCache:
+    """Everything needed to PATCH the last snapshot instead of rebuilding it
+    (the delta-snapshot fast path). Holds the raw per-record inputs the
+    derived ``ProblemColumns`` arrays were computed from (last_used /
+    used / lru_ts; rpm is NOT cached — every patch re-reads it fresh from
+    ``rpm_fn``) plus the id->index maps, so a steady-state refresh only
+    touches the dirty records — O(dirty + nnz + M) instead of the full
+    O(N) Python pass over every record.
+
+    Mutation discipline: ``patch`` copies an array before changing it, so
+    ProblemColumns handed out by earlier snapshots stay frozen even while
+    an in-flight solve still reads them (the pipelined refresh overlap)."""
+
+    __slots__ = (
+        "cols", "last_used", "used", "lru_ts", "model_pos",
+        "inst_pos", "zone_id", "tmap", "default_size_units", "max_copies",
+        "constraints",
+    )
+
+    def __init__(self, cols, last_used, used, lru_ts, zone_id, tmap,
+                 default_size_units, max_copies, constraints):
+        self.cols = cols
+        self.last_used = last_used
+        self.used = used
+        self.lru_ts = lru_ts
+        self.model_pos = {mid: i for i, mid in enumerate(cols.model_ids)}
+        self.inst_pos = {iid: j for j, iid in enumerate(cols.instance_ids)}
+        self.zone_id = zone_id
+        self.tmap = tmap
+        self.constraints = constraints
+        self.default_size_units = default_size_units
+        self.max_copies = max_copies
+
+
+def _rpm_column(rpm_fn: Optional[RpmSource], model_ids, n: int) -> np.ndarray:
+    """Fresh per-model rpm read — shared by ``snapshot_columns`` and
+    ``patch_columns`` (the delta path's contract is bit-identical output,
+    including the all-zeros ``rpm_fn=None`` case)."""
+    if rpm_fn is None:
+        return np.zeros(n, np.float32)
+    lookup = rpm_fn.get if isinstance(rpm_fn, Mapping) else rpm_fn
+    return np.fromiter((lookup(mid) or 0 for mid in model_ids), np.float32, n)
+
+
+def _derived_columns(rpm, last_used, sizes, loaded_rows, loaded_cols,
+                     used, lru_ts, now, m: int):
+    """Time/traffic-derived columns — one definition shared by
+    ``snapshot_columns`` and ``patch_columns`` so a formula tweak cannot
+    desync patched snapshots from full rebuilds. Returns
+    (rates, reserved, lru_age)."""
+    # Recency proxy where the rate view reads 0 (rpm_fn is typically the
+    # refresher's *local* rate view, blind to models served elsewhere).
+    age_min = np.maximum(0.0, (now - last_used) / 60_000.0)
+    rates = np.where(rpm > 0, rpm, 1000.0 / (1.0 + age_min)).astype(np.float32)
+    # reserved = advertised usage not attributable to managed (loaded) mass.
+    managed = np.bincount(
+        loaded_cols, weights=sizes[loaded_rows], minlength=m
+    ).astype(np.float32) if m else np.empty(0, np.float32)
+    reserved = np.maximum(0.0, used - managed)
+    lru_age = np.where(
+        lru_ts > 0, np.maximum(0.0, (now - lru_ts) / 1000.0), 0.0
+    ).astype(np.float32)
+    return rates, reserved, lru_age
+
+
 def snapshot_columns(
     models: Sequence[tuple[str, ModelRecord]],
     instances: Sequence[tuple[str, InstanceRecord]],
@@ -76,10 +141,13 @@ def snapshot_columns(
     default_size_units: int = 128,
     max_copies: int = 8,
     constraints=None,
-) -> ProblemColumns:
+    return_cache: bool = False,
+):
     """Vectorized snapshot: one C-speed pass per column, no per-model Python
     loop bodies (round-2 VERDICT weak #2 — the old row loop cost seconds at
-    100k models, dwarfing the device solve it fed)."""
+    100k models, dwarfing the device solve it fed). With ``return_cache``
+    the (cols, SnapshotCache) pair comes back so later refreshes can use
+    ``patch_columns`` instead of a full rebuild."""
     model_ids = [mid for mid, _ in models]
     instance_ids = [iid for iid, _ in instances]
     n, m = len(model_ids), len(instance_ids)
@@ -97,15 +165,7 @@ def snapshot_columns(
         1, max_copies,
     ).astype(np.int32)
     last_used = np.fromiter((mr.last_used for mr in recs), np.int64, n)
-    if rpm_fn is None:
-        rpm = np.zeros(n, np.float32)
-    else:
-        lookup = rpm_fn.get if isinstance(rpm_fn, Mapping) else rpm_fn
-        rpm = np.fromiter((lookup(mid) or 0 for mid in model_ids), np.float32, n)
-    # Recency proxy where the rate view reads 0 (rpm_fn is typically the
-    # refresher's *local* rate view, blind to models served elsewhere).
-    age_min = np.maximum(0.0, (now - last_used) / 60_000.0)
-    rates = np.where(rpm > 0, rpm, 1000.0 / (1.0 + age_min)).astype(np.float32)
+    rpm = _rpm_column(rpm_fn, model_ids, n)
 
     pairs = [
         (i, inst_index[iid])
@@ -146,25 +206,189 @@ def snapshot_columns(
         np.fromiter((rec.capacity_units for rec in irecs), np.float32, m), 1.0
     )
     used = np.fromiter((rec.used_units for rec in irecs), np.float32, m)
-    # reserved = advertised usage not attributable to managed (loaded) mass.
-    managed = np.bincount(
-        loaded_cols, weights=sizes[loaded_rows], minlength=m
-    ).astype(np.float32) if m else np.empty(0, np.float32)
-    reserved = np.maximum(0.0, used - managed)
     lru_ts = np.fromiter((rec.lru_ts for rec in irecs), np.int64, m)
-    lru_age = np.where(
-        lru_ts > 0, np.maximum(0.0, (now - lru_ts) / 1000.0), 0.0
-    ).astype(np.float32)
+    rates, reserved, lru_age = _derived_columns(
+        rpm, last_used, sizes, loaded_rows, loaded_cols, used, lru_ts, now, m
+    )
     busy = np.fromiter((rec.req_per_minute for rec in irecs), np.float32, m)
     zone = np.fromiter((zone_id[rec.zone] for rec in irecs), np.int32, m)
     placeable = np.fromiter(
         (not rec.shutting_down and not rec.disabled for rec in irecs), bool, m
     )
-    return ProblemColumns(
+    cols = ProblemColumns(
         model_ids, instance_ids, sizes, copies, rates, loaded_rows,
         loaded_cols, type_idx, req_masks, pref_masks, capacity, reserved,
         lru_age, busy, zone, placeable,
     )
+    if not return_cache:
+        return cols
+    return cols, SnapshotCache(
+        cols, last_used, used, lru_ts, zone_id, tmap,
+        default_size_units, max_copies, constraints,
+    )
+
+
+# Consecutive delta refreshes before JaxPlacementStrategy forces a full
+# rebuild: bounds how long the frozen noise epoch can pin an unlucky
+# Gumbel draw (and how long an unmarked-dirty record can stay stale)
+# when perpetual small churn never trips the dirty-fraction fallback.
+# At the default 1 s steady cadence this rotates the draw about once a
+# minute — one cold-cost solve amortized over 63 fast ones.
+MAX_DELTA_STREAK = 64
+
+# Above this dirty fraction a patch stops paying: the per-record Python
+# work approaches the full rebuild's, and the rebuild resets any drift.
+MAX_DIRTY_FRAC = 0.25
+
+
+def patch_columns(
+    cache: SnapshotCache,
+    models: Sequence[tuple[str, ModelRecord]],
+    instances: Sequence[tuple[str, InstanceRecord]],
+    rpm_fn: Optional[RpmSource] = None,
+    dirty_models: Optional[set] = None,
+    dirty_instances: Optional[set] = None,
+    constraints=None,
+    max_dirty_frac: float = MAX_DIRTY_FRAC,
+):
+    """Delta snapshot: patch the cached ``ProblemColumns`` for the dirty
+    records only. Returns the new cols (and updates ``cache`` in place), or
+    ``None`` when a patch is unsafe/unprofitable and the caller must fall
+    back to a full ``snapshot_columns`` rebuild:
+
+    - the model/instance lists changed shape (joins/leaves re-index rows
+      and columns — the COO and the warm carries key off positions),
+    - a dirty id is unknown or no longer at its cached position,
+    - a dirty record introduces a new model type or zone (both would need
+      new mask/id rows),
+    - ``constraints`` is not the object the snapshot was built under
+      (the cached masks' provenance must match),
+    - the dirty fraction exceeds ``max_dirty_frac``.
+
+    Callers must mark every changed record dirty (the tracking contract —
+    ``JaxPlacementStrategy.mark_dirty``); unmarked changes go stale until
+    the next full rebuild. Columns that can move WITHOUT a record change
+    are recomputed for ALL records every patch: the time-derived ones
+    (rates' recency proxy, lru_age) vectorized from the cached raw inputs,
+    and rpm re-read from ``rpm_fn`` (traffic shifts don't touch records,
+    so rpm staleness cannot be dirty-tracked — one dict get per model,
+    a sliver of the full rebuild's per-record Python work)."""
+    cols = cache.cols
+    n, m = len(cols.model_ids), len(cols.instance_ids)
+    if len(models) != n or len(instances) != m:
+        return None
+    if constraints is not cache.constraints:
+        # The cached masks were built under a different constraints
+        # object — patching dirty columns with the new one would mix
+        # provenances; force a rebuild (which re-primes the cache).
+        return None
+    dm = dirty_models or set()
+    di = dirty_instances or set()
+    if (len(dm) + len(di)) > max_dirty_frac * (n + m):
+        return None
+    now = now_ms()
+
+    sizes, copies, type_idx = cols.sizes, cols.copies, cols.type_idx
+    last_used = cache.last_used
+    # Fresh rpm for EVERYONE (shared _rpm_column): a model whose traffic
+    # moved from 0 to hot without any record change would otherwise serve
+    # a stale recency-proxy rate until the next full rebuild.
+    rpm = _rpm_column(rpm_fn, cols.model_ids, n)
+    loaded_rows, loaded_cols = cols.loaded_rows, cols.loaded_cols
+    if dm:
+        rows_i = []
+        for mid in dm:
+            i = cache.model_pos.get(mid)
+            if i is None or models[i][0] != mid:
+                return None
+            mr = models[i][1]
+            if mr.model_type not in cache.tmap:
+                return None
+            rows_i.append(i)
+        sizes, copies, type_idx = (
+            np.array(sizes), np.array(copies), np.array(type_idx)
+        )
+        last_used = np.array(last_used)
+        for i in rows_i:
+            mr = models[i][1]
+            sizes[i] = mr.size_units or cache.default_size_units
+            copies[i] = min(max(mr.copy_count, 1), cache.max_copies)
+            last_used[i] = mr.last_used
+            type_idx[i] = cache.tmap[mr.model_type]
+        # COO patch: drop the dirty rows' pairs, append their fresh ones.
+        dirty_idx = np.asarray(rows_i, np.int32)
+        keep = ~np.isin(loaded_rows, dirty_idx)
+        new_pairs = [
+            (i, cache.inst_pos[iid])
+            for i in rows_i
+            for iid in models[i][1].instance_ids
+            if iid in cache.inst_pos
+        ]
+        loaded_rows = np.concatenate([
+            loaded_rows[keep],
+            np.fromiter((p[0] for p in new_pairs), np.int32, len(new_pairs)),
+        ])
+        loaded_cols = np.concatenate([
+            loaded_cols[keep],
+            np.fromiter((p[1] for p in new_pairs), np.int32, len(new_pairs)),
+        ])
+
+    capacity, busy, zone, placeable = (
+        cols.capacity, cols.busy, cols.zone, cols.placeable
+    )
+    used, lru_ts = cache.used, cache.lru_ts
+    req_masks, pref_masks = cols.req_masks, cols.pref_masks
+    if di:
+        cols_j = []
+        for iid in di:
+            j = cache.inst_pos.get(iid)
+            if j is None or instances[j][0] != iid:
+                return None
+            if instances[j][1].zone not in cache.zone_id:
+                return None
+            cols_j.append(j)
+        capacity, busy, zone, placeable = (
+            np.array(capacity), np.array(busy), np.array(zone),
+            np.array(placeable),
+        )
+        used, lru_ts = np.array(used), np.array(lru_ts)
+        patch_masks = constraints is not None and cache.tmap
+        if patch_masks:
+            req_masks = np.array(req_masks)
+            pref_masks = np.array(pref_masks)
+        for j in cols_j:
+            rec = instances[j][1]
+            capacity[j] = max(rec.capacity_units, 1.0)
+            used[j] = rec.used_units
+            lru_ts[j] = rec.lru_ts
+            busy[j] = rec.req_per_minute
+            zone[j] = cache.zone_id[rec.zone]
+            placeable[j] = not rec.shutting_down and not rec.disabled
+            if patch_masks:
+                for mtype, ti in cache.tmap.items():
+                    req_masks[ti, j] = constraints.is_candidate(
+                        mtype, rec.labels
+                    )
+                    pref_masks[ti, j] = constraints.is_preferred(
+                        mtype, rec.labels
+                    )
+
+    # Derived columns: recomputed VECTORIZED for everyone (shared
+    # _derived_columns) — time moves for clean records too, and `reserved`
+    # couples instances to the loaded mass of (possibly dirty) models.
+    rates, reserved, lru_age = _derived_columns(
+        rpm, last_used, sizes, loaded_rows, loaded_cols, used, lru_ts, now, m
+    )
+
+    new_cols = ProblemColumns(
+        cols.model_ids, cols.instance_ids, sizes, copies, rates,
+        loaded_rows, loaded_cols, type_idx, req_masks, pref_masks,
+        capacity, reserved, lru_age, busy, zone, placeable,
+    )
+    cache.cols = new_cols
+    cache.last_used = last_used
+    cache.used, cache.lru_ts = used, lru_ts
+    return new_cols
 
 
 def _bucket(x: int, floor: int = 256) -> int:
@@ -307,6 +531,9 @@ def solve_config_from_env():
         ("load_impl", "MM_SOLVER_LOAD_IMPL", str),
         ("noise_impl", "MM_SOLVER_NOISE_IMPL", str),
         ("final_select", "MM_SOLVER_FINAL_SELECT", str),
+        ("sinkhorn_tol", "MM_SOLVER_SINKHORN_TOL", float),
+        ("sinkhorn_chunk", "MM_SOLVER_SINKHORN_CHUNK", int),
+        ("auction_stall_tol", "MM_SOLVER_AUCTION_STALL_TOL", float),
     ):
         raw = envs.get(env)
         if raw not in (None, ""):
@@ -369,9 +596,11 @@ class GlobalPlan:
         self.adopted_at_ms = solved_at_ms
         # Local-only stage timings from solve_plan (not serialized).
         self.stats: dict[str, float] = {}
-        # Per-instance column potentials for warm-starting the next solve
-        # (local-only: followers never need it, only the refresher does).
+        # Per-instance column potentials / congestion prices for
+        # warm-starting the next solve (local-only: followers never need
+        # them, only the refresher does).
         self.warm_g: Optional[dict[str, float]] = None
+        self.warm_price: Optional[dict[str, float]] = None
 
     @classmethod
     def from_columnar(
@@ -589,59 +818,91 @@ class GlobalPlan:
         return plan
 
 
-def solve_plan(
-    models: Sequence[tuple[str, ModelRecord]],
-    instances: Sequence[tuple[str, InstanceRecord]],
-    rpm_fn: Optional[RpmSource] = None,
+class PendingSolve(NamedTuple):
+    """A dispatched-but-not-finalized refresh: the device is (possibly
+    still) crunching ``sol`` while the host is free to build the NEXT
+    snapshot — the pipelined refresh overlap (placement/refresh_loop.py).
+    ``sol`` holds async device arrays; ``finalize_plan`` blocks on them."""
+
+    cols: ProblemColumns
+    sol: object          # ops.solve.Placement (device arrays, in flight)
+    t_start: float       # perf_counter at snapshot start
+    t_snapshot: float    # perf_counter when the host snapshot was done
+    t_dispatch: float    # perf_counter when the solve was enqueued
+    warm: bool
+
+
+def dispatch_solve(
+    cols: ProblemColumns,
     seed: int = 0,
-    constraints=None,
     mesh=None,
     warm_g: Optional[Mapping[str, float]] = None,
+    warm_price: Optional[Mapping[str, float]] = None,
     config=None,
-) -> GlobalPlan:
-    """One global solve -> GlobalPlan (blocking; runs on the JAX device).
+    carry=None,
+    donate: bool = False,
+    t_start: Optional[float] = None,
+    t_snapshot: Optional[float] = None,
+) -> PendingSolve:
+    """Expand ``cols`` on device and enqueue the solve WITHOUT blocking.
 
-    Stage timings land in ``plan.stats`` (snapshot / device solve / plan
-    extraction, milliseconds) — the e2e refresh cost, not just the kernel
-    (round-2 VERDICT weak #2). Shapes are bucket-padded so consecutive
-    refreshes with drifting model counts reuse the compiled solver.
+    JAX dispatch is asynchronous: the returned PendingSolve's arrays are
+    futures, and the host can immediately go build the next snapshot while
+    the device works — ``finalize_plan`` collects the result.
 
-    ``mesh``: a parallel.mesh device mesh shards the solve across chips
-    (parallel/sharded_solver.py) — the 1M x 10k ladder path. Bucket sizes
-    are powers of two or 3·2^k, so any power-of-two mesh axis ≤ the pad
-    floors (256 rows, 64 cols) divides them evenly.
+    Warm-start carries, in order of preference: ``carry`` as (g0, price0)
+    DEVICE arrays from the previous solve (already bucket-padded and
+    column-aligned — the double-buffered steady-state path, no host round
+    trip); else the ``warm_g`` / ``warm_price`` per-instance-id dicts
+    scattered onto zeros (robust to joins/leaves); else cold zeros. The
+    carry arrays are ALWAYS materialized — switching the jitted solve's
+    init between None and an array would change the argument pytree and
+    force a recompile on the first warm refresh.
 
-    ``config``: a SolveConfig overriding the solver defaults (None keeps
-    the compiled-default cache entry). The strategy builds one from the
-    MM_SOLVER_* env knobs (solve_config_from_env).
-
-    ``warm_g``: per-instance-id column potentials from the previous solve
-    (``plan.warm_g``) — warm-starts Sinkhorn (SURVEY.md section 7 hard
-    part #4, incremental solves as state churns). Only g needs carrying:
-    the first iteration derives f entirely from g, and keying by instance
-    id makes the carry robust to models/instances joining or leaving.
+    ``donate=True`` routes through the buffer-donating jit entry: the
+    carry buffers are consumed and XLA reuses their HBM for the outputs,
+    so a steady-state loop never reallocates them. Only safe when the
+    caller hands over ownership (device ``carry`` it won't reuse) and the
+    backend honors donation (TPU/GPU; CPU warns and copies).
     """
-    import jax
+    from modelmesh_tpu.ops.solve import (
+        SolveConfig,
+        SolveInit,
+        solve_placement,
+        solve_placement_donated,
+    )
 
-    from modelmesh_tpu.ops.solve import solve_placement
-
-    if not models or not instances:
-        return GlobalPlan({}, now_ms(), 0.0)
-    t0 = time.perf_counter()
-    cols = snapshot_columns(models, instances, rpm_fn, constraints=constraints)
-    t1 = time.perf_counter()
-    # Warm-start column potentials, id-aligned to this snapshot's column
-    # order; instances unknown to the carry (new pods) start at 0 = cold.
-    # ALWAYS materialized (zeros = cold): switching the jitted solve's
-    # init between None and an array would change the argument pytree and
-    # force a full recompile on the first warm refresh.
-    g0 = np.zeros(_bucket(len(cols.instance_ids), 64), np.float32)
-    if warm_g:
-        for j, iid in enumerate(cols.instance_ids):
-            g0[j] = warm_g.get(iid, 0.0)
+    t_start = time.perf_counter() if t_start is None else t_start
+    t_snapshot = time.perf_counter() if t_snapshot is None else t_snapshot
+    m_pad = _bucket(len(cols.instance_ids), 64)
+    if carry is not None:
+        g0, price0 = carry
+        if g0.shape[0] != m_pad or price0.shape[0] != m_pad:
+            raise ValueError(
+                f"device carry shape {g0.shape[0]} != padded columns {m_pad}"
+            )
+        warm = True
+    else:
+        # Host path: id-aligned scatter; instances unknown to the carry
+        # (new pods) start at 0 = cold.
+        g0 = np.zeros(m_pad, np.float32)
+        price0 = np.zeros(m_pad, np.float32)
+        if warm_g:
+            for j, iid in enumerate(cols.instance_ids):
+                g0[j] = warm_g.get(iid, 0.0)
+        if warm_price:
+            for j, iid in enumerate(cols.instance_ids):
+                price0[j] = warm_price.get(iid, 0.0)
+        warm = bool(warm_g)
     if mesh is not None:
         from modelmesh_tpu.parallel.mesh import INSTANCE_AXIS, MODEL_AXIS
 
+        if donate:
+            # Donation is only wired through the single-device jit entry;
+            # silently dropping the flag would let a caller skip the
+            # carry readback (as donors must) with nothing ever donated,
+            # permanently staling its warm-start dicts.
+            raise ValueError("donate=True is not supported with mesh")
         if MODEL_AXIS not in mesh.shape or INSTANCE_AXIS not in mesh.shape:
             raise ValueError(
                 f"mesh axes {tuple(mesh.shape)} != "
@@ -649,24 +910,36 @@ def solve_plan(
                 "parallel.mesh.make_mesh"
             )
         n_mdl, n_inst = mesh.shape[MODEL_AXIS], mesh.shape[INSTANCE_AXIS]
-        if _bucket(len(cols.model_ids)) % n_mdl or (
-            _bucket(len(cols.instance_ids), 64) % n_inst
-        ):
+        if _bucket(len(cols.model_ids)) % n_mdl or m_pad % n_inst:
             raise ValueError(
                 f"mesh {dict(mesh.shape)} does not divide the padded problem"
             )
         problem = _expand_problem_device(cols, pad=True, mesh=mesh)
-        sol = jax.block_until_ready(
-            _solver_for(mesh, config)(problem, seed=seed, g0=g0)
+        sol = _solver_for(mesh, config)(
+            problem, seed=seed, g0=g0, price0=price0
         )
     else:
-        from modelmesh_tpu.ops.solve import SolveInit
-
         problem = _expand_problem_device(cols, pad=True)
-        kw = {} if config is None else {"config": config}
-        sol = jax.block_until_ready(
-            solve_placement(problem, seed=seed, init=SolveInit(g0=g0), **kw)
-        )
+        # Always pass config explicitly: solve_placement defaults it, but
+        # the donated entry jits _solve_placement_impl directly (no
+        # default) — config is static, so the literal SolveConfig() hits
+        # the same cache entry as the wrapper's default.
+        cfg = SolveConfig() if config is None else config
+        solve = solve_placement_donated if donate else solve_placement
+        sol = solve(problem, config=cfg, seed=seed,
+                    init=SolveInit(g0=g0, price0=price0))
+    return PendingSolve(
+        cols=cols, sol=sol, t_start=t_start, t_snapshot=t_snapshot,
+        t_dispatch=time.perf_counter(), warm=warm,
+    )
+
+
+def finalize_plan(pending: PendingSolve) -> GlobalPlan:
+    """Block on a dispatched solve and pack it into a GlobalPlan."""
+    import jax
+
+    cols, sol = pending.cols, pending.sol
+    sol = jax.block_until_ready(sol)
     t2 = time.perf_counter()
     # Compact readback: u16 indices + per-row valid counts instead of the
     # raw i32[N,K] + bool[N,K] (2.1 MB vs 5.2 MB at the padded 100k tier —
@@ -692,21 +965,89 @@ def solve_plan(
     model_ids = [cols.model_ids[i] for i in order.tolist()]
     t3 = time.perf_counter()
     plan = GlobalPlan.from_columnar(
-        model_ids, counts, flat, cols.instance_ids, now_ms(), (t3 - t0) * 1e3
+        model_ids, counts, flat, cols.instance_ids, now_ms(),
+        (t3 - pending.t_start) * 1e3,
     )
     plan.stats = {
-        "snapshot_ms": (t1 - t0) * 1e3,
-        "solve_ms": (t2 - t1) * 1e3,
+        "snapshot_ms": (pending.t_snapshot - pending.t_start) * 1e3,
+        "solve_ms": (t2 - pending.t_snapshot) * 1e3,
         "extract_ms": (t3 - t2) * 1e3,
-        "warm": bool(warm_g),
+        "warm": pending.warm,
     }
-    # Warm-start carry for the NEXT refresh (~4 KB at 1k instances).
+    for name in ("sinkhorn_iters_run", "auction_iters_run"):
+        v = getattr(sol, name, None)
+        if v is not None:
+            plan.stats[name] = int(np.asarray(v))
+    # Warm-start carries for the NEXT refresh (~4 KB each at 1k instances).
     if sol.g is not None:
         g_host = np.asarray(jax.device_get(sol.g))[: len(cols.instance_ids)]
         plan.warm_g = dict(
             zip(cols.instance_ids, g_host.astype(float).tolist())
         )
+    if sol.prices is not None:
+        p_host = np.asarray(
+            jax.device_get(sol.prices)
+        )[: len(cols.instance_ids)]
+        plan.warm_price = dict(
+            zip(cols.instance_ids, p_host.astype(float).tolist())
+        )
     return plan
+
+
+def solve_plan(
+    models: Sequence[tuple[str, ModelRecord]],
+    instances: Sequence[tuple[str, InstanceRecord]],
+    rpm_fn: Optional[RpmSource] = None,
+    seed: int = 0,
+    constraints=None,
+    mesh=None,
+    warm_g: Optional[Mapping[str, float]] = None,
+    config=None,
+    warm_price: Optional[Mapping[str, float]] = None,
+    cols: Optional[ProblemColumns] = None,
+) -> GlobalPlan:
+    """One global solve -> GlobalPlan (blocking; runs on the JAX device).
+
+    Stage timings land in ``plan.stats`` (snapshot / device solve / plan
+    extraction, milliseconds) — the e2e refresh cost, not just the kernel
+    (round-2 VERDICT weak #2). Shapes are bucket-padded so consecutive
+    refreshes with drifting model counts reuse the compiled solver.
+
+    ``mesh``: a parallel.mesh device mesh shards the solve across chips
+    (parallel/sharded_solver.py) — the 1M x 10k ladder path. Bucket sizes
+    are powers of two or 3·2^k, so any power-of-two mesh axis ≤ the pad
+    floors (256 rows, 64 cols) divides them evenly.
+
+    ``config``: a SolveConfig overriding the solver defaults (None keeps
+    the compiled-default cache entry). The strategy builds one from the
+    MM_SOLVER_* env knobs (solve_config_from_env).
+
+    ``warm_g`` / ``warm_price``: per-instance-id column potentials and
+    congestion prices from the previous solve (``plan.warm_g`` /
+    ``plan.warm_price``) — warm-start Sinkhorn and the auction (SURVEY.md
+    section 7 hard part #4, incremental solves as state churns). Only
+    column state needs carrying, and keying by instance id makes the
+    carry robust to models/instances joining or leaving.
+
+    ``cols``: a pre-built snapshot (e.g. from ``patch_columns``); skips
+    the internal ``snapshot_columns`` call. This is the blocking
+    convenience wrapper around dispatch_solve + finalize_plan — the
+    pipelined steady-state driver calls those directly to overlap the
+    next snapshot with the in-flight solve.
+    """
+    if not models or not instances:
+        return GlobalPlan({}, now_ms(), 0.0)
+    t0 = time.perf_counter()
+    if cols is None:
+        cols = snapshot_columns(
+            models, instances, rpm_fn, constraints=constraints
+        )
+    t1 = time.perf_counter()
+    pending = dispatch_solve(
+        cols, seed=seed, mesh=mesh, warm_g=warm_g, warm_price=warm_price,
+        config=config, t_start=t0, t_snapshot=t1,
+    )
+    return finalize_plan(pending)
 
 
 _compact_jits: dict = {}
@@ -788,34 +1129,151 @@ class JaxPlacementStrategy(PlacementStrategy):
             solve_config = None if cfg == SolveConfig() else cfg
         self.solve_config = solve_config
         self._plan: Optional[GlobalPlan] = None
+        # Plan generation (always increments — readers order plans by it)
+        # is deliberately SEPARATE from the rounding-noise seed: the
+        # auction's carried prices and its Gumbel draw are a matched pair,
+        # so incremental refreshes freeze the noise epoch (see refresh())
+        # and the seed rotates only on full rebuilds.
+        self._generation = 0
         self._seed = 0
         self._refresh_lock = threading.Lock()
-        # Column-potential carry across refreshes (solve_plan warm_g).
+        # Column-potential / price carries across refreshes (solve_plan
+        # warm_g / warm_price).
         self._warm_g: Optional[dict[str, float]] = None
+        self._warm_price: Optional[dict[str, float]] = None
+        # Delta-snapshot state: the cached columns plus the dirty sets
+        # accumulated since the last refresh (mark_dirty, watch-fed).
+        # _dirty_lock is separate from _refresh_lock so event threads never
+        # block behind a multi-hundred-ms solve.
+        self._snap_cache: Optional[SnapshotCache] = None
+        self._dirty_lock = threading.Lock()
+        self._dirty_models: set = set()
+        self._dirty_instances: set = set()
+        # Consecutive delta refreshes since the last full rebuild. Under
+        # perpetual small churn the dirty fraction never trips the patch
+        # fallback, so without a cap the frozen noise epoch would freeze
+        # an unlucky Gumbel draw FOREVER — _build_cols forces a rebuild
+        # (and thus a seed rotation) every MAX_DELTA_STREAK deltas, which
+        # also bounds how long an unmarked-dirty record can serve stale
+        # columns.
+        self._delta_streak = 0
 
     @property
     def plan(self) -> Optional[GlobalPlan]:
         return self._plan
+
+    def mark_dirty(
+        self, models: Sequence[str] = (), instances: Sequence[str] = ()
+    ) -> None:
+        """Record churned records for the next ``refresh(incremental=True)``.
+
+        The tracking contract: every model/instance whose record changed
+        since the last refresh must be marked, or the delta snapshot serves
+        stale columns for it until the next full rebuild. Registry/instance
+        watch handlers are the natural callers."""
+        with self._dirty_lock:
+            self._dirty_models.update(models)
+            self._dirty_instances.update(instances)
+
+    def _take_dirty(self) -> tuple[set, set]:
+        with self._dirty_lock:
+            dm, di = self._dirty_models, self._dirty_instances
+            self._dirty_models, self._dirty_instances = set(), set()
+            return dm, di
+
+    def _build_cols(self, models, instances, rpm_fn, incremental: bool):
+        """Delta-patch the cached snapshot when allowed, else rebuild (and
+        re-prime the cache). Returns (cols, was_delta)."""
+        if (
+            incremental
+            and self._snap_cache is not None
+            and self._delta_streak < MAX_DELTA_STREAK
+        ):
+            dm, di = self._take_dirty()
+            cols = patch_columns(
+                self._snap_cache, models, instances, rpm_fn, dm, di,
+                constraints=self.constraints,
+            )
+            if cols is not None:
+                self._delta_streak += 1
+                return cols, True
+        else:
+            self._take_dirty()  # consumed by the rebuild below
+        cols, self._snap_cache = snapshot_columns(
+            models, instances, rpm_fn, constraints=self.constraints,
+            return_cache=True,
+        )
+        self._delta_streak = 0
+        return cols, False
+
+    def _epoch_carries(self, delta: bool):
+        """Noise-epoch discipline, shared by the blocking ``refresh`` and
+        ``PipelinedRefresher.submit`` so the matched-pair rules cannot
+        fork: a delta refresh KEEPS the Gumbel seed and may warm-start
+        prices; a full rebuild rotates the seed and DROPS the price
+        carry, which is only meaningful under the draw it was selected
+        with (rotating without dropping re-herds rows — ~40x worse probe
+        overflow measured at 20k x 256 — and kills the warm early exit).
+        Sinkhorn's g is draw-independent and always carries. Returns the
+        (warm_g, warm_price) id-keyed dicts to use. Callers hold
+        _refresh_lock."""
+        if not delta:
+            self._seed += 1
+            # INVALIDATE the stored prices, don't just skip them for this
+            # solve: they belong to the old draw, and if this rebuild's
+            # own price readback is skipped (donated pipelined flight) a
+            # later delta refresh would pair them with the rotated seed.
+            self._warm_price = None
+        return self._warm_g, self._warm_price
 
     def refresh(
         self,
         models: Sequence[tuple[str, ModelRecord]],
         instances: Sequence[tuple[str, InstanceRecord]],
         rpm_fn: Optional[RpmSource] = None,
+        incremental: bool = False,
     ) -> GlobalPlan:
         with self._refresh_lock:
-            self._seed += 1
-            plan = solve_plan(
-                models, instances, rpm_fn, seed=self._seed,
-                constraints=self.constraints, mesh=self.mesh,
-                warm_g=self._warm_g, config=self.solve_config,
-            )
+            self._generation += 1
+            delta = None
+            if models and instances:
+                t0 = time.perf_counter()
+                cols, delta = self._build_cols(
+                    models, instances, rpm_fn, incremental
+                )
+                # Noise-epoch discipline (_epoch_carries): a frozen draw
+                # keeps the warm prices valid AND the plan stable under
+                # small churn — fewer gratuitous model moves. An unlucky
+                # draw is never frozen forever: full rebuilds rotate it,
+                # and _build_cols forces one every MAX_DELTA_STREAK
+                # consecutive deltas even under perpetual small churn.
+                warm_g, warm_price = self._epoch_carries(delta)
+                plan = finalize_plan(dispatch_solve(
+                    cols, seed=self._seed, mesh=self.mesh,
+                    warm_g=warm_g, warm_price=warm_price,
+                    config=self.solve_config, t_start=t0,
+                ))
+            else:
+                # Empty view: no solve happens, so do NOT rotate the seed —
+                # _warm_price stays selected under the current draw, and a
+                # rotation here would mispair them for the next real delta
+                # refresh (plan ordering is _generation's job, not _seed's).
+                plan = solve_plan(
+                    models, instances, rpm_fn, seed=self._seed,
+                    constraints=self.constraints, mesh=self.mesh,
+                    warm_g=self._warm_g, config=self.solve_config,
+                    warm_price=self._warm_price,
+                )
             if plan.warm_g is not None:
                 # Keep the carry across empty-snapshot blips (registry
                 # rebuild / watch reconnect): a transiently empty refresh
                 # must not force the next real solve cold.
                 self._warm_g = plan.warm_g
-            plan.generation = self._seed
+            if plan.warm_price is not None:
+                self._warm_price = plan.warm_price
+            if delta is not None:
+                plan.stats["delta_snapshot"] = delta
+            plan.generation = self._generation
             self._plan = plan
             log.info(
                 "placement plan refreshed: %d models x %d instances in %.1f ms",

@@ -1282,6 +1282,13 @@ class ModelMeshInstance:
         if not self._unload_pool.submit(fn):
             threading.Thread(target=fn, daemon=True).start()
 
+    # How young a loading claim survives the stale-self prune: a fresh
+    # claim with no cache entry behind it is far more likely a concurrent
+    # load racing this prune (its CAS landed between our trigger read and
+    # the mutate below) than a crashed load — those are minutes stale by
+    # the time the serve loop trips over them.
+    _PRUNE_CLAIM_GRACE_MS = 2_000
+
     def _prune_stale_self(self, model_id: str) -> Optional["ModelRecord"]:
         """Drop OUR stale entry from a record's loaded set (cache disagrees
         with the registry about us). Returns the updated record, or None
@@ -1294,8 +1301,24 @@ class ModelMeshInstance:
         def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
             if cur is None:
                 return None
+            # Re-read the cache INSIDE the CAS callback: the prune was
+            # triggered from a pre-CAS cache read, and _load_local inserts
+            # the cache entry before CAS'ing its registry claim — so a
+            # load that started since the trigger is visible here. Without
+            # this, the freshly CAS'd claim would be transiently dropped
+            # and concurrent placements could double-load the model.
+            ce = self.cache.get(model_id)
+            if ce is not None and ce.state is not EntryState.REMOVED:
+                raise _NothingToPrune(cur)
             was_loaded = cur.instance_ids.pop(self.instance_id, None)
-            was_loading = cur.loading_instances.pop(self.instance_id, None)
+            claim_ts = cur.loading_instances.get(self.instance_id)
+            was_loading = None
+            if claim_ts is not None and (
+                now_ms() - claim_ts >= self._PRUNE_CLAIM_GRACE_MS
+            ):
+                was_loading = cur.loading_instances.pop(
+                    self.instance_id, None
+                )
             if was_loaded is None and was_loading is None:
                 # The trigger came from a lagging watch view; the REAL
                 # record is already clean. Abort instead of CAS-writing

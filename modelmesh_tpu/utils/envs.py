@@ -71,6 +71,10 @@ REGISTRY: dict[str, EnvVar] = {
         EnvVar("MM_BENCH_E2E", "int", "1",
                "also measure the end-to-end plan refresh (0 disables)",
                "bench.py"),
+        EnvVar("MM_BENCH_STEADY", "int", "0",
+               "also measure the steady-state refresh fast path: cold vs "
+               "warm e2e refresh under churn (pipelined + delta snapshots "
+               "+ convergence-gated early exit)", "bench.py"),
         EnvVar("MM_KV_READ_ONLY", "int", "0",
                "KV-migration read-only mode: block model add/remove, "
                "suppress reaper pruning", "serving/instance.py"),
@@ -110,6 +114,19 @@ REGISTRY: dict[str, EnvVar] = {
                "placement/jax_engine.py"),
         EnvVar("MM_SOLVER_FINAL_SELECT", "str", "",
                "auction epilogue selection: exact | approx | none",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_SINKHORN_TOL", "float", "",
+               "Sinkhorn early-exit tolerance on relative L1 row-marginal "
+               "error (0/unset = fixed iteration budget)",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_SINKHORN_CHUNK", "int", "",
+               "iterations per Sinkhorn convergence check when "
+               "MM_SOLVER_SINKHORN_TOL is set (default 4)",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_AUCTION_STALL_TOL", "float", "",
+               "auction early-exit stall tolerance: per-round price "
+               "movement (price units) and best-overflow improvement "
+               "(fraction of demand); 0/unset = fixed budget",
                "placement/jax_engine.py"),
     ]
 }

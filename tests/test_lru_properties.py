@@ -10,6 +10,9 @@ re-weighting, live capacity changes, and eviction-listener ordering.
 """
 
 import pytest
+
+pytest.importorskip("hypothesis", reason="hypothesis not installed")
+
 from hypothesis import settings, strategies as st
 from hypothesis.stateful import (
     RuleBasedStateMachine,

@@ -146,7 +146,8 @@ class TestEndToEndRefresh:
         pf = PlanFollower(kv, "scale", follower)
         try:
             plan = solve_plan(models, instances, rpm)
-            assert set(plan.stats) == {"snapshot_ms", "solve_ms", "extract_ms", "warm"}
+            assert {"snapshot_ms", "solve_ms", "extract_ms", "warm"} <= set(plan.stats)
+            assert plan.stats["sinkhorn_iters_run"] >= 1
             assert len(plan.placements) == 2_000
             publish_plan(kv, "scale", plan)
             deadline = time.monotonic() + 20

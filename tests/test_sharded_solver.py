@@ -72,7 +72,7 @@ class TestShardedSolver:
         def kern(prob):
             Cb = ss._cost_block(prob, CostWeights(), jnp.float32)
             cps = jnp.minimum(prob.copies, ops.MAX_COPIES)
-            f, g, _ = ss._sharded_sinkhorn(
+            f, g, _, _ = ss._sharded_sinkhorn(
                 ss._cost_block(prob, CostWeights(), jnp.bfloat16),
                 prob.sizes * cps,
                 jnp.maximum(prob.capacity - prob.reserved, 0.0),
@@ -82,7 +82,7 @@ class TestShardedSolver:
             return Cb, f, g
 
         C_sh, f_sh, g_sh = jax.jit(
-            jax.shard_map(
+            mesh_mod.shard_map(
                 kern,
                 mesh=mesh,
                 in_specs=(mesh_mod.problem_pspec(),),
@@ -97,6 +97,59 @@ class TestShardedSolver:
         np.testing.assert_array_equal(C_single, np.asarray(C_sh))
         np.testing.assert_allclose(np.asarray(sk.f), np.asarray(f_sh), atol=1e-5)
         np.testing.assert_allclose(np.asarray(sk.g), np.asarray(g_sh), atol=1e-5)
+
+    def test_gated_sinkhorn_parity_with_single_device(self, problem):
+        # The early-exit path (tol > 0) must ALSO stay in lockstep with
+        # ops.sinkhorn — including the single-iteration warm probe: a
+        # converged carry exits both solvers after exactly one iteration
+        # with matching potentials.
+        from jax.sharding import PartitionSpec as P
+
+        from modelmesh_tpu.ops.costs import CostWeights
+        from modelmesh_tpu.ops.sinkhorn import sinkhorn
+        from modelmesh_tpu.parallel import sharded_solver as ss
+
+        copies = jnp.minimum(problem.copies, ops.MAX_COPIES)
+        row_mass = problem.sizes * copies
+        free = jnp.maximum(problem.capacity - problem.reserved, 0.0)
+        cold = sinkhorn(ops.assemble_cost(problem), row_mass, free,
+                        eps=0.05, iters=10, tol=0.02, chunk=4)
+        warm = sinkhorn(ops.assemble_cost(problem), row_mass, free,
+                        eps=0.05, iters=10, tol=0.02, chunk=4, g0=cold.g)
+        assert int(warm.iters_run) == 1
+
+        mesh = mesh_mod.make_mesh((4, 2))
+        pp = shard_problem(problem, mesh)
+        g0_full = cold.g
+
+        def kern(prob, g0_blk):
+            cps = jnp.minimum(prob.copies, ops.MAX_COPIES)
+            f, g, _, n = ss._sharded_sinkhorn(
+                ss._cost_block(prob, CostWeights(), jnp.bfloat16),
+                prob.sizes * cps,
+                jnp.maximum(prob.capacity - prob.reserved, 0.0),
+                0.05, 10, g0=g0_blk, tol=0.02, chunk=4,
+            )
+            return f, g, n
+
+        f_sh, g_sh, n_sh = jax.jit(
+            mesh_mod.shard_map(
+                kern,
+                mesh=mesh,
+                in_specs=(mesh_mod.problem_pspec(), P(mesh_mod.INSTANCE_AXIS)),
+                out_specs=(
+                    P(mesh_mod.MODEL_AXIS),
+                    P(mesh_mod.INSTANCE_AXIS),
+                    P(),
+                ),
+                check_vma=False,
+            )
+        )(pp, g0_full)
+        assert int(np.asarray(n_sh).ravel()[0]) == 1
+        np.testing.assert_allclose(np.asarray(warm.f), np.asarray(f_sh),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(warm.g), np.asarray(g_sh),
+                                   atol=1e-5)
 
     def test_quality_parity_with_single_device(self, problem):
         # Integral plans differ (see above) but must be equally good:

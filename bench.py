@@ -18,6 +18,11 @@ MM_BENCH_MODELS, MM_BENCH_INSTANCES, MM_BENCH_REPS, MM_BENCH_FORCE_CPU=1.
 MM_BENCH_E2E=1 additionally measures one full cold refresh end to end
 (registry snapshot -> device solve -> KV publish -> follower adoption).
 
+MM_BENCH_SERVE=1 additionally runs the serving data-plane microbench
+(bench_serve.py): request-path routing latency (local hit / forward /
+cache miss) at simulated 1/100/1000-instance views, with the per-model
+route cache cold vs hot.
+
 MM_BENCH_STEADY=1 measures the steady-state refresh fast path: one cold
 refresh, then a churn loop (~1% of models touched per cycle) driven
 through the pipelined refresher — delta snapshots (dirty tracking),
@@ -381,6 +386,19 @@ def main() -> None:
             result["e2e_refresh"] = e2e
         except Exception as e:  # noqa: BLE001
             print(f"bench: e2e refresh measurement failed: {e}", file=sys.stderr)
+    # Serving data-plane microbench (MM_BENCH_SERVE=1): request-path
+    # routing cost at simulated 1/100/1000-instance views, route cache
+    # cold vs hot (bench_serve.py; CPU-only, no device involved). Failure
+    # must not lose the kernel line.
+    if envs.get_int("MM_BENCH_SERVE"):
+        try:
+            import bench_serve
+
+            result["serve"] = bench_serve.run()
+        except Exception as e:  # noqa: BLE001
+            print(
+                f"bench: serve measurement failed: {e}", file=sys.stderr
+            )
     # Steady-state refresh fast path: cold vs warm (pipelined + delta +
     # early exit) under churn. Failure must not lose the kernel line.
     if envs.get_int("MM_BENCH_STEADY"):

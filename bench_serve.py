@@ -1,0 +1,272 @@
+"""Serving request-path microbenchmark: routing cost at simulated fleet sizes.
+
+tools/serving_bench.py measures the full wire path (real gRPC on every
+hop) but can't isolate the ROUTING cost this repo's data-plane fast path
+targets, and can't simulate a 1000-instance view on one core. This bench
+does the inverse: one real ModelMeshInstance against an in-memory KV, an
+instantaneous in-process loader, and a stub peer transport — so what's
+measured is exactly the per-request Python work between "request arrives
+at invoke_model" and "payload/forward dispatched", at 1/100/1000-instance
+simulated cluster views.
+
+Scenarios per tier:
+  local_hit      — copy loaded locally; the cache-hit fast path.
+  forward_cold   — copy held only by a peer, route cache DISABLED: full
+                   choose_serve_target per request (epoch-cached view).
+  forward_cached — same requests with the route cache on: steady-state
+                   hits skip the view walk and candidate ranking.
+  cache_miss     — a never-loaded model per request: registry read, miss
+                   loop, placement decision, instantaneous local load.
+  select         — the serve-target decision alone, uncached vs cached
+                   (µs/op + speedup): the number the route cache exists
+                   to improve, isolated from invoke plumbing.
+
+Run directly (`python bench_serve.py`, prints one JSON document) or via
+`MM_BENCH_SERVE=1 python bench.py` (attached under the "serve" key).
+Env knobs (registered in utils/envs.py): MM_ROUTE_CACHE /
+MM_ROUTE_CACHE_TTL_MS affect the instance under test like production.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from modelmesh_tpu.cache.lru import now_ms
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.records import InstanceRecord
+from modelmesh_tpu.runtime.spi import (
+    LoadedModel,
+    LocalInstanceParams,
+    ModelInfo,
+    ModelLoader,
+)
+from modelmesh_tpu.serving.instance import (
+    InstanceConfig,
+    InvokeResult,
+    ModelMeshInstance,
+    RoutingContext,
+)
+
+INFO = ModelInfo(model_type="bench", model_path="mem://bench")
+
+
+class _BenchLoader(ModelLoader):
+    """Instantaneous loads: the bench measures routing, not the runtime."""
+
+    def startup(self) -> LocalInstanceParams:
+        return LocalInstanceParams(capacity_bytes=1 << 30, load_timeout_ms=10_000)
+
+    def load(self, model_id: str, info: ModelInfo) -> LoadedModel:
+        return LoadedModel(handle=None, size_bytes=8 * 1024)
+
+    def unload(self, model_id: str) -> None:
+        pass
+
+    @property
+    def requires_unload(self) -> bool:
+        return False
+
+
+def _make_instance(n_instances: int):
+    """One real instance + (n_instances - 1) synthetic peer records fed
+    through the normal instances table/watch, with a stub peer transport
+    that acks forwards instantly."""
+    kv = InMemoryKV(sweep_interval_s=3600.0)
+    forwards: list[str] = []
+
+    def peer_call(endpoint, model_id, method, payload, headers, ctx):
+        forwards.append(ctx.dest_instance)
+        return InvokeResult(b"ok", ctx.dest_instance, "LOADED")
+
+    inst = ModelMeshInstance(
+        kv,
+        _BenchLoader(),
+        InstanceConfig(instance_id="i-bench", load_timeout_s=10,
+                       min_churn_age_ms=0),
+        peer_call=peer_call,
+        runtime_call=lambda ce, method, payload, headers, cancel_event=None: payload,
+    )
+    old = now_ms() - 3_600_000
+    for k in range(n_instances - 1):
+        inst.instances.put(f"p-{k:04d}", InstanceRecord(
+            start_ts=old, lru_ts=old, model_count=10,
+            capacity_units=1 << 20, used_units=1000 + (k * 37) % 5000,
+            req_per_minute=(k * 131) % 600, endpoint=f"ep-{k:04d}",
+        ))
+    inst.instances_view.wait_for(lambda v: len(v) >= n_instances, timeout=30)
+    return kv, inst, forwards
+
+
+def _percentiles(samples_ms: list[float], wall_s: float) -> dict:
+    xs = sorted(samples_ms)
+    n = len(xs)
+    return {
+        "reps": n,
+        "rps": round(n / wall_s, 1) if wall_s > 0 else None,
+        "p50_us": round(xs[n // 2] * 1e3, 1),
+        "p99_us": round(xs[min(n - 1, (n * 99) // 100)] * 1e3, 1),
+    }
+
+
+def _drive(fn, reps: int) -> dict:
+    fn()  # warm (first-route caches, lazy imports)
+    samples = []
+    t_wall = time.perf_counter()
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return _percentiles(samples, time.perf_counter() - t_wall)
+
+
+def _time_per_op_us(fn, iters: int) -> float:
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) * 1e6 / iters
+
+
+def _bench_tier(n_instances: int, reps: int, select_iters: int) -> dict:
+    kv, inst, forwards = _make_instance(n_instances)
+    try:
+        payload = b"x" * 1024
+
+        # local_hit: force the copy onto THIS instance regardless of how
+        # attractive the synthetic peers look to placement.
+        inst.register_model("m-local", INFO)
+        inst.invoke_model(
+            "m-local", None, b"", [],
+            RoutingContext(hop=RoutingContext.LOAD_LOCAL_ONLY), sync=True,
+        )
+        local = _drive(
+            lambda: inst.invoke_model("m-local", "predict", payload, []),
+            reps,
+        )
+
+        out = {"instances": n_instances, "local_hit": local}
+
+        if n_instances > 1:
+            # forward: copies exist only on peers (loaded an hour ago,
+            # stably past the warming window) — several copies so the
+            # serve selection has real ranking work, like a hot model.
+            n_copies = min(8, n_instances - 1)
+            inst.register_model("m-fwd", INFO)
+
+            def place(cur):
+                for c in range(n_copies):
+                    cur.promote_loaded(f"p-{c:04d}", now_ms() - 3_600_000)
+                return cur
+
+            inst.registry.update_or_create("m-fwd", place)
+            inst.registry_view.wait_for(
+                lambda v: (mr := v.get("m-fwd")) is not None
+                and len(mr.instance_ids) >= n_copies,
+                timeout=10,
+            )
+
+            def fwd():
+                return inst.invoke_model("m-fwd", "predict", payload, [])
+
+            inst.route_cache.enabled = False
+            out["forward_cold"] = _drive(fwd, reps)
+            inst.route_cache.enabled = True
+            inst.route_cache.clear()
+            hit0 = inst.route_cache.hits
+            out["forward_cached"] = _drive(fwd, reps)
+            out["route_cache_hits"] = inst.route_cache.hits - hit0
+
+        # cache_miss: a fresh never-loaded model per request (registered
+        # up front so the measured work is routing + placement, not
+        # registration). The local instance is in the placement shortlist
+        # (empty LRU), so the load lands here through the instantaneous
+        # loader.
+        miss_reps = min(reps, 500)
+        for i in range(miss_reps + 1):
+            inst.register_model(f"m-miss-{i:05d}", INFO)
+        inst.registry_view.wait_for(
+            lambda v: v.get(f"m-miss-{miss_reps:05d}") is not None, timeout=10
+        )
+        seq = iter(range(miss_reps + 1))
+        out["cache_miss"] = _drive(
+            lambda: inst.invoke_model(
+                f"m-miss-{next(seq):05d}", "predict", payload, []
+            ),
+            miss_reps,
+        )
+
+        # select: the serve-target decision alone. Uncached = the full
+        # strategy ranking against the (epoch-cached) view; cached = the
+        # route-memo path the hot loop takes. Needs a non-excluded copy
+        # holder, so only meaningful with peers.
+        if n_instances > 1:
+            from modelmesh_tpu.placement.strategy import ClusterView
+
+            mr = inst.registry_view.get("m-fwd")
+            ctx = RoutingContext()
+            inst.route_cache.enabled = True
+
+            # legacy: what every request paid before this fast path — a
+            # fresh O(cluster) table copy into a throwaway view whose
+            # live set/map is derived per request.
+            def legacy_select():
+                view = ClusterView(instances=inst.instances_view.items())
+                return inst.strategy.choose_serve_target(
+                    mr, view, frozenset((inst.instance_id,))
+                )
+
+            legacy_us = _time_per_op_us(legacy_select, max(select_iters // 10, 100))
+            uncached_us = _time_per_op_us(
+                lambda: inst.strategy.choose_serve_target(
+                    mr, inst.cluster_view(), frozenset((inst.instance_id,))
+                ),
+                select_iters,
+            )
+            cached_us = _time_per_op_us(
+                lambda: inst._choose_serve_target("m-sel", mr, ctx),
+                select_iters,
+            )
+            out["select_legacy_copy_us"] = round(legacy_us, 2)
+            out["select_uncached_us"] = round(uncached_us, 2)
+            out["select_cached_us"] = round(cached_us, 2)
+            out["select_speedup"] = (
+                round(uncached_us / cached_us, 2) if cached_us > 0 else None
+            )
+            out["select_speedup_vs_legacy"] = (
+                round(legacy_us / cached_us, 2) if cached_us > 0 else None
+            )
+        out["forwards_observed"] = len(forwards)
+        return out
+    finally:
+        inst.shutdown()
+        kv.close()
+
+
+def run(tiers=(1, 100, 1000), reps: int = 2000, select_iters: int = 20_000) -> dict:
+    from modelmesh_tpu.serving.route_cache import RouteCache
+
+    probe = RouteCache()
+    return {
+        "route_cache_enabled": probe.enabled,
+        "route_cache_ttl_ms": probe.ttl_ms,
+        "payload_bytes": 1024,
+        "tiers": [_bench_tier(n, reps, select_iters) for n in tiers],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiers", type=str, default="1,100,1000")
+    ap.add_argument("--reps", type=int, default=2000)
+    ap.add_argument("--select-iters", type=int, default=20_000)
+    args = ap.parse_args()
+    tiers = [int(t) for t in args.tiers.split(",") if t.strip()]
+    print(json.dumps(run(tiers, args.reps, args.select_iters)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -224,6 +224,11 @@ class TableView(Generic[R]):
         self._lock = threading.RLock()
         self._listeners: list[TableListener] = []
         self._ready = threading.Event()
+        # Monotone view version: bumped on every APPLIED change (stale
+        # watch replays don't count). Readers key derived snapshots on it
+        # (ModelMeshInstance caches its ClusterView per epoch) so the
+        # request hot path copies the table only when it actually moved.
+        self._epoch = 0
         # Subscribe from revision 0 so pre-existing records replay as events.
         self._watch = table.store.watch(
             table.prefix, self._on_events, start_rev=0
@@ -233,6 +238,7 @@ class TableView(Generic[R]):
         with self._lock:
             for id_, rec in table.items():
                 self._cache[id_] = rec
+            self._epoch += 1
         self._ready.set()
 
     def add_listener(self, listener: TableListener) -> None:
@@ -258,6 +264,8 @@ class TableView(Generic[R]):
                         event = (
                             TableEvent.ADDED if prev is None else TableEvent.UPDATED
                         )
+                if event is not None:
+                    self._epoch += 1
             if event is not None:
                 for listener in self._listeners:
                     listener(event, id_, rec)
@@ -271,6 +279,20 @@ class TableView(Generic[R]):
     def items(self) -> list[tuple[str, R]]:
         with self._lock:
             return list(self._cache.items())
+
+    @property
+    def epoch(self) -> int:
+        """Current view version (see __init__). Lock-free read: a torn
+        read is impossible for a GIL-atomic int, and callers only compare
+        for equality against a snapshot's recorded epoch."""
+        return self._epoch
+
+    def snapshot(self) -> tuple[int, list[tuple[str, R]]]:
+        """(epoch, items) captured atomically — the pair a caller needs to
+        build an epoch-keyed derived view without a lost-update window
+        between reading the version and copying the table."""
+        with self._lock:
+            return self._epoch, list(self._cache.items())
 
     def __len__(self) -> int:
         return len(self._cache)

@@ -116,11 +116,35 @@ class _Histogram:
         self.counts[-1] += 1
 
 
+class _MetricStripe:
+    """One lock's worth of counter/histogram state (see PrometheusMetrics)."""
+
+    __slots__ = ("lock", "counters", "hists")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counters: dict[tuple[str, str], float] = {}
+        self.hists: dict[tuple[str, str], _Histogram] = {}
+
+
+# Stripes for the request-path recording locks. 8 comfortably separates
+# the handful of distinct metrics one request touches; power of two so
+# the index is a mask.
+_N_STRIPES = 8
+
+
 class PrometheusMetrics(Metrics):
     """In-memory registry + /metrics HTTP endpoint (text format 0.0.4).
 
     ``per_model`` adds a model_id label to counters/histograms that carry
     one (cardinality opt-in, like the reference's per-model metrics flag).
+
+    Recording is striped: each (metric, label) key hashes to one of
+    ``_N_STRIPES`` independently-locked shards, so the 4+ metric updates
+    a request handler makes don't all serialize on a single process-wide
+    lock under concurrent handlers. A key lives in exactly one stripe, so
+    the scrape-time merge in render() is collision-free and the rendered
+    text is identical to the single-lock version.
     """
 
     def __init__(
@@ -130,10 +154,9 @@ class PrometheusMetrics(Metrics):
         instance_id: str = "",
         start_server: bool = True,
     ):
-        self._lock = threading.Lock()
-        self._counters: dict[tuple[str, str], float] = {}
+        self._lock = threading.Lock()  # gauges + server lifecycle (rare)
+        self._stripes = [_MetricStripe() for _ in range(_N_STRIPES)]
         self._gauges: dict[str, float] = {}
-        self._hists: dict[tuple[str, str], _Histogram] = {}
         self.per_model = per_model
         self.instance_id = instance_id
         self.port = 0
@@ -148,15 +171,17 @@ class PrometheusMetrics(Metrics):
 
     def inc(self, metric: Metric, value: float = 1.0, model_id: str = "") -> None:
         key = (metric.metric_name, self._label(model_id))
-        with self._lock:
-            self._counters[key] = self._counters.get(key, 0.0) + value
+        stripe = self._stripes[hash(key) & (_N_STRIPES - 1)]
+        with stripe.lock:
+            stripe.counters[key] = stripe.counters.get(key, 0.0) + value
 
     def observe(self, metric: Metric, value_ms: float, model_id: str = "") -> None:
         key = (metric.metric_name, self._label(model_id))
-        with self._lock:
-            hist = self._hists.get(key)
+        stripe = self._stripes[hash(key) & (_N_STRIPES - 1)]
+        with stripe.lock:
+            hist = stripe.hists.get(key)
             if hist is None:
-                hist = self._hists[key] = _Histogram(DEFAULT_BUCKETS_MS)
+                hist = stripe.hists[key] = _Histogram(DEFAULT_BUCKETS_MS)
             hist.observe(value_ms)
 
     def set_gauge(self, metric: Metric, value: float) -> None:
@@ -246,29 +271,44 @@ class PrometheusMetrics(Metrics):
                 lines.append(f"# HELP {name} {m.help}")
                 lines.append(f"# TYPE {name} {kind}")
 
+        # Merge the stripes under their own locks (a key lives in exactly
+        # one stripe, so updates cannot collide); histograms are copied to
+        # a consistent (counts, total, count) snapshot so a concurrent
+        # observe can't tear a row mid-render. The merged output sorts
+        # identically to the old single-dict registry.
+        counters: dict[tuple[str, str], float] = {}
+        hists: dict[tuple[str, str], tuple] = {}
+        for stripe in self._stripes:
+            with stripe.lock:
+                counters.update(stripe.counters)
+                for key, h in stripe.hists.items():
+                    hists[key] = (h.buckets, list(h.counts), h.total, h.count)
         with self._lock:
-            for (name, model), v in sorted(self._counters.items()):
-                meta(name, "counter")
-                extra = f'model_id="{model}"' if model else ""
-                lines.append(f"{name}{labels(extra)} {v}")
-            for name, v in sorted(self._gauges.items()):
-                meta(name, "gauge")
-                lines.append(f"{name}{labels()} {v}")
-            for (name, model), h in sorted(self._hists.items()):
-                meta(name, "histogram")
-                extra = f'model_id="{model}"' if model else ""
-                cum = 0
-                for b, c in zip(h.buckets, h.counts):
-                    cum += c
-                    le = f'le="{b}"'
-                    lab = labels(", ".join(x for x in (extra, le) if x) if extra else le)
-                    lines.append(f"{name}_bucket{lab} {cum}")
-                cum += h.counts[-1]
-                le = 'le="+Inf"'
+            gauges = dict(self._gauges)
+        for (name, model), v in sorted(counters.items()):
+            meta(name, "counter")
+            extra = f'model_id="{model}"' if model else ""
+            lines.append(f"{name}{labels(extra)} {v}")
+        for name, v in sorted(gauges.items()):
+            meta(name, "gauge")
+            lines.append(f"{name}{labels()} {v}")
+        for (name, model), (buckets, counts, total, count) in sorted(
+            hists.items()
+        ):
+            meta(name, "histogram")
+            extra = f'model_id="{model}"' if model else ""
+            cum = 0
+            for b, c in zip(buckets, counts):
+                cum += c
+                le = f'le="{b}"'
                 lab = labels(", ".join(x for x in (extra, le) if x) if extra else le)
                 lines.append(f"{name}_bucket{lab} {cum}")
-                lines.append(f"{name}_sum{labels(extra)} {h.total}")
-                lines.append(f"{name}_count{labels(extra)} {h.count}")
+            cum += counts[-1]
+            le = 'le="+Inf"'
+            lab = labels(", ".join(x for x in (extra, le) if x) if extra else le)
+            lines.append(f"{name}_bucket{lab} {cum}")
+            lines.append(f"{name}_sum{labels(extra)} {total}")
+            lines.append(f"{name}_count{labels(extra)} {count}")
         return "\n".join(lines) + "\n"
 
     def _start_http(self, port: int) -> None:

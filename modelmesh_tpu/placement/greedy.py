@@ -87,27 +87,38 @@ class GreedyStrategy(PlacementStrategy):
                 shortlist = pref
         if any(iid == req.requesting_instance for iid, _ in shortlist):
             return LOAD_HERE
-        # Least busy; stable tie-break on free space then id.
-        shortlist.sort(key=lambda p: (p[1].req_per_minute, -p[1].free_units, p[0]))
-        return shortlist[0][0]
+        # Least busy; stable tie-break on free space then id. min() over a
+        # key is the single-pass form of sort()[0] (same winner: min is
+        # leftmost among key-ties, exactly what a stable sort put first).
+        return min(
+            shortlist,
+            key=lambda p: (p[1].req_per_minute, -p[1].free_units, p[0]),
+        )[0]
 
     def choose_serve_target(
         self, model: ModelRecord, view: ClusterView, exclude: frozenset[str]
     ) -> Optional[str]:
-        live = {iid: rec for iid, rec in view.live()}
+        # Shared per-snapshot id->record map (ClusterView caches it across
+        # requests); single-pass running-minimum selection — the per-request
+        # cost is O(copies), with no dict build and no candidate sort.
+        live = view.live_map
         now = now_ms()
         expect = self._expect_ms(model.model_type)
-        candidates: list[tuple[tuple, str]] = []
+        best_key: Optional[tuple] = None
+        best: Optional[str] = None
         for iid, load_ts in model.instance_ids.items():
-            if iid in exclude or iid not in live:
+            if iid in exclude:
+                continue
+            rec = live.get(iid)
+            if rec is None:
                 continue
             # Per-type warming penalty: a slow-loading type stays
             # deprioritized longer after activation than a fast one.
-            warming = now - load_ts < expect
-            candidates.append(((warming, live[iid].req_per_minute, iid), iid))
-        if candidates:
-            candidates.sort()
-            return candidates[0][1]
+            key = (now - load_ts < expect, rec.req_per_minute, iid)
+            if best_key is None or key < best_key:
+                best_key, best = key, iid
+        if best is not None:
+            return best
         # No READY copy: wait-vs-go-elsewhere on LOADING copies (reference
         # ModelMesh.java:4351). A copy loading for less than the type's
         # mean+3σ is healthy — forward to it and ride its load (a second
@@ -123,14 +134,15 @@ class GreedyStrategy(PlacementStrategy):
             and self.time_stats.samples(model.model_type)
             < self.time_stats.min_samples
         )
-        loading = [
-            (elapsed, iid)
-            for iid, claim_ts in model.loading_instances.items()
-            if iid not in exclude and iid in live
-            and ((elapsed := now - claim_ts) <= expect or no_evidence)
-        ]
-        if loading:
-            # Longest-elapsed healthy copy: closest to completion, so the
-            # forwarded request waits the least.
-            return max(loading)[1]
-        return None
+        # Longest-elapsed healthy copy: closest to completion, so the
+        # forwarded request waits the least. Running max, no list build.
+        best_load: Optional[tuple[int, str]] = None
+        for iid, claim_ts in model.loading_instances.items():
+            if iid in exclude or iid not in live:
+                continue
+            elapsed = now - claim_ts
+            if elapsed <= expect or no_evidence:
+                cand = (elapsed, iid)
+                if best_load is None or cand > best_load:
+                    best_load = cand
+        return best_load[1] if best_load is not None else None

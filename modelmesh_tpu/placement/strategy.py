@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import functools
 from typing import Optional, Sequence
 
 from modelmesh_tpu.records import InstanceRecord, ModelRecord
@@ -34,18 +35,39 @@ class PlacementRequest:
 
 @dataclasses.dataclass(frozen=True)
 class ClusterView:
-    """Snapshot of live instances (from the instances TableView)."""
+    """Immutable snapshot of live instances (from the instances TableView).
+
+    ``epoch`` is the TableView version the snapshot was taken at (-1 for
+    ad-hoc views built outside the watch-fed path). Views are shared
+    across requests until the epoch moves, so the derived collections are
+    computed once per snapshot, not per request (cached_property writes
+    straight into __dict__, which the frozen dataclass permits)."""
 
     instances: Sequence[tuple[str, InstanceRecord]]
+    epoch: int = -1
+
+    @functools.cached_property
+    def _live(self) -> list[tuple[str, InstanceRecord]]:
+        return [(i, r) for i, r in self.instances if not r.shutting_down]
+
+    @functools.cached_property
+    def live_map(self) -> dict[str, InstanceRecord]:
+        """id -> record of live instances; the O(1) lookup the per-request
+        serve-target selection reads instead of rebuilding a dict."""
+        return dict(self._live)
+
+    @functools.cached_property
+    def _placeable(self) -> list[tuple[str, InstanceRecord]]:
+        return [(i, r) for i, r in self._live if not r.disabled]
 
     def live(self) -> list[tuple[str, InstanceRecord]]:
-        return [(i, r) for i, r in self.instances if not r.shutting_down]
+        return self._live
 
     def placeable(self) -> list[tuple[str, InstanceRecord]]:
         """Candidates for NEW placements: live and not admin-drained.
         Serve routing keeps using live() — a disabled instance's
         already-loaded copies continue serving (drain, not eviction)."""
-        return [(i, r) for i, r in self.live() if not r.disabled]
+        return self._placeable
 
 
 class PlacementStrategy(abc.ABC):

@@ -28,6 +28,10 @@ import grpc
 from modelmesh_tpu.utils.grpcopts import message_size_options
 from modelmesh_tpu.observability.metrics import Metric as MX
 from modelmesh_tpu.observability.payloads import Payload
+from modelmesh_tpu.observability.tracing import (
+    TRACE_HEADER,
+    incoming_trace_id,
+)
 
 from modelmesh_tpu.proto import mesh_api_pb2 as apb
 from modelmesh_tpu.proto import mesh_internal_pb2 as ipb
@@ -66,11 +70,14 @@ _STATUS_MAP = {
 
 
 def _ctx_to_proto(ctx: RoutingContext) -> ipb.RoutingContext:
+    # Sets are serialized in iteration order: the receiver rebuilds sets
+    # (order-insensitive), and sorting three sets per forward hop was pure
+    # hot-path overhead.
     return ipb.RoutingContext(
         hop=ctx.hop,
-        exclude_serve=sorted(ctx.exclude_serve),
-        exclude_load=sorted(ctx.exclude_load),
-        visited=sorted(ctx.visited),
+        exclude_serve=list(ctx.exclude_serve),
+        exclude_load=list(ctx.exclude_load),
+        visited=list(ctx.visited),
         dest_instance=ctx.dest_instance,
         chain_load_count=ctx.chain_load_count,
         known_size_bytes=ctx.known_size_bytes,
@@ -226,8 +233,6 @@ class MeshInternalServicer:
         ctx.cancel_event = threading.Event()
         context.add_callback(ctx.cancel_event.set)
         headers = list(request.headers.items())
-        from modelmesh_tpu.observability.tracing import incoming_trace_id
-
         incoming_tid = incoming_trace_id(headers)
         try:
             with self.instance.tracer.trace(
@@ -352,16 +357,35 @@ class InferenceFallback:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"missing {grpc_defs.MODEL_ID_HEADER} metadata",
             )
+        # Single pass over the metadata: strip transport/id entries into
+        # the forwardable header list and capture the trace id on the way
+        # (previously: a filtering comprehension here plus a second
+        # identical one in the multi-model path plus separate md lookups).
+        headers = []
+        trace_id = ""
+        for k, v in md.items():
+            if k.startswith("grpc-") or not isinstance(v, str):
+                continue
+            if k == grpc_defs.MODEL_ID_HEADER or k == grpc_defs.VMODEL_ID_HEADER:
+                continue
+            if k == TRACE_HEADER:
+                trace_id = v
+            headers.append((k, v))
         if "," in model_id:
-            return self._multi_model(method, request, context, model_id, md)
-        headers = [
-            (k, v) for k, v in md.items()
-            if not k.startswith("grpc-") and isinstance(v, str)
-            and k not in (grpc_defs.MODEL_ID_HEADER, grpc_defs.VMODEL_ID_HEADER)
-        ]
-        req_id = f"{self.instance.instance_id}-{next(self._req_seq)}"
+            return self._multi_model(
+                method, request, context, model_id, headers, trace_id
+            )
+        # Payload observation (and the req-id it needs) only exists when a
+        # processor is configured — the common unconfigured case skips the
+        # id formatting and the observer calls entirely.
+        proc = self.payload_processor
+        req_id = ""
+        if proc is not None:
+            req_id = f"{self.instance.instance_id}-{next(self._req_seq)}"
+            self._observe_payload(
+                req_id, model_id, method, "request", request, "OK"
+            )
         metrics.inc(MX.API_REQUEST_COUNT, model_id=model_id)
-        self._observe_payload(req_id, model_id, method, "request", request, "OK")
         # Client-disconnect propagation (ModelMeshApi.java:709-729): gRPC
         # fires rpc-termination callbacks on cancel; the event interrupts
         # slot waits, runtime calls, and peer forwards downstream. (It also
@@ -370,11 +394,9 @@ class InferenceFallback:
         context.add_callback(cancel_event.set)
         t0 = _time.perf_counter()
         metrics.observe(MX.REQUEST_BYTES, len(request), model_id)
-        from modelmesh_tpu.observability.tracing import TRACE_HEADER
-
         try:
             with self.log_headers.bind(md.items()), self.instance.tracer.trace(
-                md.get(TRACE_HEADER, ""), model_id, method
+                trace_id, model_id, method
             ):
                 result = self.instance.invoke_model(
                     model_id, method, request, headers,
@@ -385,9 +407,10 @@ class InferenceFallback:
                 MX.API_REQUEST_TIME, (_time.perf_counter() - t0) * 1e3,
                 model_id=model_id,
             )
-            self._observe_payload(
-                req_id, model_id, method, "response", result.payload, "OK"
-            )
+            if proc is not None:
+                self._observe_payload(
+                    req_id, model_id, method, "response", result.payload, "OK"
+                )
             # Serving-identity trailers: which worker the connection
             # entered (front-door balancing debug) and which instance
             # actually served — operators and tests read these to see
@@ -426,21 +449,20 @@ class InferenceFallback:
             metrics.inc(MX.API_REQUEST_FAILED, model_id=model_id)
             context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
 
-    def _multi_model(self, method, request, context, model_ids, md) -> bytes:
+    def _multi_model(
+        self, method, request, context, model_ids, headers, trace_id
+    ) -> bytes:
         """Fan the same request out to several models in parallel; responses
         are concatenated as length-prefixed frames (4-byte big-endian per
         response, in the order the ids were given). First failure aborts the
-        whole call, mirroring the reference's all-or-nothing semantics."""
+        whole call, mirroring the reference's all-or-nothing semantics.
+
+        ``headers`` already has the routing ids stripped (the caller's
+        single metadata pass): each per-model call gets its own id header
+        from the runtime client; the original comma-list must not leak
+        through (duplicate metadata keys would shadow it)."""
         metrics = self.instance.metrics
         ids = [m.strip() for m in model_ids.split(",") if m.strip()]
-        # Strip the routing ids: each per-model call gets its own id header
-        # from the runtime client; the original comma-list must not leak
-        # through (duplicate metadata keys would shadow it).
-        headers = [
-            (k, v) for k, v in md.items()
-            if not k.startswith("grpc-") and isinstance(v, str)
-            and k not in (grpc_defs.MODEL_ID_HEADER, grpc_defs.VMODEL_ID_HEADER)
-        ]
         req_id = f"{self.instance.instance_id}-{next(self._req_seq)}"
         metrics.inc(MX.API_REQUEST_COUNT, model_id=model_ids)
         metrics.inc(MX.MULTI_MODEL_COUNT, model_id=model_ids)
@@ -448,11 +470,9 @@ class InferenceFallback:
         cancel_event = threading.Event()
         context.add_callback(cancel_event.set)
         t0 = _time.perf_counter()
-        from modelmesh_tpu.observability.tracing import TRACE_HEADER
-
         import uuid as _uuid
 
-        trace_id = md.get(TRACE_HEADER, "") or _uuid.uuid4().hex[:16]
+        trace_id = trace_id or _uuid.uuid4().hex[:16]
 
         def run_member(mid):
             # Pool threads don't inherit the handler's trace contextvar:

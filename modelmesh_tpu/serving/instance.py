@@ -68,7 +68,9 @@ from modelmesh_tpu.serving.errors import (
     ServiceUnavailableError,
 )
 from modelmesh_tpu.observability.metrics import Metric as MX
+from modelmesh_tpu.observability.tracing import outgoing_headers
 from modelmesh_tpu.serving.rate import RateTracker
+from modelmesh_tpu.serving.route_cache import RouteCache
 from modelmesh_tpu.utils.pool import BoundedDaemonPool
 
 log = logging.getLogger(__name__)
@@ -287,6 +289,13 @@ class ModelMeshInstance:
         self._model_rates_lock = threading.Lock()
         # model_id -> failfast-until timestamp (KV-outage sentinels).
         self._kv_failfast: dict[str, int] = {}
+        # Request-path fast path: the epoch-keyed ClusterView snapshot
+        # (rebuilt only when the instances view moves) and the per-model
+        # serve-route memo (serving/route_cache.py). Created before the
+        # registry listener below is registered — it invalidates through
+        # this cache.
+        self.route_cache = RouteCache()
+        self._cluster_view_cache: Optional[ClusterView] = None
 
         prefix = self.config.kv_prefix
         # Bucketed (128): scans page bucket-by-bucket so no range RPC
@@ -300,10 +309,14 @@ class ModelMeshInstance:
         )
         self.instances_view: TableView[InstanceRecord] = TableView(self.instances)
 
+        # Cached self-advertisement, reused as the cluster-view fallback
+        # until our published record round-trips through the watch —
+        # refreshed only on publish, not rebuilt per request.
+        self._self_record = self._build_instance_record()
         self._session = SessionNode(
             store,
             f"{prefix}/instances/{self.instance_id}",
-            self._build_instance_record().to_bytes(),
+            self._self_record.to_bytes(),
             ttl_s=10.0,
         )
         self._session.start()
@@ -338,16 +351,37 @@ class ModelMeshInstance:
     # ------------------------------------------------------------------ #
 
     def cluster_view(self) -> ClusterView:
-        items = self.instances_view.items()
+        """Epoch-cached immutable snapshot: the instances table is copied
+        only when the watch-fed view actually moved, not per request —
+        steady-state routing shares one ClusterView object (and its
+        cached live/placeable/live_map derivations) across requests."""
+        view = self._cluster_view_cache
+        if view is not None and view.epoch == self.instances_view.epoch:
+            return view
+        epoch, items = self.instances_view.snapshot()
+        self_rec = None
         if not any(iid == self.instance_id for iid, _ in items):
             # A node always knows itself: right after startup our own
             # published record may not have round-tripped through the async
             # KV watch yet, and an empty view would make placement reject
             # the first request (NoCapacityError) instead of loading here.
-            items = list(items) + [
-                (self.instance_id, self._build_instance_record())
-            ]
-        return ClusterView(instances=items)
+            # The fallback record is the cached self-advertisement
+            # (refreshed on publish), not a per-request rebuild.
+            self_rec = self._self_record
+            items.append((self.instance_id, self_rec))
+        view = ClusterView(instances=tuple(items), epoch=epoch)
+        # Benign race: concurrent rebuilds both install a view at-least-as
+        # fresh as the epoch they recorded; last writer wins.
+        self._cluster_view_cache = view
+        if self_rec is not None and self_rec is not self._self_record:
+            # A publish slipped between reading the fallback and installing
+            # the view; its cache invalidation may have fired BEFORE our
+            # install and been overwritten (the epoch alone can't catch
+            # this — our own unreflected publishes don't move it). Drop
+            # the just-installed view; every interleaving converges: a
+            # publish after this re-check invalidates after our install.
+            self._cluster_view_cache = None
+        return view
 
     # KV outage fail-fast: after a registry read error, requests for THAT
     # model fail immediately (UNAVAILABLE) for a cooldown window instead of
@@ -430,6 +464,17 @@ class ModelMeshInstance:
         with self._publish_lock:
             rec = self._build_instance_record()
             prev = self._last_published
+            if prev is not None:
+                rec.start_ts = prev.start_ts
+            # Refresh the cluster-view self-fallback on every publish
+            # attempt (suppressed or not): the fallback should carry the
+            # freshest self-observation without per-request rebuilds. The
+            # cached view must be dropped too — while the fallback is in
+            # use (our record not yet in the watch-fed table) our own
+            # publishes don't move the table epoch, so the epoch check
+            # alone would pin the startup-era self record indefinitely.
+            self._self_record = rec
+            self._cluster_view_cache = None
             if not force and prev is not None:
                 same = (
                     prev.model_count == rec.model_count
@@ -440,7 +485,6 @@ class ModelMeshInstance:
                 )
                 if same:
                     return
-            rec.start_ts = prev.start_ts if prev else rec.start_ts
             self._session.update(rec.to_bytes())
             self._last_published = rec
         self.metrics.set_gauge(MX.MODELS_LOADED, len(self.cache))
@@ -682,12 +726,7 @@ class ModelMeshInstance:
                 )
 
             # 2. cache-hit loop: forward to a loaded copy
-            exclude = (
-                ctx.exclude_serve | ctx.visited | {self.instance_id}
-            )
-            target = self.strategy.choose_serve_target(
-                mr, self.cluster_view(), frozenset(exclude)
-            )
+            target = self._choose_serve_target(model_id, mr, ctx)
             if target is not None:
                 try:
                     return self._forward(
@@ -695,12 +734,17 @@ class ModelMeshInstance:
                         hop=RoutingContext.INTERNAL,
                     )
                 except (ModelNotHereError, ServiceUnavailableError) as e:
+                    # The memoized route just failed in practice — drop it
+                    # so concurrent/subsequent requests re-decide instead
+                    # of replaying the failure until a version/epoch bump.
+                    self.route_cache.invalidate(model_id)
                     ctx.exclude_serve.add(target)
                     last_exc = e
                     continue
                 except ModelLoadException as e:
                     # Serve target was a LOADING copy whose load failed (or
                     # timed out) — exclude it on both axes and re-route.
+                    self.route_cache.invalidate(model_id)
                     ctx.exclude_serve.add(target)
                     ctx.exclude_load.add(target)
                     last_exc = e
@@ -722,7 +766,7 @@ class ModelMeshInstance:
             hard_exclude = (
                 ctx.exclude_load | mr.all_placements | mr.active_failures()
             )
-            views = self.instances_view.items()
+            views = self.cluster_view().instances
             if self.constraints is not None:
                 hard_exclude |= self.constraints.non_candidates(
                     mr.model_type, views
@@ -772,6 +816,40 @@ class ModelMeshInstance:
         raise last_exc or ModelLoadException(
             f"{model_id}: routing iterations exhausted"
         )
+
+    def _choose_serve_target(
+        self, model_id: str, mr: ModelRecord, ctx: RoutingContext
+    ) -> Optional[str]:
+        """Serve-target selection with the per-model route memo.
+
+        The memo is consulted only when the request carries no serve
+        exclusions — the forward-failure retry loop must always re-decide
+        (and it also invalidates, see the except branches above). A hit is
+        valid only while the registry record version, the instances-view
+        epoch, and the warming-clock bucket all match what the decision
+        was derived from; the exclusion signature is the cache key, so a
+        hit can never return an excluded instance.
+        """
+        exclude = ctx.exclude_serve | ctx.visited | {self.instance_id}
+        cache = self.route_cache
+        if not cache.enabled or ctx.exclude_serve:
+            return self.strategy.choose_serve_target(
+                mr, self.cluster_view(), frozenset(exclude)
+            )
+        sig = frozenset(exclude)
+        target = cache.lookup(
+            model_id, sig, mr.version, self.instances_view.epoch
+        )
+        if target is not None:
+            return target
+        view = self.cluster_view()
+        target = self.strategy.choose_serve_target(mr, view, sig)
+        if target is not None:
+            # Keyed on the snapshot actually used (view.epoch), not the
+            # live epoch — if the view moved mid-decision the entry is
+            # already stale and the next lookup recomputes.
+            cache.store(model_id, sig, mr.version, view.epoch, target)
+        return target
 
     # ------------------------------------------------------------------ #
     # local invocation                                                   #
@@ -1209,6 +1287,12 @@ class ModelMeshInstance:
         KV round-trips — the actual cleanup (CAS deregister + runtime
         unload) is queued onto the bounded cleanup pool.
         """
+        # Any registry movement (copy added/removed/promoted, load failed,
+        # deletion) drops the memoized route for the model. The version
+        # check in _choose_serve_target already rejects stale entries once
+        # the VIEW catches up; this eagerly frees the slot and keeps the
+        # cache from holding routes for deleted models.
+        self.route_cache.invalidate(model_id)
         if event is not TableEvent.DELETED:
             return
         if self.cache.get_quietly(model_id) is None:
@@ -1307,7 +1391,9 @@ class ModelMeshInstance:
             # load that started since the trigger is visible here. Without
             # this, the freshly CAS'd claim would be transiently dropped
             # and concurrent placements could double-load the model.
-            ce = self.cache.get(model_id)
+            # get_quietly: a registry-repair probe must not refresh the
+            # entry's LRU recency (same as the trigger-path check).
+            ce = self.cache.get_quietly(model_id)
             if ce is not None and ce.state is not EntryState.REMOVED:
                 raise _NothingToPrune(cur)
             was_loaded = cur.instance_ids.pop(self.instance_id, None)
@@ -1385,8 +1471,6 @@ class ModelMeshInstance:
             cancel_event=ctx.cancel_event,
         )
         self.metrics.inc(MX.INVOKE_FORWARD_COUNT, model_id=model_id)
-        from modelmesh_tpu.observability.tracing import outgoing_headers
-
         with self.tracer.span("forward", target=target, hop=hop):
             return self._peer_call(
                 rec.endpoint or target, model_id, method, payload,

@@ -1,0 +1,140 @@
+"""Per-model serve-route memoization for the request hot path.
+
+The cache-hit forwarding loop pays a full ``choose_serve_target`` per
+request: a pass over the model's copies against the cluster view, with
+warming/busyness ranking. At steady state the inputs barely move, so the
+chosen target is memoized per ``(model_id, exclusion-signature)`` and a
+hit costs two dict lookups — no view walk, no candidate ranking. The
+RouteBalance observation (PAPERS.md) is exactly this: fused routing+LB
+scales only when the per-request decision cost is amortized off the
+request path.
+
+A cached entry is only served while every input it was derived from is
+provably unchanged:
+
+- ``record_version`` — the registry record's KV CAS version. Any copy
+  added/removed/promoted/failed bumps it, so placement changes miss.
+- ``view_epoch`` — the instances TableView epoch (kv/table.py). Any
+  instance joining/leaving/republishing (rpm, shutdown, drain) misses.
+- warming-clock bucket — the greedy ranking depends on wall time through
+  the per-type warming penalty and the loading-copy ride-the-load bound,
+  so entries expire with the ``ttl_ms`` clock bucket (default 1 s).
+
+Callers additionally bypass the cache whenever the request carries serve
+exclusions (the forward-failure retry loop) and invalidate on registry
+watch events and observed forward failures — see
+ModelMeshInstance._choose_serve_target.
+
+Knobs (utils/envs.py): ``MM_ROUTE_CACHE`` (default on) and
+``MM_ROUTE_CACHE_TTL_MS`` (warming-clock bucket width).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from modelmesh_tpu.cache.lru import now_ms
+
+DEFAULT_TTL_MS = 1_000
+# Distinct model ids cached before a wholesale reset; a cache, not a
+# registry mirror — resets only cost the next request per model one
+# recompute.
+DEFAULT_MAX_MODELS = 8_192
+
+
+class RouteCache:
+    """Lock-free on the hit path: reads/writes are single dict operations
+    (GIL-atomic); the lock only guards the rare size-cap reset. Validity
+    is carried in the entry and checked against caller-supplied inputs,
+    so a racing store can never make a lookup return a target whose
+    inputs don't match."""
+
+    __slots__ = (
+        "enabled", "ttl_ms", "max_models",
+        "_by_model", "_lock", "hits", "misses", "invalidations",
+    )
+
+    def __init__(
+        self,
+        enabled: Optional[bool] = None,
+        ttl_ms: Optional[int] = None,
+        max_models: int = DEFAULT_MAX_MODELS,
+    ):
+        if enabled is None or ttl_ms is None:
+            from modelmesh_tpu.utils import envs
+
+            if enabled is None:
+                enabled = envs.get_bool("MM_ROUTE_CACHE")
+            if ttl_ms is None:
+                ttl_ms = envs.get_int("MM_ROUTE_CACHE_TTL_MS")
+        self.enabled = enabled
+        self.ttl_ms = max(int(ttl_ms), 1)
+        self.max_models = max_models
+        # model_id -> {exclusion_sig: (target, record_version, view_epoch,
+        #                              clock_bucket)}
+        self._by_model: dict[str, dict[frozenset, tuple]] = {}
+        self._lock = threading.Lock()
+        # Plain-int stats (racy under contention, monotone enough for
+        # bench/diagnostics — not billing).
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    def _bucket(self, now: Optional[int]) -> int:
+        return (now if now is not None else now_ms()) // self.ttl_ms
+
+    def lookup(
+        self,
+        model_id: str,
+        sig: frozenset,
+        record_version: int,
+        view_epoch: int,
+        now: Optional[int] = None,
+    ) -> Optional[str]:
+        """Cached target, or None when absent/any validity input moved."""
+        sigs = self._by_model.get(model_id)
+        entry = sigs.get(sig) if sigs is not None else None
+        if (
+            entry is not None
+            and entry[1] == record_version
+            and entry[2] == view_epoch
+            and entry[3] == self._bucket(now)
+        ):
+            self.hits += 1
+            return entry[0]
+        self.misses += 1
+        return None
+
+    def store(
+        self,
+        model_id: str,
+        sig: frozenset,
+        record_version: int,
+        view_epoch: int,
+        target: str,
+        now: Optional[int] = None,
+    ) -> None:
+        if len(self._by_model) >= self.max_models:
+            with self._lock:
+                if len(self._by_model) >= self.max_models:
+                    self._by_model = {}
+        entry = (target, record_version, view_epoch, self._bucket(now))
+        sigs = self._by_model.setdefault(model_id, {})
+        # Signatures per model stay tiny (the trivial external signature
+        # plus a handful of multi-hop variants); cap defensively so a
+        # pathological exclusion churn can't grow one model's map.
+        if len(sigs) >= 16:
+            sigs.clear()
+        sigs[sig] = entry
+
+    def invalidate(self, model_id: str) -> None:
+        if self._by_model.pop(model_id, None) is not None:
+            self.invalidations += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._by_model = {}
+
+    def __len__(self) -> int:
+        return len(self._by_model)

@@ -75,6 +75,20 @@ REGISTRY: dict[str, EnvVar] = {
                "also measure the steady-state refresh fast path: cold vs "
                "warm e2e refresh under churn (pipelined + delta snapshots "
                "+ convergence-gated early exit)", "bench.py"),
+        EnvVar("MM_BENCH_SERVE", "int", "0",
+               "also run the serving data-plane microbench: local-hit / "
+               "forward / cache-miss request-path latency at simulated "
+               "1/100/1000-instance views, route cache cold vs hot",
+               "bench.py"),
+        EnvVar("MM_ROUTE_CACHE", "bool", "1",
+               "memoize the per-model serve-route decision on the request "
+               "hot path (invalidated by registry version, instances-view "
+               "epoch, warming-clock bucket, and forward failures)",
+               "serving/route_cache.py"),
+        EnvVar("MM_ROUTE_CACHE_TTL_MS", "int", "1000",
+               "route-cache warming-clock bucket width: bounds how long a "
+               "time-dependent (warming/ride-the-load) routing decision "
+               "can be served from cache", "serving/route_cache.py"),
         EnvVar("MM_KV_READ_ONLY", "int", "0",
                "KV-migration read-only mode: block model add/remove, "
                "suppress reaper pruning", "serving/instance.py"),

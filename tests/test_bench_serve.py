@@ -1,0 +1,49 @@
+"""Smoke for the serving data-plane microbench (bench_serve.py).
+
+Runs the full harness at tiny scale (few instances, a handful of reps)
+so the bench itself can't rot: every scenario must produce a sane result
+document, with the route cache demonstrably hitting on the forward path.
+Numbers are NOT asserted — relative speedups on a loaded shared test
+core are noise; structure and correctness are the contract.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench_serve
+
+
+class TestBenchServeSmoke:
+    def test_tiny_run_produces_all_scenarios(self):
+        out = bench_serve.run(tiers=(1, 4), reps=25, select_iters=200)
+        assert out["route_cache_enabled"] in (True, False)
+        assert out["route_cache_ttl_ms"] >= 1
+        tiers = {t["instances"]: t for t in out["tiers"]}
+        assert set(tiers) == {1, 4}
+
+        solo = tiers[1]
+        assert solo["local_hit"]["reps"] == 25
+        assert solo["local_hit"]["p50_us"] > 0
+        assert solo["cache_miss"]["reps"] == 25
+        # No peers: nothing to forward to, ever.
+        assert solo["forwards_observed"] == 0
+        assert "forward_cold" not in solo
+
+        multi = tiers[4]
+        for scenario in ("local_hit", "forward_cold", "forward_cached",
+                         "cache_miss"):
+            stats = multi[scenario]
+            assert stats["reps"] > 0
+            assert stats["p50_us"] > 0
+            assert stats["p99_us"] >= stats["p50_us"]
+            assert stats["rps"] > 0
+        # The cached forward run must actually have been served from the
+        # route memo (warmup request primes it; every measured rep hits).
+        assert multi["route_cache_hits"] >= 25
+        assert multi["select_uncached_us"] > 0
+        assert multi["select_cached_us"] > 0
+        assert multi["select_legacy_copy_us"] > 0
+        assert multi["select_speedup"] is not None
+        assert multi["forwards_observed"] > 0

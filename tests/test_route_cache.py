@@ -1,0 +1,456 @@
+"""Request-path fast path: versioned views + per-model route cache.
+
+Coherence is the whole game for a routing memo: these tests pin the
+invalidation triggers (registry record version, instances-view epoch,
+warming-clock bucket, registry watch events, forward failures) and the
+agreement between cached and uncached serve-target selection — including
+the acceptance property that a request after a copy is unregistered
+never routes to the stale target.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from modelmesh_tpu.cache.lru import now_ms
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.kv.table import KVTable, TableView
+from modelmesh_tpu.placement.greedy import GreedyStrategy
+from modelmesh_tpu.placement.strategy import ClusterView
+from modelmesh_tpu.records import InstanceRecord, ModelRecord
+from modelmesh_tpu.runtime.spi import (
+    LoadedModel,
+    LocalInstanceParams,
+    ModelInfo,
+    ModelLoader,
+)
+from modelmesh_tpu.serving.errors import ServiceUnavailableError
+from modelmesh_tpu.serving.instance import (
+    InstanceConfig,
+    InvokeResult,
+    ModelMeshInstance,
+    RoutingContext,
+)
+from modelmesh_tpu.serving.route_cache import RouteCache
+
+INFO = ModelInfo(model_type="example", model_path="mem://m")
+HOUR = 3_600_000
+
+
+class _InstantLoader(ModelLoader):
+    def startup(self) -> LocalInstanceParams:
+        return LocalInstanceParams(capacity_bytes=64 << 20, load_timeout_ms=10_000)
+
+    def load(self, model_id: str, info: ModelInfo) -> LoadedModel:
+        return LoadedModel(handle=None, size_bytes=8 * 1024)
+
+    def unload(self, model_id: str) -> None:
+        pass
+
+    @property
+    def requires_unload(self) -> bool:
+        return False
+
+
+class _Harness:
+    """One real instance + synthetic peers + scriptable peer transport."""
+
+    def __init__(self, n_peers: int = 3):
+        self.kv = InMemoryKV(sweep_interval_s=3600.0)
+        self.forwards: list[str] = []
+        # peer id -> exception to raise once on the next forward to it
+        self.fail_next: dict[str, Exception] = {}
+
+        def peer_call(endpoint, model_id, method, payload, headers, ctx):
+            target = ctx.dest_instance
+            self.forwards.append(target)
+            exc = self.fail_next.pop(target, None)
+            if exc is not None:
+                raise exc
+            return InvokeResult(b"ok", target, "LOADED")
+
+        self.inst = ModelMeshInstance(
+            self.kv,
+            _InstantLoader(),
+            InstanceConfig(instance_id="i-self", load_timeout_s=5,
+                           min_churn_age_ms=0),
+            peer_call=peer_call,
+        )
+        # Wide warming-clock bucket: these tests pin the version/epoch/
+        # event invalidation triggers; the time trigger is unit-tested
+        # separately and a mid-test bucket rollover would only add noise.
+        self.inst.route_cache.ttl_ms = 60_000
+        old = now_ms() - HOUR
+        for k in range(n_peers):
+            self.put_peer(f"p-{k}", req_per_minute=10 * (k + 1), lru_ts=old)
+        self.inst.instances_view.wait_for(
+            lambda v: len(v) >= n_peers + 1, timeout=10
+        )
+
+    def put_peer(self, iid: str, **kwargs) -> InstanceRecord:
+        rec = InstanceRecord(
+            start_ts=now_ms() - HOUR, lru_ts=kwargs.pop("lru_ts", 1),
+            capacity_units=100_000, used_units=1000, endpoint=f"ep-{iid}",
+            **kwargs,
+        )
+        self.inst.instances.put(iid, rec)
+        return rec
+
+    def put_peer_synced(self, iid: str, **kwargs) -> InstanceRecord:
+        """put_peer + wait until the watch applied exactly this write
+        (KV version fencing — content comparison could pass early on a
+        no-op-looking update)."""
+        rec = self.put_peer(iid, **kwargs)
+        self.inst.instances_view.wait_for(
+            lambda v: (r := v.get(iid)) is not None
+            and r.version >= rec.version
+        )
+        return rec
+
+    def place_on(self, model_id: str, *peers: str, ts: int | None = None):
+        self.inst.register_model(model_id, INFO)  # idempotent
+        ts = ts if ts is not None else now_ms() - HOUR
+
+        def mutate(cur):
+            for p in peers:
+                cur.promote_loaded(p, ts)
+            return cur
+
+        mr = self.inst.registry.update_or_create(model_id, mutate)
+        self.inst.registry_view.wait_for(
+            lambda v: (r := v.get(model_id)) is not None
+            and r.version >= mr.version,
+            timeout=10,
+        )
+        return mr
+
+    def unplace(self, model_id: str, peer: str):
+        def mutate(cur):
+            cur.remove_instance(peer)
+            return cur
+
+        mr = self.inst.registry.update_or_create(model_id, mutate)
+        self.inst.registry_view.wait_for(
+            lambda v: (r := v.get(model_id)) is not None
+            and r.version >= mr.version,
+            timeout=10,
+        )
+        return mr
+
+    def invoke(self, model_id: str) -> InvokeResult:
+        return self.inst.invoke_model(model_id, "predict", b"x", [])
+
+    def close(self):
+        self.inst.shutdown()
+        self.kv.close()
+
+
+def _eventually(cond, timeout_s: float = 5.0):
+    deadline = time.monotonic() + timeout_s
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError("condition not reached")
+        time.sleep(0.005)
+
+
+@pytest.fixture()
+def harness():
+    h = _Harness()
+    yield h
+    h.close()
+
+
+class TestTableViewEpoch:
+    def test_epoch_moves_only_on_applied_changes(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        try:
+            table: KVTable[InstanceRecord] = KVTable(kv, "t/i", InstanceRecord)
+            view = TableView(table)
+            e0 = view.epoch
+            table.put("a", InstanceRecord(capacity_units=1))
+            view.wait_for(lambda v: v.get("a") is not None)
+            e1 = view.epoch
+            assert e1 > e0
+            # No movement, no bump.
+            assert view.epoch == e1
+            table.put("a", InstanceRecord(capacity_units=2))
+            view.wait_for(
+                lambda v: v.get("a") is not None
+                and v.get("a").capacity_units == 2
+            )
+            assert view.epoch > e1
+            e2 = view.epoch
+            table.delete("a")
+            view.wait_for(lambda v: v.get("a") is None)
+            assert view.epoch > e2
+            # snapshot() pairs epoch and items atomically.
+            epoch, items = view.snapshot()
+            assert epoch == view.epoch and items == []
+        finally:
+            kv.close()
+
+
+class TestClusterViewSnapshot:
+    def test_view_shared_until_epoch_moves(self, harness):
+        inst = harness.inst
+        v1 = inst.cluster_view()
+        assert inst.cluster_view() is v1  # same object, no copy
+        assert v1.epoch == inst.instances_view.epoch
+        harness.put_peer("p-new")
+        inst.instances_view.wait_for(lambda v: v.get("p-new") is not None)
+        v2 = inst.cluster_view()
+        assert v2 is not v1 and v2.epoch > v1.epoch
+        assert "p-new" in v2.live_map
+
+    def test_derived_collections_cached_per_snapshot(self, harness):
+        v = harness.inst.cluster_view()
+        assert v.live() is v.live()
+        assert v.placeable() is v.placeable()
+        assert v.live_map is v.live_map
+
+    def test_self_fallback_not_rebuilt_per_request(self, harness):
+        inst = harness.inst
+        calls = []
+        orig = inst._build_instance_record
+        inst._build_instance_record = lambda: (
+            calls.append(1) or orig()  # type: ignore[func-returns-value]
+        )
+        try:
+            for _ in range(50):
+                inst.cluster_view()
+            assert calls == []  # served from cache / cached self record
+            inst.publish_instance_record(force=True)
+            assert len(calls) == 1  # rebuilt exactly on publish
+        finally:
+            inst._build_instance_record = orig
+
+    def test_publish_refreshes_fallback_in_cached_view(self, harness):
+        """Review finding: while the fallback is in use our own publishes
+        don't move the table epoch, so publish must drop the cached view
+        or it pins the startup-era self record indefinitely."""
+        inst = harness.inst
+        inst.instances.delete(inst.instance_id)
+        inst.instances_view.wait_for(
+            lambda v: v.get(inst.instance_id) is None
+        )
+        v1 = inst.cluster_view()
+        old_rec = dict(v1.instances)[inst.instance_id]
+        assert inst.cluster_view() is v1  # cached
+        inst.publish_instance_record(force=True)
+        v2 = inst.cluster_view()
+        assert v2 is not v1
+        assert dict(v2.instances)[inst.instance_id] is not old_rec
+
+    def test_fallback_used_before_watch_roundtrip(self, harness):
+        # Simulate the pre-roundtrip window: view without our own record.
+        inst = harness.inst
+        inst.instances.delete(inst.instance_id)
+        inst.instances_view.wait_for(
+            lambda v: v.get(inst.instance_id) is None
+        )
+        view = inst.cluster_view()
+        assert inst.instance_id in dict(view.instances)
+        assert dict(view.instances)[inst.instance_id] is inst._self_record
+
+
+class TestRouteCacheUnit:
+    def test_hit_requires_every_validity_input(self):
+        rc = RouteCache(enabled=True, ttl_ms=60_000)
+        sig = frozenset({"i-self"})
+        now = 120_000
+        rc.store("m", sig, 3, 7, "p-1", now=now)
+        assert rc.lookup("m", sig, 3, 7, now=now) == "p-1"
+        assert rc.lookup("m", sig, 4, 7, now=now) is None        # version
+        assert rc.lookup("m", sig, 3, 8, now=now) is None        # epoch
+        assert rc.lookup("m", frozenset(), 3, 7, now=now) is None  # sig
+        assert rc.lookup("m", sig, 3, 7, now=now + 60_000) is None  # bucket
+        assert rc.lookup("other", sig, 3, 7, now=now) is None
+
+    def test_invalidate_drops_all_signatures(self):
+        rc = RouteCache(enabled=True, ttl_ms=60_000)
+        rc.store("m", frozenset({"a"}), 1, 1, "p-1", now=0)
+        rc.store("m", frozenset({"a", "b"}), 1, 1, "p-2", now=0)
+        assert len(rc) == 1
+        rc.invalidate("m")
+        assert rc.lookup("m", frozenset({"a"}), 1, 1, now=0) is None
+        assert rc.invalidations == 1
+
+    def test_size_cap_resets(self):
+        rc = RouteCache(enabled=True, ttl_ms=60_000, max_models=4)
+        for i in range(10):
+            rc.store(f"m{i}", frozenset(), 1, 1, "p", now=0)
+        assert len(rc) <= 4
+
+
+class TestRouteCacheCoherence:
+    def test_steady_state_hits_and_routes_correctly(self, harness):
+        harness.place_on("m", "p-0")
+        r1 = harness.invoke("m")
+        assert r1.served_by == "p-0"
+        h0 = harness.inst.route_cache.hits
+        for _ in range(5):
+            assert harness.invoke("m").served_by == "p-0"
+        assert harness.inst.route_cache.hits - h0 == 5
+
+    def test_unregistered_copy_never_routed_to(self, harness):
+        """THE acceptance property: after a copy is unregistered, no
+        request routes to the stale target once the view reflects it."""
+        harness.place_on("m", "p-0", "p-1")
+        first = harness.invoke("m").served_by
+        assert first == "p-0"  # least busy of the two
+        harness.unplace("m", "p-0")
+        harness.forwards.clear()
+        for _ in range(10):
+            assert harness.invoke("m").served_by == "p-1"
+        assert "p-0" not in harness.forwards
+
+    def test_registry_event_invalidates(self, harness):
+        harness.place_on("m", "p-0")
+        harness.invoke("m")
+        assert "m" in harness.inst.route_cache._by_model
+        # ANY registry movement (here: a copy added elsewhere) drops the
+        # memo eagerly via the watch listener. (The listener runs just
+        # after the view applies the event — poll, don't assert.)
+        harness.place_on("m", "p-1")
+        _eventually(
+            lambda: "m" not in harness.inst.route_cache._by_model
+        )
+
+    def test_epoch_bump_forces_redecision(self, harness):
+        harness.place_on("m", "p-0", "p-1")
+        assert harness.invoke("m").served_by == "p-0"
+        # p-0 starts draining: instance record update bumps the view
+        # epoch; the cached route must not survive it.
+        harness.put_peer("p-0", req_per_minute=10, shutting_down=True)
+        harness.inst.instances_view.wait_for(
+            lambda v: v.get("p-0") is not None and v.get("p-0").shutting_down
+        )
+        assert harness.invoke("m").served_by == "p-1"
+
+    def test_forward_failure_bypasses_and_invalidates(self, harness):
+        harness.place_on("m", "p-0", "p-1")
+        assert harness.invoke("m").served_by == "p-0"
+        # Next forward to p-0 dies; the same request must retry (cache
+        # bypassed via exclude_serve) and land on p-1...
+        harness.fail_next["p-0"] = ServiceUnavailableError("ep-p-0")
+        assert harness.invoke("m").served_by == "p-1"
+        # ...and the failure evicted the memo: nothing cached routes to
+        # p-0 without a fresh decision (which re-picks p-0 only because
+        # it is genuinely live again and least busy — that's correct).
+        assert "m" not in harness.inst.route_cache._by_model or (
+            harness.inst.route_cache._by_model["m"] == {}
+        )
+
+    def test_disabled_cache_still_serves(self, harness):
+        harness.inst.route_cache.enabled = False
+        harness.place_on("m", "p-0")
+        for _ in range(3):
+            assert harness.invoke("m").served_by == "p-0"
+        assert harness.inst.route_cache.hits == 0
+
+
+def _legacy_choose_serve_target(strategy, model, view, exclude):
+    """The pre-PR sort-based selection, kept verbatim as the parity oracle."""
+    live = {iid: rec for iid, rec in view.live()}
+    now = now_ms()
+    expect = strategy._expect_ms(model.model_type)
+    candidates = []
+    for iid, load_ts in model.instance_ids.items():
+        if iid in exclude or iid not in live:
+            continue
+        warming = now - load_ts < expect
+        candidates.append(((warming, live[iid].req_per_minute, iid), iid))
+    if candidates:
+        candidates.sort()
+        return candidates[0][1]
+    no_evidence = (
+        strategy.time_stats is not None
+        and strategy.time_stats.samples(model.model_type)
+        < strategy.time_stats.min_samples
+    )
+    loading = [
+        (elapsed, iid)
+        for iid, claim_ts in model.loading_instances.items()
+        if iid not in exclude and iid in live
+        and ((elapsed := now - claim_ts) <= expect or no_evidence)
+    ]
+    if loading:
+        return max(loading)[1]
+    return None
+
+
+class TestSelectionParity:
+    def test_single_pass_matches_sort_based_oracle(self):
+        """Property-style: the rewritten single-pass selection agrees with
+        the original sort-based implementation on random views/exclusions
+        (timestamps kept far from the warming boundary so the two now_ms()
+        reads can't straddle it)."""
+        rng = random.Random(0xC0FFEE)
+        strat = GreedyStrategy()
+        expect = strat._expect_ms("t")
+        for _ in range(300):
+            now = now_ms()
+            n = rng.randint(0, 12)
+            ids = [f"i-{k}" for k in range(n)]
+            instances = []
+            for iid in ids:
+                instances.append((iid, InstanceRecord(
+                    capacity_units=100, used_units=rng.randint(0, 100),
+                    req_per_minute=rng.choice([0, 5, 5, 50, 500]),
+                    shutting_down=rng.random() < 0.2,
+                )))
+            view = ClusterView(instances=tuple(instances))
+            mr = ModelRecord(model_type="t")
+            for iid in ids:
+                r = rng.random()
+                if r < 0.4:
+                    # Far on either side of the warming boundary.
+                    mr.instance_ids[iid] = now - int(
+                        rng.choice([0.1, 10.0]) * expect
+                    )
+                elif r < 0.6:
+                    mr.loading_instances[iid] = now - int(
+                        rng.choice([0.1, 10.0]) * expect
+                    )
+            exclude = frozenset(
+                iid for iid in ids if rng.random() < 0.3
+            )
+            got = strat.choose_serve_target(mr, view, exclude)
+            want = _legacy_choose_serve_target(strat, mr, view, exclude)
+            assert got == want, (mr.instance_ids, mr.loading_instances,
+                                 exclude, instances)
+
+    def test_cached_and_uncached_agree_under_random_churn(self, harness):
+        """Drive the instance-level cached selection against the direct
+        strategy call across random registry/instance mutations; after
+        every quiesced mutation the two must agree."""
+        rng = random.Random(7)
+        inst = harness.inst
+        peers = ["p-0", "p-1", "p-2"]
+        harness.place_on("m", *peers)
+        for step in range(40):
+            op = rng.random()
+            if op < 0.4:
+                victim = rng.choice(peers)
+                if rng.random() < 0.5:
+                    harness.unplace("m", victim)
+                else:
+                    harness.place_on("m", victim)
+            elif op < 0.8:
+                # put_peer_synced quiesces on the write's KV version so
+                # the comparison below can't race the watch apply.
+                harness.put_peer_synced(
+                    rng.choice(peers),
+                    req_per_minute=rng.randint(0, 500),
+                )
+            mr = inst.registry_view.get("m")
+            sig = frozenset({inst.instance_id})
+            for _ in range(3):
+                cached = inst._choose_serve_target("m", mr, RoutingContext())
+                direct = inst.strategy.choose_serve_target(
+                    mr, inst.cluster_view(), sig
+                )
+                assert cached == direct, f"step {step}"

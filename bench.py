@@ -23,6 +23,13 @@ MM_BENCH_SERVE=1 additionally runs the serving data-plane microbench
 cache miss) at simulated 1/100/1000-instance views, with the per-model
 route cache cold vs hot.
 
+MM_BENCH_LIFECYCLE=1 additionally runs the model-lifecycle bench
+(bench_lifecycle.py): time-to-first-serve, time-to-N-copies (N=4), and
+500-model mass-registration throughput with KV write counts — the
+pipelined load fast path (serve-before-sizing, concurrent chained
+fan-out, batched promote+publish txn, coalesced publishes) vs the serial
+per-load baseline.
+
 MM_BENCH_STEADY=1 measures the steady-state refresh fast path: one cold
 refresh, then a churn loop (~1% of models touched per cycle) driven
 through the pipelined refresher — delta snapshots (dirty tracking),
@@ -398,6 +405,19 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             print(
                 f"bench: serve measurement failed: {e}", file=sys.stderr
+            )
+    # Model-lifecycle fast path (MM_BENCH_LIFECYCLE=1): time-to-first-
+    # serve, time-to-N-copies, and mass-registration throughput with KV
+    # write counts, pipelined vs serial (bench_lifecycle.py; CPU-only, no
+    # device involved). Failure must not lose the kernel line.
+    if envs.get_int("MM_BENCH_LIFECYCLE"):
+        try:
+            import bench_lifecycle
+
+            result["lifecycle"] = bench_lifecycle.run()
+        except Exception as e:  # noqa: BLE001
+            print(
+                f"bench: lifecycle measurement failed: {e}", file=sys.stderr
             )
     # Steady-state refresh fast path: cold vs warm (pipelined + delta +
     # early exit) under churn. Failure must not lose the kernel line.

@@ -1,0 +1,317 @@
+"""Model-lifecycle microbenchmark: cold-start & scale-up fast path.
+
+bench_serve.py measures the steady-state request path; this bench measures
+the third hot path — getting a model from "registered" to "serving N
+copies" — before/after the pipelined load lifecycle (MM_LOAD_FASTPATH,
+MM_PUBLISH_COALESCE_MS):
+
+  first_serve — one real instance, a loader with configurable load and
+                sizing delays: wall time from a cold ``invoke_model`` to
+                the first served byte. The serial pipeline pays
+                load + sizing before activation; serve-before-sizing pays
+                only the load (sizing overlaps live traffic as a guarded
+                correction).
+  n_copies    — a small in-process fleet (direct-call peer transport with
+                the production sync semantics: a forwarded placement
+                blocks until the remote load completes, like the gRPC
+                Forward hop), ``ensure_loaded(chain=N-1)``: wall time
+                until the registry shows N loaded copies. The sequential
+                chain costs ~N x load; the concurrent claim-time fan-out
+                approaches max(load).
+  mass_load   — register + load ``mass_models`` models on one instance
+                through an instantaneous loader, against a KV proxy that
+                counts write RPCs: throughput plus total registry writes
+                and STANDALONE instance-record publish puts — the batched
+                promote+publish txn and the coalesced publisher vs the
+                per-load CAS + publish baseline.
+
+Each scenario runs both modes (serial baseline: fastpath off, coalescing
+off; pipelined: both on) and reports the speedup / write reduction.
+Numbers are wall-clock on whatever core runs the bench; the structure and
+the ratios are the signal, as with the sibling benches.
+
+Run directly (`python bench_lifecycle.py`, one JSON document) or via
+`MM_BENCH_LIFECYCLE=1 python bench.py` (attached under "lifecycle").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.runtime.spi import (
+    LoadedModel,
+    LocalInstanceParams,
+    ModelInfo,
+    ModelLoader,
+)
+from modelmesh_tpu.serving.instance import (
+    InstanceConfig,
+    ModelMeshInstance,
+)
+
+INFO = ModelInfo(model_type="bench", model_path="mem://bench")
+MODEL_BYTES = 8 * 1024
+
+
+class _LifecycleLoader(ModelLoader):
+    """Configurable-delay loader: ``load_ms`` inside load(), ``size_ms``
+    inside the model_size RPC. With ``inline_size`` the load reports its
+    size directly (no sizing stage at all — the mass-load scenario, where
+    the measured cost should be registry writes, not sleeps)."""
+
+    def __init__(self, load_ms=0.0, size_ms=0.0, inline_size=False):
+        self.load_ms = load_ms
+        self.size_ms = size_ms
+        self.inline_size = inline_size
+
+    def startup(self) -> LocalInstanceParams:
+        return LocalInstanceParams(
+            capacity_bytes=1 << 30, load_timeout_ms=60_000
+        )
+
+    def load(self, model_id: str, info: ModelInfo) -> LoadedModel:
+        if self.load_ms:
+            time.sleep(self.load_ms / 1e3)
+        return LoadedModel(
+            handle=None,
+            size_bytes=MODEL_BYTES if self.inline_size else 0,
+        )
+
+    def predict_size(self, model_id: str, info: ModelInfo) -> int:
+        return MODEL_BYTES
+
+    def model_size(self, model_id: str, handle) -> int:
+        if self.size_ms:
+            time.sleep(self.size_ms / 1e3)
+        return MODEL_BYTES
+
+    def unload(self, model_id: str) -> None:
+        pass
+
+    @property
+    def requires_unload(self) -> bool:
+        return False
+
+
+class _CountingKV:
+    """KVStore proxy counting write RPCs. Reads/watches/leases pass
+    through; put/delete/txn (and the CAS convenience entry points, which
+    would otherwise reach the inner store's own txn uncounted) are
+    counted. ``publish_puts`` counts STANDALONE instance-record puts —
+    the number the publish coalescer and the promote-piggybacked publish
+    exist to collapse."""
+
+    def __init__(self, inner, instances_prefix: str):
+        self._inner = inner
+        self._instances_prefix = instances_prefix
+        self.writes = 0
+        self.publish_puts = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def put(self, key, value, lease=0):
+        self.writes += 1
+        if key.startswith(self._instances_prefix):
+            self.publish_puts += 1
+        return self._inner.put(key, value, lease)
+
+    def delete(self, key):
+        self.writes += 1
+        return self._inner.delete(key)
+
+    def txn(self, compares, on_success, on_failure=()):
+        self.writes += 1
+        return self._inner.txn(compares, on_success, on_failure)
+
+    def put_if_version(self, key, value, expected_version, lease=0):
+        self.writes += 1
+        return self._inner.put_if_version(key, value, expected_version, lease)
+
+    def delete_if_version(self, key, expected_version):
+        self.writes += 1
+        return self._inner.delete_if_version(key, expected_version)
+
+
+def _fleet(n, kv, fastpath, coalesce_ms, load_ms=0.0, size_ms=0.0,
+           inline_size=True):
+    """n in-process instances on one KV with a direct-call peer transport
+    mirroring the gRPC Forward semantics (remote hops run sync)."""
+    by_endpoint = {}
+
+    def peer_call(endpoint, model_id, method, payload, headers, ctx):
+        return by_endpoint[endpoint].invoke_model(
+            model_id, method, payload, headers, ctx, sync=True
+        )
+
+    insts = []
+    for i in range(n):
+        inst = ModelMeshInstance(
+            kv,
+            _LifecycleLoader(load_ms, size_ms, inline_size),
+            InstanceConfig(
+                instance_id=f"i-{i:02d}", endpoint=f"ep-{i:02d}",
+                load_timeout_s=60, min_churn_age_ms=0,
+                load_fastpath=fastpath, publish_coalesce_ms=coalesce_ms,
+            ),
+            peer_call=peer_call,
+            runtime_call=(
+                lambda ce, method, payload, headers, cancel_event=None:
+                payload
+            ),
+        )
+        by_endpoint[inst.config.endpoint] = inst
+        insts.append(inst)
+    for inst in insts:
+        inst.instances_view.wait_for(lambda v: len(v) >= n, timeout=30)
+    return insts
+
+
+def _close(insts, kv):
+    for inst in insts:
+        inst.shutdown()
+    kv.close()
+
+
+def _measure_first_serve(fastpath: bool, load_ms: float, size_ms: float,
+                         reps: int) -> dict:
+    samples = []
+    for r in range(reps):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        insts = _fleet(1, kv, fastpath, coalesce_ms=0,
+                       load_ms=load_ms, size_ms=size_ms, inline_size=False)
+        inst = insts[0]
+        inst.register_model(f"m-{r}", INFO)
+        t0 = time.perf_counter()
+        inst.invoke_model(f"m-{r}", "predict", b"x" * 64, [])
+        samples.append((time.perf_counter() - t0) * 1e3)
+        _close(insts, kv)
+    return {
+        "reps": reps,
+        "load_ms": load_ms,
+        "size_ms": size_ms,
+        "ttfs_ms": round(statistics.median(samples), 1),
+    }
+
+
+def _measure_n_copies(fastpath: bool, n_copies: int, fleet: int,
+                      load_ms: float, reps: int) -> dict:
+    samples = []
+    for r in range(reps):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        insts = _fleet(fleet, kv, fastpath, coalesce_ms=0,
+                       load_ms=load_ms, inline_size=True)
+        inst = insts[0]
+        mid = f"m-{r}"
+        inst.register_model(mid, INFO)
+        t0 = time.perf_counter()
+        inst.ensure_loaded(mid, sync=True, chain=n_copies - 1)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            mr = inst.registry.get(mid)
+            if mr is not None and len(mr.instance_ids) >= n_copies:
+                break
+            time.sleep(0.002)
+        samples.append((time.perf_counter() - t0) * 1e3)
+        mr = inst.registry.get(mid)
+        copies = len(mr.instance_ids) if mr else 0
+        _close(insts, kv)
+        assert copies >= n_copies, (
+            f"only {copies}/{n_copies} copies materialized"
+        )
+    return {
+        "reps": reps,
+        "n": n_copies,
+        "fleet": fleet,
+        "load_ms": load_ms,
+        "time_to_n_ms": round(statistics.median(samples), 1),
+    }
+
+
+def _measure_mass_load(fastpath: bool, coalesce_ms: int,
+                       models: int) -> dict:
+    inner = InMemoryKV(sweep_interval_s=3600.0)
+    kv = _CountingKV(inner, "mm/instances/")
+    insts = _fleet(1, kv, fastpath, coalesce_ms, inline_size=True)
+    inst = insts[0]
+    setup_writes, setup_pubs = kv.writes, kv.publish_puts
+    t0 = time.perf_counter()
+    for i in range(models):
+        inst.register_model(f"m-{i:05d}", INFO, load_now=True, sync=True)
+    wall_s = time.perf_counter() - t0
+    # Let the trailing coalesced flush (if armed) land so the write counts
+    # are the complete storm, not the storm minus its tail.
+    time.sleep(max(0.05, coalesce_ms / 1000.0 * 2))
+    out = {
+        "models": models,
+        "wall_ms": round(wall_s * 1e3, 1),
+        "throughput_per_s": round(models / wall_s, 1),
+        "kv_writes": kv.writes - setup_writes,
+        "standalone_publish_puts": kv.publish_puts - setup_pubs,
+        "loaded": len(inst.cache),
+    }
+    _close(insts, kv)
+    return out
+
+
+def run(load_ms: float = 80.0, size_ms: float = 80.0, n_copies: int = 4,
+        fleet: int = 5, mass_models: int = 500, reps: int = 3) -> dict:
+    serial_fs = _measure_first_serve(False, load_ms, size_ms, reps)
+    fast_fs = _measure_first_serve(True, load_ms, size_ms, reps)
+    serial_nc = _measure_n_copies(False, n_copies, fleet, load_ms, reps)
+    fast_nc = _measure_n_copies(True, n_copies, fleet, load_ms, reps)
+    serial_ml = _measure_mass_load(False, 0, mass_models)
+    fast_ml = _measure_mass_load(True, 25, mass_models)
+    return {
+        "first_serve": {
+            "serial": serial_fs,
+            "fastpath": fast_fs,
+            "speedup": round(
+                serial_fs["ttfs_ms"] / max(fast_fs["ttfs_ms"], 1e-9), 2
+            ),
+        },
+        "n_copies": {
+            "serial": serial_nc,
+            "fastpath": fast_nc,
+            "speedup": round(
+                serial_nc["time_to_n_ms"]
+                / max(fast_nc["time_to_n_ms"], 1e-9), 2
+            ),
+        },
+        "mass_load": {
+            "serial": serial_ml,
+            "fastpath": fast_ml,
+            "write_reduction": round(
+                serial_ml["kv_writes"] / max(fast_ml["kv_writes"], 1), 2
+            ),
+            "publish_reduction": round(
+                serial_ml["standalone_publish_puts"]
+                / max(fast_ml["standalone_publish_puts"], 1), 1
+            ),
+        },
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--load-ms", type=float, default=80.0)
+    ap.add_argument("--size-ms", type=float, default=80.0)
+    ap.add_argument("--n-copies", type=int, default=4)
+    ap.add_argument("--fleet", type=int, default=5)
+    ap.add_argument("--mass-models", type=int, default=500)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+    print(json.dumps(run(
+        args.load_ms, args.size_ms, args.n_copies, args.fleet,
+        args.mass_models, args.reps,
+    )))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

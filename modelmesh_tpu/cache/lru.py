@@ -221,6 +221,24 @@ class WeightedLRUCache(Generic[K, V]):
                 self._evict_over_capacity(exclude=key)
             return old
 
+    def update_weight_if_value(
+        self, key: K, value: V, new_weight: int
+    ) -> bool:
+        """CAS-style ``update_weight``: re-account only while ``key`` still
+        maps to this exact ``value``. The serve-before-sizing correction
+        uses this so a stale sizing follow-up can never re-weigh a
+        replacement copy inserted after its entry was evicted."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry.value is not value:
+                return False
+            old = entry.weight
+            entry.weight = new_weight
+            self._weight += new_weight - old
+            if new_weight > old:
+                self._evict_over_capacity(exclude=key)
+            return True
+
     # -- iteration --------------------------------------------------------
 
     def descending_items(self) -> Iterator[tuple[K, V, int]]:

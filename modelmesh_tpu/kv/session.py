@@ -58,6 +58,20 @@ class SessionNode:
             if self._lease is not None:
                 self.store.put(self.key, value, lease=self._lease)
 
+    def publish_op(self, value: bytes):
+        """An ``Op`` updating this node, for riding someone else's txn
+        (the batched promote-loaded + instance-record publish). Records
+        the value as the node's latest so a later lease re-establish
+        republishes it; returns None when no lease is live yet (caller
+        falls back to a standalone ``update``-style publish)."""
+        from modelmesh_tpu.kv.store import Op
+
+        with self._lock:
+            self._value = value
+            if self._lease is None:
+                return None
+            return Op(self.key, value, lease=self._lease)
+
     def _keepalive_loop(self) -> None:
         while not self._stop.wait(self._interval):
             with self._lock:

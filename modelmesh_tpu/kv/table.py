@@ -23,12 +23,14 @@ import json
 import threading
 import time
 from dataclasses import asdict
-from typing import Callable, Generic, Iterator, Optional, Type, TypeVar
+from typing import Callable, Generic, Iterator, Optional, Sequence, Type, TypeVar
 
 from modelmesh_tpu.kv.store import (
     CasFailed,
+    Compare,
     EventType,
     KVStore,
+    Op,
     WatchEvent,
 )
 
@@ -160,6 +162,61 @@ class KVTable(Generic[R]):
             except CasFailed:
                 continue
         raise CasFailed(f"update_or_create({id_}): too many CAS conflicts")
+
+    def batch_mutate(
+        self,
+        mutations: Sequence[tuple[str, Callable[[Optional[R]], Optional[R]]]],
+        extra_ops: Sequence[Op] = (),
+        max_attempts: int = 20,
+    ) -> dict[str, Optional[R]]:
+        """CAS-guarded multi-record mutation committed as ONE store txn.
+
+        Each ``(id, mutate)`` follows update_or_create semantics (mutate
+        gets current-or-None, returns desired-or-None meaning delete /
+        no-op-if-absent), but every record write lands atomically in a
+        single ``store.txn`` guarded on every record's version —
+        collapsing N CAS round trips into one. ``extra_ops`` ride the same
+        txn unconditionally (e.g. an instance-record publish piggybacked
+        on a promote-loaded), so callers can merge table writes with
+        adjacent-key updates without an extra RPC. Any version conflict
+        retries the WHOLE batch from fresh reads.
+
+        Returns id -> final record (None if deleted/absent no-op).
+        """
+        for _ in range(max_attempts):
+            compares: list[Compare] = []
+            ops: list[Op] = []
+            results: dict[str, Optional[R]] = {}
+            writes: list[tuple[str, R]] = []
+            for id_, mutate in mutations:
+                current = self.get(id_)
+                desired = mutate(current)
+                cur_version = current.version if current is not None else 0
+                key = self._key(id_)
+                compares.append(Compare(key, cur_version))
+                if desired is None:
+                    results[id_] = None
+                    if current is not None:
+                        ops.append(Op(key))  # delete
+                else:
+                    desired.version = cur_version
+                    ops.append(Op(key, desired.to_bytes()))
+                    writes.append((id_, desired))
+                    results[id_] = desired
+            ops.extend(extra_ops)
+            if not ops:
+                return results
+            ok, _ = self.store.txn(compares, ops, [])
+            if ok:
+                # Refresh versions like conditional_set does (the
+                # conditionalSetAndGet idiom): written keys bumped once.
+                for id_, rec in writes:
+                    rec.version += 1
+                return results
+        raise CasFailed(
+            f"batch_mutate({[i for i, _ in mutations]}): "
+            "too many CAS conflicts"
+        )
 
 
 class BucketedKVTable(KVTable[R]):

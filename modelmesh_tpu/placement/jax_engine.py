@@ -1141,14 +1141,18 @@ class JaxPlacementStrategy(PlacementStrategy):
         # warm_g / warm_price).
         self._warm_g: Optional[dict[str, float]] = None
         self._warm_price: Optional[dict[str, float]] = None
-        # Delta-snapshot state: the cached columns plus the dirty sets
+        # Delta-snapshot state: the cached columns plus the dirty marks
         # accumulated since the last refresh (mark_dirty, watch-fed).
-        # _dirty_lock is separate from _refresh_lock so event threads never
-        # block behind a multi-hundred-ms solve.
+        # Marks map id -> highest record version announced (0 = version
+        # unknown); the version lets a refresh detect marks whose
+        # mutation is NEWER than the list snapshot it is patching from
+        # and re-queue them (see _requeue_stale_marks). _dirty_lock is
+        # separate from _refresh_lock so event threads never block behind
+        # a multi-hundred-ms solve.
         self._snap_cache: Optional[SnapshotCache] = None
         self._dirty_lock = threading.Lock()
-        self._dirty_models: set = set()
-        self._dirty_instances: set = set()
+        self._dirty_models: dict = {}
+        self._dirty_instances: dict = {}
         # Consecutive delta refreshes since the last full rebuild. Under
         # perpetual small churn the dirty fraction never trips the patch
         # fallback, so without a cap the frozen noise epoch would freeze
@@ -1163,47 +1167,88 @@ class JaxPlacementStrategy(PlacementStrategy):
         return self._plan
 
     def mark_dirty(
-        self, models: Sequence[str] = (), instances: Sequence[str] = ()
+        self, models: Sequence = (), instances: Sequence = ()
     ) -> None:
         """Record churned records for the next ``refresh(incremental=True)``.
 
         The tracking contract: every model/instance whose record changed
         since the last refresh must be marked, or the delta snapshot serves
         stale columns for it until the next full rebuild. Registry/instance
-        watch handlers are the natural callers."""
-        with self._dirty_lock:
-            self._dirty_models.update(models)
-            self._dirty_instances.update(instances)
+        watch handlers are the natural callers.
 
-    def _take_dirty(self) -> tuple[set, set]:
+        Entries are bare ids or ``(id, record_version)`` pairs. A
+        versioned mark closes the watch-race window: if the refresh that
+        consumes it is patching from a list snapshot OLDER than the
+        marked version (the caller's ``items()`` read happened before the
+        mutation landed), the mark is re-queued instead of silently
+        consumed — see ``_requeue_stale_marks``. Bare ids keep the
+        original best-effort semantics."""
+        with self._dirty_lock:
+            for entry in models:
+                mid, ver = entry if isinstance(entry, tuple) else (entry, 0)
+                if ver >= self._dirty_models.get(mid, 0):
+                    self._dirty_models[mid] = ver
+            for entry in instances:
+                iid, ver = entry if isinstance(entry, tuple) else (entry, 0)
+                if ver >= self._dirty_instances.get(iid, 0):
+                    self._dirty_instances[iid] = ver
+
+    def _take_dirty(self) -> tuple[dict, dict]:
         with self._dirty_lock:
             dm, di = self._dirty_models, self._dirty_instances
-            self._dirty_models, self._dirty_instances = set(), set()
+            self._dirty_models, self._dirty_instances = {}, {}
             return dm, di
+
+    def _requeue_stale_marks(self, dm, di, models, instances) -> None:
+        """Re-queue consumed marks whose record version is NEWER than the
+        snapshot just applied: a watch event that landed between the
+        refresher's ``items()`` read and ``_take_dirty`` was patched (or
+        rebuilt) from the stale pre-event record — without this its mark
+        would be gone and the record's columns stale for up to
+        MAX_DELTA_STREAK refreshes, until the forced full rebuild."""
+        cache = self._snap_cache
+        if cache is None:
+            return
+        stale_m = [
+            (mid, ver) for mid, ver in dm.items()
+            if ver
+            and (i := cache.model_pos.get(mid)) is not None
+            and models[i][1].version < ver
+        ]
+        stale_i = [
+            (iid, ver) for iid, ver in di.items()
+            if ver
+            and (j := cache.inst_pos.get(iid)) is not None
+            and instances[j][1].version < ver
+        ]
+        if stale_m or stale_i:
+            self.mark_dirty(stale_m, stale_i)
 
     def _build_cols(self, models, instances, rpm_fn, incremental: bool):
         """Delta-patch the cached snapshot when allowed, else rebuild (and
         re-prime the cache). Returns (cols, was_delta)."""
+        dm, di = self._take_dirty()
         if (
             incremental
             and self._snap_cache is not None
             and self._delta_streak < MAX_DELTA_STREAK
         ):
-            dm, di = self._take_dirty()
             cols = patch_columns(
-                self._snap_cache, models, instances, rpm_fn, dm, di,
-                constraints=self.constraints,
+                self._snap_cache, models, instances, rpm_fn,
+                set(dm), set(di), constraints=self.constraints,
             )
             if cols is not None:
                 self._delta_streak += 1
+                self._requeue_stale_marks(dm, di, models, instances)
                 return cols, True
-        else:
-            self._take_dirty()  # consumed by the rebuild below
         cols, self._snap_cache = snapshot_columns(
             models, instances, rpm_fn, constraints=self.constraints,
             return_cache=True,
         )
         self._delta_streak = 0
+        # A rebuild from a stale list has the same race: keep marks whose
+        # mutation the rebuilt snapshot provably hasn't seen.
+        self._requeue_stale_marks(dm, di, models, instances)
         return cols, False
 
     def _epoch_carries(self, delta: bool):

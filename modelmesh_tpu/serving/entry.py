@@ -85,6 +85,12 @@ class CacheEntry:
         self.load_completed_ms: Optional[int] = None
         self._lock = threading.Lock()
         self._done = threading.Event()
+        # Broadcast on EVERY state transition (not just terminal ones):
+        # load waiters sleep on this instead of polling, waking exactly
+        # when the entry moves — activation, failure, removal, or an
+        # intermediate phase change that re-bases their timeout budget
+        # (QUEUED -> LOADING starts the per-type load clock).
+        self._state_cv = threading.Condition(self._lock)
         self._sem: Optional[threading.Semaphore] = None
         self.max_concurrency = 0
         self.inflight = 0
@@ -125,6 +131,7 @@ class CacheEntry:
         self.state = new
         if new.is_terminal:
             self._done.set()
+        self._state_cv.notify_all()
 
     def try_transition(self, new: EntryState) -> bool:
         """Advance to a non-terminal loading state unless already terminal
@@ -134,6 +141,7 @@ class CacheEntry:
             if self.state.is_terminal:
                 return False
             self.state = new
+            self._state_cv.notify_all()
             return True
 
     def complete_load(self, loaded: LoadedModel) -> bool:
@@ -169,6 +177,19 @@ class CacheEntry:
         if self.state is EntryState.FAILED:
             raise ModelLoadException(self.error or "load failed")
         return self.state is EntryState.ACTIVE
+
+    def await_transition(
+        self, known: EntryState, timeout_s: float
+    ) -> EntryState:
+        """Event-driven wait: block until the state is no longer ``known``
+        (any transition wakes us — the condition broadcasts on every
+        advance) or the timeout elapses; returns the state seen on wake.
+        Load waiters use this instead of a fixed-cadence poll, so wakeup
+        latency is notification latency, not poll-interval slack."""
+        with self._state_cv:
+            if self.state is known and timeout_s > 0:
+                self._state_cv.wait(timeout_s)
+            return self.state
 
     # -- invocation gating ---------------------------------------------------
 

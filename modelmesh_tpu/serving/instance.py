@@ -84,6 +84,11 @@ DEFAULT_MIN_CHURN_AGE_MS = 60_000
 REGISTRATION_BACKDATE_MS = 3_600_000
 
 
+class _NoPublishLease(Exception):
+    """Session lease not live yet — the merged promote+publish txn cannot
+    ride it; fall back to the plain promote CAS."""
+
+
 class RoutingContext:
     """Per-request routing state (proto mesh_internal.RoutingContext)."""
 
@@ -157,6 +162,8 @@ class InstanceConfig:
         min_churn_age_ms: int = DEFAULT_MIN_CHURN_AGE_MS,
         publish_interval_s: float = 8.0,
         read_only: Optional[bool] = None,
+        load_fastpath: Optional[bool] = None,
+        publish_coalesce_ms: Optional[int] = None,
     ):
         self.instance_id = instance_id or f"i-{uuid.uuid4().hex[:8]}"
         self.kv_prefix = kv_prefix.rstrip("/")
@@ -179,6 +186,25 @@ class InstanceConfig:
 
             read_only = bool(envs.get_int("MM_KV_READ_ONLY"))
         self.read_only = read_only
+        # Cold-start/scale-up fast path (MM_LOAD_FASTPATH): activate an
+        # entry as soon as the runtime load returns (sizing becomes an
+        # overlapped follow-up correction) and fan secondary chained
+        # copies out concurrently at claim time instead of hop-by-hop
+        # after each completion.
+        if load_fastpath is None:
+            from modelmesh_tpu.utils import envs
+
+            load_fastpath = envs.get_bool("MM_LOAD_FASTPATH")
+        self.load_fastpath = load_fastpath
+        # Trailing-flush window for NON-forced instance-record publishes
+        # (MM_PUBLISH_COALESCE_MS, 0 = publish inline): a mass load/unload
+        # storm collapses its O(models) advertisement refreshes into O(1)
+        # KV puts. force=True always bypasses.
+        if publish_coalesce_ms is None:
+            from modelmesh_tpu.utils import envs
+
+            publish_coalesce_ms = envs.get_int("MM_PUBLISH_COALESCE_MS")
+        self.publish_coalesce_ms = publish_coalesce_ms
 
 
 class ModelMeshInstance:
@@ -205,6 +231,7 @@ class ModelMeshInstance:
         around it."""
         self.config = config or InstanceConfig()
         self.instance_id = self.config.instance_id
+        self.load_fastpath = self.config.load_fastpath
         self.store = store
         self.loader = loader
         self.strategy = strategy or GreedyStrategy()
@@ -335,6 +362,11 @@ class ModelMeshInstance:
             self._plan_follower = PlanFollower(store, prefix, self.strategy)
         self._publish_lock = threading.Lock()
         self._last_published: Optional[InstanceRecord] = None
+        # Publish coalescer state (trailing-flush window; see
+        # publish_instance_record).
+        self._coalesce_lock = threading.Lock()
+        self._publish_timer: Optional[threading.Timer] = None
+        self._shutdown_publishes = False
         # Watch-driven deletion cleanup (reference registers a registry
         # listener at ModelMesh.java:629; the deletion handler at :2807
         # removes local copies at :2814): when a model is unregistered
@@ -460,21 +492,68 @@ class ModelMeshInstance:
 
     def publish_instance_record(self, force: bool = False) -> None:
         """Refresh our advertisement; suppress no-op updates (reference
-        change-suppression, ModelMesh.java:5440-5468)."""
+        change-suppression, ModelMesh.java:5440-5468).
+
+        Non-forced publishes coalesce behind a trailing-flush window
+        (``publish_coalesce_ms``): the first request arms a one-shot
+        flush timer, later requests inside the window ride it, and the
+        flush publishes the freshest record — a mass load/unload storm
+        issues O(1) puts instead of O(models). ``force=True`` bypasses
+        the window (and disarms any pending flush: the forced publish
+        already carries the freshest state)."""
+        window_ms = self.config.publish_coalesce_ms
+        if not force and window_ms > 0:
+            with self._coalesce_lock:
+                if self._shutdown_publishes:
+                    return
+                if self._publish_timer is None:
+                    t = threading.Timer(
+                        window_ms / 1000.0, self._publish_flush
+                    )
+                    t.daemon = True
+                    t.name = "publish-coalesce"
+                    self._publish_timer = t
+                    t.start()
+            return
+        if force:
+            with self._coalesce_lock:
+                t, self._publish_timer = self._publish_timer, None
+            if t is not None:
+                t.cancel()
+        self._publish_now(force)
+
+    def _publish_flush(self) -> None:
+        """Trailing edge of the coalesce window (timer thread)."""
+        with self._coalesce_lock:
+            self._publish_timer = None
+        try:
+            self._publish_now(force=False)
+        except Exception:  # noqa: BLE001 — periodic publisher will retry
+            log.warning("coalesced publish flush failed", exc_info=True)
+
+    def _build_publish_record_locked(self) -> InstanceRecord:
+        """Build the advertisement (start_ts carried from the last
+        publish) and refresh the cluster-view self-fallback — on every
+        publish ATTEMPT, suppressed/coalesced or not: the fallback should
+        carry the freshest self-observation without per-request rebuilds,
+        and the cached view must be dropped too — while the fallback is
+        in use (our record not yet in the watch-fed table) our own
+        publishes don't move the table epoch, so the epoch check alone
+        would pin the startup-era self record indefinitely. Shared by
+        the standalone publish and the promote-piggybacked publish so
+        the bookkeeping cannot fork. Callers hold _publish_lock."""
+        rec = self._build_instance_record()
+        prev = self._last_published
+        if prev is not None:
+            rec.start_ts = prev.start_ts
+        self._self_record = rec
+        self._cluster_view_cache = None
+        return rec
+
+    def _publish_now(self, force: bool = False) -> None:
         with self._publish_lock:
-            rec = self._build_instance_record()
             prev = self._last_published
-            if prev is not None:
-                rec.start_ts = prev.start_ts
-            # Refresh the cluster-view self-fallback on every publish
-            # attempt (suppressed or not): the fallback should carry the
-            # freshest self-observation without per-request rebuilds. The
-            # cached view must be dropped too — while the fallback is in
-            # use (our record not yet in the watch-fed table) our own
-            # publishes don't move the table epoch, so the epoch check
-            # alone would pin the startup-era self record indefinitely.
-            self._self_record = rec
-            self._cluster_view_cache = None
+            rec = self._build_publish_record_locked()
             if not force and prev is not None:
                 same = (
                     prev.model_count == rec.model_count
@@ -487,6 +566,9 @@ class ModelMeshInstance:
                     return
             self._session.update(rec.to_bytes())
             self._last_published = rec
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
         self.metrics.set_gauge(MX.MODELS_LOADED, len(self.cache))
         self.metrics.set_gauge(MX.CACHE_USED_UNITS, self.cache.weight)
         self.metrics.set_gauge(MX.CACHE_CAPACITY_UNITS, self.cache.capacity)
@@ -954,14 +1036,36 @@ class ModelMeshInstance:
         excluded (reference triggerChainedLoadIfNecessary,
         ModelMesh.java:4560-4585) — distributing an N-copy ensureLoaded
         across the fleet one hop at a time instead of hammering one caller.
+        Under ``load_fastpath`` the chain already fanned out concurrently
+        at claim time (``_load_local``); the ``_chain_fired`` flag keeps
+        this completion-time trigger from double-firing it.
         """
         remaining = getattr(ce, "chain_load_count", 0)
-        if remaining <= 0:
+        if remaining <= 0 or getattr(ce, "_chain_fired", False):
             return
         ce._chain_fired = True
         self._spawn_chain(ce.model_id, ce.last_used, remaining)
 
     def _spawn_chain(self, model_id: str, last_used: int, remaining: int) -> None:
+        """Dispatch ``remaining`` secondary copies on a background thread.
+
+        Fast path (``load_fastpath``): ``_fanout_chain`` — all
+        ``remaining`` placements are issued CONCURRENTLY, so the copies
+        load in parallel across the fleet and time-to-N-copies approaches
+        max(load) instead of N x load.
+
+        Legacy path: one hop that propagates ``chain=remaining-1`` to the
+        target, which fires the next copy only after ITS load completes —
+        the reference's hop-by-hop distribution.
+        """
+        if self.load_fastpath:
+            threading.Thread(
+                target=self._fanout_chain,
+                args=(model_id, last_used, remaining),
+                name=f"chain-{model_id}", daemon=True,
+            ).start()
+            return
+
         def chain():
             try:
                 mr = self.registry.get(model_id)
@@ -980,6 +1084,101 @@ class ModelMeshInstance:
         threading.Thread(
             target=chain, name=f"chain-{model_id}", daemon=True
         ).start()
+
+    def _fanout_chain(self, model_id: str, last_used: int, remaining: int) -> None:
+        """Concurrent chained fan-out (runs on the chain thread).
+
+        ``sync`` does not traverse the internal Forward hop (a forwarded
+        placement blocks until the remote load completes), so concurrency
+        comes from DIRECTED parallel placements: a sequential pre-pass
+        picks ``remaining`` distinct targets with the strategy (local,
+        no KV writes), then one worker per target places a copy with
+        every OTHER known instance excluded — concurrent placements can
+        never collapse onto one instance, and each worker places at most
+        one copy, so the chain budget is a hard ceiling on fan-out copies
+        even when the first load later fails. A top-up pass repairs
+        under-delivery (a directed placement that failed, or collapsed
+        onto an instance that joined mid-fan-out and absorbed several
+        workers) — but the chain owes ``remaining`` NEW copies beyond
+        the surviving original placements only: a first-load failure (or
+        an original copy evicted meanwhile) shrinks the target instead
+        of baiting the top-up into replacing copies it never owed.
+        """
+        try:
+            mr = self.registry.get(model_id)
+            if mr is None or mr.load_exhausted():
+                return
+            originals = set(mr.all_placements)
+            view = self.cluster_view()
+            known = {iid for iid, _ in view.instances}
+            units = bytes_to_units(self._predict_size_bytes(model_id, mr))
+            exclude = set(mr.all_placements) | {self.instance_id}
+            targets: list[str] = []
+            for _ in range(remaining):
+                req = PlacementRequest(
+                    model_id=model_id,
+                    model=mr,
+                    required_units=units,
+                    requesting_instance=self.instance_id,
+                    exclude=frozenset(exclude),
+                    last_used_ms=last_used or now_ms(),
+                )
+                target = self.strategy.choose_load_target(req, view)
+                if target is None or target in (LOAD_HERE, self.instance_id):
+                    break
+                targets.append(target)
+                exclude.add(target)
+
+            def place(target: str) -> None:
+                try:
+                    self.ensure_loaded(
+                        model_id,
+                        last_used_ms=last_used,
+                        sync=False,
+                        exclude=(known | {self.instance_id}) - {target},
+                        chain=0,
+                    )
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log.debug(
+                        "fan-out placement of %s on %s failed: %s",
+                        model_id, target, e,
+                    )
+
+            workers = [
+                threading.Thread(
+                    target=place, args=(t,),
+                    name=f"chain-{model_id}-{t}", daemon=True,
+                )
+                for t in targets
+            ]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join()
+            # Top-up: place until the fleet holds `remaining` copies
+            # beyond the SURVIVING originals. The target is recomputed
+            # per attempt, so an original that failed or was evicted
+            # shrinks it (never replaced), while a worker collapse onto
+            # a mid-fan-out joiner (copies short, all budget nominally
+            # spent) is repaired. Bounded attempts; each gated on a
+            # fresh authoritative read.
+            for _ in range(remaining):
+                mr = self.registry.get(model_id)
+                if mr is None or mr.load_exhausted():
+                    return
+                placements = set(mr.all_placements)
+                want = remaining + len(originals & placements)
+                if len(placements) >= want:
+                    return
+                self.ensure_loaded(
+                    model_id,
+                    last_used_ms=last_used,
+                    sync=False,
+                    exclude=placements | {self.instance_id},
+                    chain=0,
+                )
+        except Exception as e:  # noqa: BLE001 — chain is best-effort
+            log.debug("chained fan-out of %s stopped: %s", model_id, e)
 
     # ------------------------------------------------------------------ #
     # local load lifecycle                                               #
@@ -1059,13 +1258,32 @@ class ModelMeshInstance:
         self.loading_pool.submit(
             lambda: self._run_load(ce), urgent=urgent, last_used=last_used
         )
+        # Concurrent chained fan-out: secondary copies start placing as
+        # soon as the FIRST copy's loading claim is in the registry (just
+        # CASed above) rather than after its load completes — the whole
+        # chain loads in parallel across the fleet.
+        if (
+            self.load_fastpath
+            and ctx.chain_load_count > 0
+            and not getattr(ce, "_chain_fired", False)
+        ):
+            ce._chain_fired = True
+            self._spawn_chain(model_id, last_used, ctx.chain_load_count)
         return ce
 
     def _run_load(self, ce: CacheEntry) -> None:
         """Loading-pool task. All state advances go through the entry's
         guarded transitions so a concurrent eviction (-> REMOVED) is never
         clobbered; if the entry is removed after the runtime load happened,
-        the runtime copy is released here."""
+        the runtime copy is released here.
+
+        Pipelined fast path (``load_fastpath``): the entry activates and
+        serves traffic as soon as ``loader.load`` returns a usable handle
+        — the predicted size keeps holding the cache slot — and the
+        ``model_size`` RPC runs as an overlapped follow-up whose guarded
+        weight correction (``_correct_sizing``) can never touch an entry
+        a concurrent eviction removed. Serial path (fastpath off, or the
+        loader reported its size inline): size first, then activate."""
         model_id = ce.model_id
         # Anchor the queue-delay at submit time (set in _load_local), not at
         # worker pickup — otherwise the metric reads ~0 exactly when the
@@ -1080,9 +1298,13 @@ class ModelMeshInstance:
                         f"{model_id}: timed out waiting for unload space",
                         timeout=True,
                     )
+            # Stamp the load start BEFORE the LOADING broadcast: waiters
+            # wake on that transition to re-base onto the per-type load
+            # budget, and must never observe LOADING with no start time
+            # (they would silently fall back to the flat cap).
+            ce.load_started_ms = now_ms()
             if not ce.try_transition(EntryState.LOADING):
                 return
-            ce.load_started_ms = now_ms()
             self.metrics.observe(
                 MX.QUEUE_DELAY, ce.load_started_ms - queued_ms, model_id
             )
@@ -1092,6 +1314,12 @@ class ModelMeshInstance:
             if self.probation is not None:
                 self.probation.record_success()
             size_bytes = loaded.size_bytes
+            if not size_bytes and self.load_fastpath:
+                # Serve-before-sizing: waiters wake NOW; the sizing RPC
+                # and weight/registry correction overlap live traffic.
+                if self._activate(ce, loaded):
+                    self._correct_sizing(ce, loaded)
+                return
             if not size_bytes and ce.try_transition(EntryState.SIZING):
                 t_size = _time.perf_counter()
                 size_bytes = self.loader.model_size(model_id, loaded.handle)
@@ -1109,24 +1337,91 @@ class ModelMeshInstance:
                         size_bytes=size_bytes,
                         max_concurrency=loaded.max_concurrency,
                     )
-            if not ce.complete_load(loaded):
-                # Removed (evicted/unregistered) while we were loading.
-                self.loader.unload(model_id)
-                return
-            self._promote_loaded(model_id, size_units=ce.weight_units)
-            self._trigger_chained_load(ce)
-            self.metrics.inc(MX.LOAD_COUNT, model_id=model_id)
-            if ce.load_started_ms:
-                elapsed = now_ms() - ce.load_started_ms
-                self.metrics.observe(MX.LOAD_TIME, elapsed, model_id)
-                self.time_stats.record(ce.info.model_type, elapsed)
-            self.publish_instance_record()
+            self._activate(ce, loaded)
         except ModelLoadException as e:
             self._load_failed(ce, str(e))
         except Exception as e:  # noqa: BLE001 — any load error is a failure
             self._load_failed(ce, f"{type(e).__name__}: {e}")
 
-    def _promote_loaded(self, model_id: str, size_units: int = 0) -> None:
+    def _activate(self, ce: CacheEntry, loaded) -> bool:
+        """Finalize a runtime load: ACTIVE (unless removed meanwhile — then
+        the runtime copy is released), registry promotion with the
+        instance-record publish riding the same txn, chained-load trigger,
+        load metrics. Returns True when the entry activated."""
+        model_id = ce.model_id
+        if not ce.complete_load(loaded):
+            # Removed (evicted/unregistered) while we were loading.
+            self.loader.unload(model_id)
+            return False
+        published = self._promote_loaded(model_id, size_units=ce.weight_units)
+        self._trigger_chained_load(ce)
+        self.metrics.inc(MX.LOAD_COUNT, model_id=model_id)
+        if ce.load_started_ms:
+            elapsed = now_ms() - ce.load_started_ms
+            self.metrics.observe(MX.LOAD_TIME, elapsed, model_id)
+            self.time_stats.record(ce.info.model_type, elapsed)
+        if not published:
+            self.publish_instance_record()
+        return True
+
+    def _correct_sizing(self, ce: CacheEntry, loaded) -> None:
+        """Overlapped follow-up of a serve-before-sizing activation: run
+        the ``model_size`` RPC and re-account the entry from its predicted
+        weight to the measured one. Guarded throughout — the entry is
+        already ACTIVE and serving, so a sizing failure only keeps the
+        prediction, and the correction applies through
+        ``update_weight_if_value`` so a concurrently evicted (or replaced)
+        copy is never touched."""
+        model_id = ce.model_id
+        try:
+            t_size = _time.perf_counter()
+            size_bytes = self.loader.model_size(model_id, loaded.handle)
+            self.metrics.observe(
+                MX.SIZING_TIME, (_time.perf_counter() - t_size) * 1e3,
+                model_id,
+            )
+        except Exception as e:  # noqa: BLE001 — keep serving on prediction
+            log.warning(
+                "post-activation sizing of %s failed (serving continues "
+                "on the predicted size): %s", model_id, e,
+            )
+            return
+        if not size_bytes:
+            return
+        new_units = bytes_to_units(size_bytes)
+        if new_units == ce.weight_units:
+            return
+        if not self.cache.update_weight_if_value(model_id, ce, new_units):
+            return  # evicted/replaced during sizing: nothing to correct
+        ce.weight_units = new_units
+        ce.loaded = type(loaded)(
+            handle=loaded.handle,
+            size_bytes=size_bytes,
+            max_concurrency=loaded.max_concurrency,
+        )
+
+        # The promotion advertised the predicted units to the global
+        # solver — correct the record only when the measurement moved it.
+        def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
+            if cur is None:
+                return None
+            cur.size_units = new_units
+            return cur
+
+        try:
+            self.registry.update_or_create(model_id, mutate)
+        except CasFailed:
+            log.warning("size-correction CAS gave up for %s", model_id)
+        self.publish_instance_record()
+
+    def _promote_loaded(self, model_id: str, size_units: int = 0) -> bool:
+        """CAS the loaded promotion into the registry, with the refreshed
+        instance-record advertisement riding the SAME store txn (the
+        batched-mutation fast path: one KV round trip where the serial
+        pipeline paid a promote CAS plus a separate publish put). Returns
+        True when the publish rode the txn — the caller can then skip its
+        standalone publish entirely."""
+
         def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
             if cur is None:
                 return None
@@ -1136,13 +1431,55 @@ class ModelMeshInstance:
             return cur
 
         try:
+            if not self.load_fastpath:
+                raise _NoPublishLease  # serial baseline: plain CAS below
+            with self._publish_lock:
+                rec = self._build_publish_record_locked()
+                op = self._session.publish_op(rec.to_bytes())
+            if op is None:
+                raise _NoPublishLease
+            # The txn runs OUTSIDE _publish_lock: CAS retries are KV
+            # round trips, and concurrent load completions must not
+            # convoy on the lock (it guards only the bookkeeping).
+            # Interleaved publishes are each self-consistent — the
+            # suppression state follows whichever record committed last.
+            self.registry.batch_mutate([(model_id, mutate)], [op])
+            with self._publish_lock:
+                self._last_published = rec
+            self._publish_gauges()
+            return True
+        except CasFailed:
+            # The record mutation gave up AND the piggybacked publish
+            # never committed — let the caller's coalesced publish carry
+            # the advertisement on its own.
+            log.warning("promote-loaded CAS gave up for %s", model_id)
+            return False
+        except _NoPublishLease:
+            pass
+        except Exception as e:  # noqa: BLE001 — e.g. session lease died
+            log.warning(
+                "merged promote+publish txn for %s failed (%s); "
+                "falling back to a plain promote", model_id, e,
+            )
+        try:
             self.registry.update_or_create(model_id, mutate)
         except CasFailed:
             log.warning("promote-loaded CAS gave up for %s", model_id)
+        return False
 
     def _wait_entry_active(self, ce: CacheEntry, cancel_event=None) -> bool:
         """Wait for an entry to activate, with a per-type bound on the LOAD
         phase only (reference TimeStats at ModelMesh.java:4351).
+
+        Event-driven: the entry's condition variable broadcasts on every
+        state transition, so the waiter sleeps for exactly its remaining
+        budget and wakes at activation / failure / removal with
+        notification latency — no polling-cadence slack. Intermediate
+        transitions (QUEUED -> LOADING sets ``load_started_ms``) also wake
+        it, re-basing the per-type load budget the moment the runtime load
+        actually starts. Only a request carrying a ``cancel_event`` still
+        slices its sleep: cancellation arrives on a foreign Event that
+        cannot notify this condition.
 
         The overall wait is capped by the flat load_timeout*1.5 bound — it
         covers queueing behind a saturated loading pool, where per-type
@@ -1162,24 +1499,33 @@ class ModelMeshInstance:
             # loads and cascade duplicate copies).
             load_budget_s = cap_s
         deadline = _time.monotonic() + cap_s
+        state = ce.state
         while True:
-            if ce.wait_active(0.25):
+            if state is EntryState.ACTIVE:
                 return True
+            if state is EntryState.FAILED:
+                raise ModelLoadException(ce.error or "load failed")
+            if state is EntryState.REMOVED:
+                return False
             if cancel_event is not None and cancel_event.is_set():
                 # The client is gone: stop pinning this handler thread on
                 # the load (the load itself continues for other waiters).
                 raise RequestCancelledError(ce.model_id)
-            if ce.state.is_terminal:
-                # FAILED raises inside wait_active; REMOVED lands here.
-                return ce.state is EntryState.ACTIVE
             now = _time.monotonic()
+            remaining = deadline - now
             started = ce.load_started_ms
-            if now >= deadline or (
-                started and (now_ms() - started) / 1000.0 >= load_budget_s
-            ):
+            if started:
+                remaining = min(
+                    remaining,
+                    load_budget_s - (now_ms() - started) / 1000.0,
+                )
+            if remaining <= 0:
                 self.metrics.inc(MX.LOAD_TIMEOUT_COUNT, model_id=ce.model_id)
                 self._log_loader_stacks(ce.model_id)
                 return False
+            if cancel_event is not None:
+                remaining = min(remaining, 0.25)
+            state = ce.await_transition(state, remaining)
 
     def _log_loader_stacks(self, model_id: str) -> None:
         """On a load timeout, capture the loading-pool threads' live stacks
@@ -1511,6 +1857,13 @@ class ModelMeshInstance:
     shutdown_skip_migration = False
 
     def shutdown(self) -> None:
+        # Disarm the publish coalescer first: a trailing flush firing
+        # after the session closes would republish a dead instance.
+        with self._coalesce_lock:
+            self._shutdown_publishes = True
+            timer, self._publish_timer = self._publish_timer, None
+        if timer is not None:
+            timer.cancel()
         self.loading_pool.shutdown()
         self._cleanup_pool.shutdown()
         self._unload_pool.shutdown()

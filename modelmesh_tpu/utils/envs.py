@@ -80,6 +80,23 @@ REGISTRY: dict[str, EnvVar] = {
                "forward / cache-miss request-path latency at simulated "
                "1/100/1000-instance views, route cache cold vs hot",
                "bench.py"),
+        EnvVar("MM_BENCH_LIFECYCLE", "int", "0",
+               "also run the model-lifecycle bench (bench_lifecycle.py): "
+               "time-to-first-serve, time-to-N-copies, and 500-model "
+               "mass-registration throughput with KV write counts, "
+               "pipelined fast path vs the serial baseline", "bench.py"),
+        EnvVar("MM_LOAD_FASTPATH", "bool", "1",
+               "pipelined model-load lifecycle: activate entries as soon "
+               "as the runtime load returns (sizing becomes an overlapped "
+               "guarded correction) and fan chained secondary copies out "
+               "concurrently at claim time instead of hop-by-hop after "
+               "each completion", "serving/instance.py"),
+        EnvVar("MM_PUBLISH_COALESCE_MS", "int", "100",
+               "trailing-flush window coalescing NON-forced instance-"
+               "record publishes (0 = publish inline; force=True always "
+               "bypasses): a mass load/unload storm issues O(1) "
+               "advertisement puts instead of O(models)",
+               "serving/instance.py"),
         EnvVar("MM_ROUTE_CACHE", "bool", "1",
                "memoize the per-model serve-route decision on the request "
                "hot path (invalidated by registry version, instances-view "

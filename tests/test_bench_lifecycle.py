@@ -1,0 +1,53 @@
+"""Smoke for the model-lifecycle microbench (bench_lifecycle.py).
+
+Runs the full harness at tiny scale (short loader delays, a small fleet,
+a few dozen models) so the bench itself can't rot: every scenario must
+produce a sane result document, with the pipelined mode demonstrably
+issuing fewer registry writes and standalone publishes than the serial
+baseline. Wall-clock speedups are NOT asserted beyond sanity — relative
+timings on a loaded shared test core are noise; structure and the
+write-count contract are deterministic.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench_lifecycle
+
+
+class TestBenchLifecycleSmoke:
+    def test_tiny_run_produces_all_scenarios(self):
+        out = bench_lifecycle.run(
+            load_ms=20.0, size_ms=20.0, n_copies=3, fleet=4,
+            mass_models=40, reps=1,
+        )
+
+        fs = out["first_serve"]
+        for mode in ("serial", "fastpath"):
+            assert fs[mode]["ttfs_ms"] > 0
+        # The serial pipeline pays load + sizing before the first byte;
+        # serve-before-sizing pays only the load. Generous bound: the
+        # fast path must at least beat serial's sizing-included total.
+        assert fs["fastpath"]["ttfs_ms"] < fs["serial"]["ttfs_ms"]
+        assert fs["speedup"] > 1.0
+
+        nc = out["n_copies"]
+        assert nc["serial"]["n"] == nc["fastpath"]["n"] == 3
+        assert nc["fastpath"]["time_to_n_ms"] > 0
+        # Sequential chain ~= N x load; concurrent fan-out ~= max(load).
+        assert (
+            nc["fastpath"]["time_to_n_ms"] < nc["serial"]["time_to_n_ms"]
+        )
+
+        ml = out["mass_load"]
+        assert ml["serial"]["loaded"] == ml["fastpath"]["loaded"] == 40
+        assert ml["fastpath"]["throughput_per_s"] > 0
+        # Deterministic contracts: the merged promote+publish txn saves
+        # one write per load, and coalescing collapses the O(models)
+        # standalone publish storm to O(1).
+        assert ml["fastpath"]["kv_writes"] < ml["serial"]["kv_writes"]
+        assert ml["serial"]["standalone_publish_puts"] >= 40
+        assert ml["fastpath"]["standalone_publish_puts"] <= 3
+        assert ml["write_reduction"] > 1.0

@@ -1,0 +1,448 @@
+"""Pipelined load-lifecycle races: serve-before-sizing, concurrent
+chained fan-out, batched/coalesced registry writes, event-driven waiters.
+
+Covers the windows the cold-start fast path opens:
+- an eviction landing during the overlapped sizing follow-up must never
+  re-activate / re-weigh a removed entry (and an eviction during the
+  runtime load itself still releases the runtime copy),
+- the claim-time fan-out must never place more total copies than the
+  chain budget, even when the FIRST copy's load fails,
+- the coalesced publisher must always flush (trailing edge) and
+  force=True must bypass and disarm it,
+- load waiters must wake on both completion and failure through the
+  entry condition variable (no polling cadence in the wake path).
+"""
+
+import threading
+import time
+
+import pytest
+
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.kv.store import Op
+from modelmesh_tpu.kv.table import KVTable
+from modelmesh_tpu.records import ModelRecord
+from modelmesh_tpu.runtime.spi import (
+    LoadedModel,
+    LocalInstanceParams,
+    ModelInfo,
+    ModelLoader,
+)
+from modelmesh_tpu.serving.entry import CacheEntry, EntryState
+from modelmesh_tpu.serving.instance import (
+    InstanceConfig,
+    ModelMeshInstance,
+    RoutingContext,
+)
+
+INFO = ModelInfo(model_type="pipe", model_path="mem://pipe")
+
+
+class GatedLoader(ModelLoader):
+    """Loads/sizes gated on events so tests can park a load mid-stage."""
+
+    def __init__(self, size_bytes=64 * 1024):
+        self.size_bytes = size_bytes
+        self.load_gate = threading.Event()
+        self.load_gate.set()
+        self.size_gate = threading.Event()
+        self.size_gate.set()
+        self.sizing_entered = threading.Event()
+        self.unloads: list[str] = []
+        self.fail_loads = False
+
+    def startup(self) -> LocalInstanceParams:
+        return LocalInstanceParams(
+            capacity_bytes=4 << 20, load_timeout_ms=10_000
+        )
+
+    def load(self, model_id: str, info: ModelInfo) -> LoadedModel:
+        assert self.load_gate.wait(10)
+        if self.fail_loads:
+            raise RuntimeError("synthetic load failure")
+        return LoadedModel(handle=None, size_bytes=0)  # forces sizing
+
+    def predict_size(self, model_id: str, info: ModelInfo) -> int:
+        return 8 * 1024  # 1 unit predicted; measured size differs
+
+    def model_size(self, model_id: str, handle) -> int:
+        self.sizing_entered.set()
+        assert self.size_gate.wait(10)
+        return self.size_bytes
+
+    def unload(self, model_id: str) -> None:
+        self.unloads.append(model_id)
+
+    @property
+    def requires_unload(self) -> bool:
+        return False
+
+
+def _instance(kv, loader, iid="i-0", peer_call=None, **cfg):
+    cfg.setdefault("load_fastpath", True)
+    cfg.setdefault("publish_coalesce_ms", 0)
+    return ModelMeshInstance(
+        kv,
+        loader,
+        InstanceConfig(
+            instance_id=iid, endpoint=f"ep-{iid}", load_timeout_s=10,
+            min_churn_age_ms=0, **cfg,
+        ),
+        peer_call=peer_call,
+        runtime_call=(
+            lambda ce, method, payload, headers, cancel_event=None: payload
+        ),
+    )
+
+
+class TestServeBeforeSizing:
+    def test_serves_while_sizing_then_corrects_weight(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loader = GatedLoader(size_bytes=64 * 1024)  # 8 units measured
+        loader.size_gate.clear()
+        inst = _instance(kv, loader)
+        try:
+            inst.register_model("m", INFO)
+            out = inst.invoke_model("m", "predict", b"hi", [])
+            # Served BEFORE the sizing RPC was allowed to finish.
+            assert out.payload == b"hi"
+            assert loader.sizing_entered.wait(5)
+            ce = inst.cache.get_quietly("m")
+            assert ce.state is EntryState.ACTIVE
+            assert ce.weight_units == 1  # predicted units hold the slot
+            loader.size_gate.set()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and ce.weight_units != 8:
+                time.sleep(0.01)
+            assert ce.weight_units == 8
+            assert inst.cache.weight == 8
+            # The registry size correction landed too.
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if (inst.registry.get("m") or ModelRecord()).size_units == 8:
+                    break
+                time.sleep(0.01)
+            assert inst.registry.get("m").size_units == 8
+        finally:
+            inst.shutdown()
+            kv.close()
+
+    def test_eviction_during_sizing_never_serves_removed_entry(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loader = GatedLoader(size_bytes=64 * 1024)
+        loader.size_gate.clear()
+        inst = _instance(kv, loader)
+        try:
+            inst.register_model("m", INFO)
+            inst.invoke_model("m", "predict", b"hi", [])
+            ce = inst.cache.get_quietly("m")
+            assert loader.sizing_entered.wait(5)
+            # Eviction lands while the sizing follow-up is parked.
+            assert inst._remove_local("m")
+            assert ce.state is EntryState.REMOVED
+            weight_after_evict = inst.cache.weight
+            loader.size_gate.set()
+            time.sleep(0.3)
+            # The stale correction must not resurrect the entry, nor
+            # re-account its weight into the cache.
+            assert inst.cache.get_quietly("m") is None
+            assert inst.cache.weight == weight_after_evict
+            assert ce.state is EntryState.REMOVED
+            with pytest.raises(Exception):
+                inst.invoke_model(
+                    "m", "predict", b"hi", [],
+                    RoutingContext(hop=RoutingContext.HIT_ONLY),
+                )
+        finally:
+            inst.shutdown()
+            kv.close()
+
+    def test_eviction_during_load_releases_runtime_copy(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loader = GatedLoader()
+        loader.load_gate.clear()
+        inst = _instance(kv, loader)
+        try:
+            inst.register_model("m", INFO)
+            inst.invoke_model("m", "predict", b"", [], sync=False)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                ce = inst.cache.get_quietly("m")
+                # Wait until the runtime load is genuinely in flight
+                # (parked inside load() on the gate) before evicting.
+                if ce is not None and ce.state is EntryState.LOADING:
+                    break
+                time.sleep(0.01)
+            assert inst._remove_local("m")
+            loader.load_gate.set()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and "m" not in loader.unloads:
+                time.sleep(0.01)
+            # complete_load refused (entry REMOVED) and released the copy.
+            assert "m" in loader.unloads
+        finally:
+            inst.shutdown()
+            kv.close()
+
+
+class TestChainFanout:
+    def _fleet(self, n, first_fails=False):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        by_endpoint = {}
+
+        def peer_call(endpoint, model_id, method, payload, headers, ctx):
+            return by_endpoint[endpoint].invoke_model(
+                model_id, method, payload, headers, ctx, sync=True
+            )
+
+        insts = []
+        for i in range(n):
+            loader = GatedLoader()
+            if i == 0 and first_fails:
+                loader.fail_loads = True
+            inst = _instance(
+                kv, loader, iid=f"i-{i}", peer_call=peer_call
+            )
+            by_endpoint[inst.config.endpoint] = inst
+            insts.append(inst)
+        for inst in insts:
+            inst.instances_view.wait_for(lambda v: len(v) >= n, timeout=10)
+        return kv, insts
+
+    def test_fanout_reaches_n_copies(self):
+        kv, insts = self._fleet(5)
+        try:
+            insts[0].register_model("m", INFO)
+            insts[0].ensure_loaded("m", sync=True, chain=3)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                mr = insts[0].registry.get("m")
+                if mr and len(mr.instance_ids) >= 4:
+                    break
+                time.sleep(0.02)
+            mr = insts[0].registry.get("m")
+            assert len(mr.instance_ids) == 4
+        finally:
+            for inst in insts:
+                inst.shutdown()
+            kv.close()
+
+    def test_fanout_budget_holds_when_first_load_fails(self):
+        kv, insts = self._fleet(5, first_fails=True)
+        try:
+            insts[0].register_model("m", INFO)
+            # First copy forced local (and doomed); the claim-time
+            # fan-out fires chain=2 secondaries on healthy peers.
+            with pytest.raises(Exception):
+                insts[0].invoke_model(
+                    "m", "predict", b"", [],
+                    RoutingContext(
+                        hop=RoutingContext.LOAD_LOCAL_ONLY,
+                        chain_load_count=2,
+                    ),
+                )
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                mr = insts[0].registry.get("m")
+                if mr and len(mr.instance_ids) >= 2:
+                    break
+                time.sleep(0.02)
+            # Settle: no straggler placements beyond the budget.
+            time.sleep(0.5)
+            mr = insts[0].registry.get("m")
+            total = len(mr.instance_ids) + len(mr.loading_instances)
+            # The failed first copy never promotes; the fan-out placed at
+            # most its chain budget (2), never more.
+            assert len(mr.instance_ids) == 2
+            assert total <= 2
+            assert "i-0" in mr.load_failures
+        finally:
+            for inst in insts:
+                inst.shutdown()
+            kv.close()
+
+
+class TestCoalescedPublish:
+    def _count_session_puts(self, kv, prefix="mm/instances/"):
+        class Counter:
+            puts = 0
+
+        counter = Counter()
+        orig_put = kv.put
+
+        def counting_put(key, value, lease=0):
+            if key.startswith(prefix):
+                counter.puts += 1
+            return orig_put(key, value, lease)
+
+        kv.put = counting_put
+        return counter
+
+    def test_trailing_edge_always_flushes(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        inst = _instance(kv, GatedLoader(), publish_coalesce_ms=120)
+        try:
+            counter = self._count_session_puts(kv)
+            inst._last_published = None  # defeat change-suppression
+            for _ in range(10):
+                inst.publish_instance_record()
+            # Inside the window: nothing published yet.
+            assert counter.puts == 0
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and counter.puts == 0:
+                time.sleep(0.01)
+            # Trailing flush fired exactly once for the whole burst.
+            assert counter.puts == 1
+            time.sleep(0.3)
+            assert counter.puts == 1
+        finally:
+            inst.shutdown()
+            kv.close()
+
+    def test_force_bypasses_and_disarms_pending_flush(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        inst = _instance(kv, GatedLoader(), publish_coalesce_ms=150)
+        try:
+            counter = self._count_session_puts(kv)
+            inst._last_published = None
+            inst.publish_instance_record()          # arms the window
+            inst.publish_instance_record(force=True)  # immediate
+            assert counter.puts == 1
+            # The pending trailing flush was disarmed by the force.
+            time.sleep(0.5)
+            assert counter.puts == 1
+        finally:
+            inst.shutdown()
+            kv.close()
+
+
+class TestEventDrivenWaiters:
+    def test_waiter_wakes_on_success(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loader = GatedLoader()
+        loader.load_gate.clear()
+        inst = _instance(kv, loader)
+        try:
+            inst.register_model("m", INFO)
+            results = []
+
+            def invoke():
+                results.append(inst.invoke_model("m", "predict", b"x", []))
+
+            t = threading.Thread(target=invoke, daemon=True)
+            t.start()
+            time.sleep(0.2)
+            assert not results  # parked on the load
+            t0 = time.perf_counter()
+            loader.load_gate.set()
+            t.join(timeout=5)
+            wake_ms = (time.perf_counter() - t0) * 1e3
+            assert results and results[0].payload == b"x"
+            # Event-driven wake: notification latency, not poll cadence.
+            # (Sizing is instantaneous here; generous bound for slow CI.)
+            assert wake_ms < 2_000
+        finally:
+            inst.shutdown()
+            kv.close()
+
+    def test_waiter_wakes_on_load_failure(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loader = GatedLoader()
+        loader.load_gate.clear()
+        loader.fail_loads = True
+        inst = _instance(kv, loader)
+        try:
+            inst.register_model("m", INFO)
+            errors = []
+
+            def invoke():
+                try:
+                    inst.invoke_model("m", "predict", b"x", [])
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            t = threading.Thread(target=invoke, daemon=True)
+            t.start()
+            time.sleep(0.2)
+            assert not errors
+            loader.load_gate.set()
+            t.join(timeout=5)
+            assert errors, "waiter never woke on _load_failed"
+        finally:
+            inst.shutdown()
+            kv.close()
+
+    def test_await_transition_unit(self):
+        ce = CacheEntry("m", INFO)
+        ce.state = EntryState.LOADING
+        woke = []
+
+        def wait():
+            woke.append(ce.await_transition(EntryState.LOADING, 5.0))
+
+        t = threading.Thread(target=wait, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        ce.fail("boom")
+        t.join(timeout=2)
+        assert woke == [EntryState.FAILED]
+        # A stale known-state returns immediately (no lost wakeup).
+        assert ce.await_transition(EntryState.LOADING, 5.0) is (
+            EntryState.FAILED
+        )
+
+
+class TestBatchMutate:
+    def test_multi_record_txn_and_extra_ops(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        table: KVTable[ModelRecord] = KVTable(kv, "t/registry", ModelRecord)
+        try:
+            table.put("a", ModelRecord(model_type="x"))
+            table.put("b", ModelRecord(model_type="y"))
+
+            def bump(cur):
+                cur.size_units = 7
+                return cur
+
+            def create(cur):
+                return cur or ModelRecord(model_type="z")
+
+            results = table.batch_mutate(
+                [("a", bump), ("b", bump), ("c", create)],
+                extra_ops=[Op("t/side", b"rode-along")],
+            )
+            assert results["a"].size_units == 7
+            assert table.get("b").size_units == 7
+            assert table.get("c").model_type == "z"
+            assert kv.get("t/side").value == b"rode-along"
+            # Versions refreshed in place (conditionalSetAndGet idiom):
+            # a follow-up CAS with the returned record must succeed.
+            table.conditional_set("a", results["a"])
+        finally:
+            kv.close()
+
+    def test_batch_retries_on_conflict_and_supports_delete(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        table: KVTable[ModelRecord] = KVTable(kv, "t/registry", ModelRecord)
+        try:
+            table.put("a", ModelRecord(model_type="x"))
+            calls = {"n": 0}
+
+            def contended(cur):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    # Interleave a conflicting write between the read and
+                    # the txn commit: the whole batch must retry.
+                    table.put("a", ModelRecord(model_type="stomp"))
+                cur.size_units = 3
+                return cur
+
+            out = table.batch_mutate([("a", contended)])
+            assert calls["n"] >= 2
+            assert out["a"].size_units == 3
+            assert table.get("a").size_units == 3
+
+            assert table.batch_mutate([("a", lambda cur: None)])["a"] is None
+            assert table.get("a") is None
+        finally:
+            kv.close()

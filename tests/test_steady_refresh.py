@@ -225,6 +225,62 @@ class TestDeltaSnapshots:
         # solve reading it during the pipelined overlap must not tear.
         np.testing.assert_array_equal(cols0.sizes, sizes0)
 
+    def test_watch_race_requeues_versioned_mark(self):
+        """The items()/_take_dirty race (ROADMAP open item): a record
+        mutation + versioned dirty mark landing AFTER the refresher
+        captured its list snapshot but BEFORE the refresh consumed the
+        marks is patched from the stale record — the mark must be
+        re-queued (not silently consumed) so the NEXT refresh repairs the
+        columns, instead of serving them stale for up to
+        MAX_DELTA_STREAK refreshes."""
+        import copy
+
+        from modelmesh_tpu.placement.jax_engine import JaxPlacementStrategy
+
+        models = _models(32)
+        instances = _instances(4)
+        for _, mr in models:
+            mr.version = 1
+        strat = JaxPlacementStrategy()
+        strat.refresh(models, instances)
+        # The refresher captures its items() snapshot...
+        stale = [(mid, copy.copy(mr)) for mid, mr in models]
+        # ...then the watch event lands: record replaced (KV version
+        # bump), its mark announcing the new version.
+        fresh = copy.copy(models[5][1])
+        fresh.size_units = 999
+        fresh.version = 2
+        models[5] = (models[5][0], fresh)
+        strat.mark_dirty(models=[("m5", 2)])
+        # This refresh consumes the mark against the STALE snapshot: the
+        # patched column keeps the old size (the mutation wasn't in the
+        # list), but the versioned mark survives.
+        plan = strat.refresh(stale, instances, incremental=True)
+        assert plan.stats["delta_snapshot"] is True
+        assert strat._snap_cache.cols.sizes[5] != 999
+        assert strat._dirty_models.get("m5") == 2
+        # The next refresh (fresh list) repairs the columns as a DELTA
+        # patch — no full rebuild needed.
+        plan2 = strat.refresh(models, instances, incremental=True)
+        assert plan2.stats["delta_snapshot"] is True
+        assert strat._snap_cache.cols.sizes[5] == 999
+        assert "m5" not in strat._dirty_models
+
+    def test_unversioned_marks_keep_legacy_semantics(self):
+        from modelmesh_tpu.placement.jax_engine import JaxPlacementStrategy
+
+        models = _models(16)
+        instances = _instances(4)
+        strat = JaxPlacementStrategy()
+        strat.refresh(models, instances)
+        models[3][1].size_units = 555
+        strat.mark_dirty(models=["m3"], instances=["i1"])
+        plan = strat.refresh(models, instances, incremental=True)
+        assert plan.stats["delta_snapshot"] is True
+        assert strat._snap_cache.cols.sizes[3] == 555
+        # Bare (version-0) marks are consumed unconditionally.
+        assert not strat._dirty_models and not strat._dirty_instances
+
     def test_strategy_delta_refresh_matches_full(self):
         from modelmesh_tpu.placement.jax_engine import JaxPlacementStrategy
 

@@ -33,10 +33,11 @@ lock (ModelCacheUnloadBufManager.java:51-54).
 from __future__ import annotations
 
 import heapq
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Generic, Iterator, Optional, TypeVar
+
+from modelmesh_tpu.utils.lockdebug import mm_rlock
 
 K = TypeVar("K")
 V = TypeVar("V")
@@ -68,19 +69,21 @@ class WeightedLRUCache(Generic[K, V]):
     ) -> None:
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
-        self._capacity = capacity
+        self._capacity = capacity  #: guarded-by: _lock
         self._listener = eviction_listener
-        self._entries: dict[K, _Entry[V]] = {}
+        self._entries: dict[K, _Entry[V]] = {}  #: guarded-by: _lock
+        #: guarded-by: _lock
         self._heap: list[tuple[int, int, K]] = []  # (last_used, seq, key)
-        self._weight = 0
-        self._seq = 0
-        self._lock = threading.RLock()
+        self._weight = 0  #: guarded-by: _lock
+        self._seq = 0  #: guarded-by: _lock
+        self._lock = mm_rlock("WeightedLRUCache._lock")
 
     # -- locking ----------------------------------------------------------
 
     @property
-    def eviction_lock(self) -> threading.RLock:
-        """The lock all mutation runs under; shared with unload accounting."""
+    def eviction_lock(self):
+        """The lock all mutation runs under (a ``threading.RLock``, or
+        its MM_LOCK_DEBUG wrapper); shared with unload accounting."""
         return self._lock
 
     # -- capacity ---------------------------------------------------------
@@ -92,7 +95,7 @@ class WeightedLRUCache(Generic[K, V]):
     def set_capacity(self, capacity: int) -> None:
         with self._lock:
             self._capacity = capacity
-            self._evict_over_capacity()
+            self._evict_over_capacity_locked()
 
     @property
     def weight(self) -> int:
@@ -132,7 +135,7 @@ class WeightedLRUCache(Generic[K, V]):
             self._entries[key] = entry
             self._weight += weight
             heapq.heappush(self._heap, (ts, entry.seq, key))
-            self._evict_over_capacity(exclude=key)
+            self._evict_over_capacity_locked(exclude=key)
             return None
 
     def get(self, key: K, touch_ts: Optional[int] = None) -> Optional[V]:
@@ -141,7 +144,7 @@ class WeightedLRUCache(Generic[K, V]):
             entry = self._entries.get(key)
             if entry is None:
                 return None
-            self._touch(key, entry, now_ms() if touch_ts is None else touch_ts)
+            self._touch_locked(key, entry, now_ms() if touch_ts is None else touch_ts)
             return entry.value
 
     def get_quietly(self, key: K) -> Optional[V]:
@@ -188,7 +191,7 @@ class WeightedLRUCache(Generic[K, V]):
             entry = self._entries.get(key)
             if entry is None:
                 return False
-            self._touch(key, entry, ts, force=True)
+            self._touch_locked(key, entry, ts, force=True)
             return True
 
     def oldest_time(self) -> Optional[int]:
@@ -218,7 +221,7 @@ class WeightedLRUCache(Generic[K, V]):
             entry.weight = new_weight
             self._weight += new_weight - old
             if new_weight > old:
-                self._evict_over_capacity(exclude=key)
+                self._evict_over_capacity_locked(exclude=key)
             return old
 
     def update_weight_if_value(
@@ -236,7 +239,7 @@ class WeightedLRUCache(Generic[K, V]):
             entry.weight = new_weight
             self._weight += new_weight - old
             if new_weight > old:
-                self._evict_over_capacity(exclude=key)
+                self._evict_over_capacity_locked(exclude=key)
             return True
 
     # -- iteration --------------------------------------------------------
@@ -268,16 +271,16 @@ class WeightedLRUCache(Generic[K, V]):
 
     # -- internals --------------------------------------------------------
 
-    def _touch(self, key: K, entry: _Entry[V], ts: int, force: bool = False) -> None:
+    def _touch_locked(self, key: K, entry: _Entry[V], ts: int, force: bool = False) -> None:
         if not force and ts <= entry.last_used:
             return  # never move an entry backwards on plain access
         entry.last_used = ts
         heapq.heappush(self._heap, (ts, entry.seq, key))
 
-    def _evict_over_capacity(self, exclude: Optional[K] = None) -> None:
+    def _evict_over_capacity_locked(self, exclude: Optional[K] = None) -> None:
         """Pop LRU entries until within capacity. Caller holds the lock."""
         while self._weight > self._capacity and self._entries:
-            victim = self._pop_lru(exclude)
+            victim = self._pop_lru_locked(exclude)
             if victim is None:
                 return  # only the excluded entry remains
             key, entry = victim
@@ -286,7 +289,7 @@ class WeightedLRUCache(Generic[K, V]):
             if self._listener is not None:
                 self._listener(key, entry.value, entry.last_used)
 
-    def _pop_lru(self, exclude: Optional[K]) -> Optional[tuple[K, _Entry[V]]]:
+    def _pop_lru_locked(self, exclude: Optional[K]) -> Optional[tuple[K, _Entry[V]]]:
         skipped: Optional[tuple[int, int, K]] = None
         while self._heap:
             ts, seq, key = heapq.heappop(self._heap)

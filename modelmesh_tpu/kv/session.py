@@ -16,10 +16,20 @@ import threading
 from typing import Callable, Optional
 
 from modelmesh_tpu.kv.store import EventType, KVStore
+from modelmesh_tpu.utils.lockdebug import mm_lock
 
 
 class SessionNode:
-    """Ephemeral key kept alive by a background keepalive thread."""
+    """Ephemeral key kept alive by a background keepalive thread.
+
+    ``_lock`` guards only the ``(_lease, _value)`` bookkeeping — every KV
+    round trip (lease grant, put, revoke) runs OUTSIDE it, so a slow or
+    wedged store can never convoy callers that only need the bookkeeping
+    (``publish_op`` riding someone else's txn, the keepalive probe).
+    Concurrent publishes converge through ``_establish``'s re-check loop:
+    whichever put lands last, the final republished value is the newest
+    ``_value``.
+    """
 
     def __init__(
         self,
@@ -31,13 +41,13 @@ class SessionNode:
     ):
         self.store = store
         self.key = key
-        self._value = value
+        self._value = value  #: guarded-by: _lock
         self.ttl_s = ttl_s
         self._interval = keepalive_interval_s or ttl_s / 3.0
-        self._lease: Optional[int] = None
+        self._lease: Optional[int] = None  #: guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = mm_lock("SessionNode._lock")
 
     def start(self) -> None:
         self._establish()
@@ -47,16 +57,74 @@ class SessionNode:
         self._thread.start()
 
     def _establish(self) -> None:
+        """Grant a fresh lease and publish the latest value (RPCs outside
+        ``_lock``). An ``update`` racing the republish is converged by the
+        re-check loop; a newer concurrent ``_establish`` supersedes us.
+        A ``close()`` racing the grant is caught by the ``_stop`` check
+        under ``_lock``: either close pops-and-revokes the installed
+        lease, or we see the stop flag and revoke the fresh grant
+        ourselves — the ephemeral can never outlive close until TTL."""
+        lease = self.store.lease_grant(self.ttl_s)
         with self._lock:
-            self._lease = self.store.lease_grant(self.ttl_s)
-            self.store.put(self.key, self._value, lease=self._lease)
+            if self._stop.is_set():
+                orphan = lease  # closed while the grant was in flight
+            else:
+                orphan = None
+                self._lease = lease
+                value = self._value
+        if orphan is not None:
+            try:
+                self.store.lease_revoke(orphan)
+            except Exception:  # noqa: BLE001 — TTL expiry backstops
+                pass
+            return
+        self._publish_latest(value, lease)
+
+    def _publish_latest(self, value: bytes, lease: int) -> None:
+        """Put + converge: if another publisher advanced ``_value`` (or a
+        re-establish swapped the lease) while our put was in flight,
+        republish until the final KV state carries the newest value under
+        the CURRENT lease. The lease re-check cannot simply return on
+        supersession: the new lease's republish may have already landed
+        BEFORE our stale put, which then rebound the ephemeral to the
+        dying old lease — the repair must re-put under the live one.
+        After ``close()`` (lease None) the loop stops: close revokes the
+        lease it popped, and any ephemeral a stale put rebound to an
+        older lease dies with that lease's TTL."""
+        while True:
+            self.store.put(self.key, value, lease=lease)
+            with self._lock:
+                if self._lease is None:
+                    return  # closed
+                if self._lease != lease:
+                    # Superseded mid-put: repair under the current lease.
+                    lease = self._lease
+                    value = self._value
+                    continue
+                if self._value is value:
+                    return
+                value = self._value  # a publisher raced the put: redo
 
     def update(self, value: bytes) -> None:
-        """Republish the node's value (instance record refresh)."""
+        """Republish the node's value (instance record refresh). The put
+        runs outside ``_lock`` so a slow KV round trip cannot block
+        ``publish_op``/keepalive bookkeeping on the same node. A put that
+        fails because the lease was revoked/replaced mid-flight (close()
+        or a keepalive re-establish won the race) is swallowed — the new
+        lease's establish republishes the latest ``_value``, and after
+        close there is deliberately nothing to publish."""
         with self._lock:
             self._value = value
-            if self._lease is not None:
-                self.store.put(self.key, value, lease=self._lease)
+            lease = self._lease
+        if lease is None:
+            return
+        try:
+            self._publish_latest(value, lease)
+        except Exception:
+            with self._lock:
+                still_ours = self._lease == lease
+            if still_ours:
+                raise
 
     def publish_op(self, value: bytes):
         """An ``Op`` updating this node, for riding someone else's txn
@@ -88,12 +156,12 @@ class SessionNode:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
         with self._lock:
-            if self._lease is not None:
-                try:
-                    self.store.lease_revoke(self._lease)
-                except Exception:
-                    pass
-                self._lease = None
+            lease, self._lease = self._lease, None
+        if lease is not None:
+            try:
+                self.store.lease_revoke(lease)
+            except Exception:
+                pass
 
 
 class LeaderElection:
@@ -121,8 +189,8 @@ class LeaderElection:
         self._node = SessionNode(
             store, prefix + candidate_id, candidate_id.encode(), ttl_s=ttl_s
         )
-        self._is_leader = False
-        self._lock = threading.Lock()
+        self._is_leader = False  #: guarded-by: _lock
+        self._lock = mm_lock("LeaderElection._lock")
         self._watch = None
 
     @property

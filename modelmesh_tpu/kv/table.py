@@ -33,6 +33,7 @@ from modelmesh_tpu.kv.store import (
     Op,
     WatchEvent,
 )
+from modelmesh_tpu.utils.lockdebug import mm_rlock
 
 R = TypeVar("R", bound="Record")
 
@@ -277,24 +278,42 @@ class TableView(Generic[R]):
 
     def __init__(self, table: KVTable[R]):
         self.table = table
-        self._cache: dict[str, R] = {}
-        self._lock = threading.RLock()
+        self._cache: dict[str, R] = {}  #: guarded-by: _lock
+        self._lock = mm_rlock("TableView._lock")
         self._listeners: list[TableListener] = []
         self._ready = threading.Event()
         # Monotone view version: bumped on every APPLIED change (stale
         # watch replays don't count). Readers key derived snapshots on it
         # (ModelMeshInstance caches its ClusterView per epoch) so the
         # request hot path copies the table only when it actually moved.
-        self._epoch = 0
+        self._epoch = 0  #: guarded-by: _lock
+        # Deletions applied by the watch before the initial seed lands;
+        # the seed must not resurrect them from its older listing. None
+        # once seeding completed (the common steady state).
+        #: guarded-by: _lock
+        self._seed_tombstones: Optional[set[str]] = set()
         # Subscribe from revision 0 so pre-existing records replay as events.
         self._watch = table.store.watch(
             table.prefix, self._on_events, start_rev=0
         )
         # Seed synchronously for immediate availability; watch replay will
-        # redeliver, which _apply treats idempotently by mod version.
+        # redeliver, which _apply treats idempotently by mod version. The
+        # paged table scan runs OUTSIDE _lock (blocking-under-lock: the
+        # watch dispatcher must never convoy behind an O(table) KV scan),
+        # so a watch event may be APPLIED before the seed lands — the
+        # seed installs version-gated (never clobbering a newer
+        # watch-applied record with the stale listing) and skips keys the
+        # watch already deleted (_seed_tombstones).
+        seed = list(table.items())
         with self._lock:
-            for id_, rec in table.items():
-                self._cache[id_] = rec
+            tombstones = self._seed_tombstones or ()
+            for id_, rec in seed:
+                if id_ in tombstones:
+                    continue
+                prev = self._cache.get(id_)
+                if prev is None or rec.version > prev.version:
+                    self._cache[id_] = rec
+            self._seed_tombstones = None
             self._epoch += 1
         self._ready.set()
 
@@ -307,6 +326,8 @@ class TableView(Generic[R]):
             with self._lock:
                 if ev.type is EventType.DELETE:
                     existed = self._cache.pop(id_, None)
+                    if self._seed_tombstones is not None:
+                        self._seed_tombstones.add(id_)
                     event = TableEvent.DELETED if existed is not None else None
                     rec = None
                 else:

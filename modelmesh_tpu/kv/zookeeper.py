@@ -82,6 +82,7 @@ from modelmesh_tpu.kv.store import (
     WatchEvent,
     WatchHandle,
 )
+from modelmesh_tpu.utils.lockdebug import mm_lock, mm_rlock
 
 log = logging.getLogger("modelmesh_tpu.kv.zookeeper")
 
@@ -141,12 +142,13 @@ class _ZkSession:
             except (OSError, ValueError) as e:
                 self._sock.close()
                 raise ZkSessionLost(f"zk TLS handshake failed: {e}") from e
-        self._send_lock = threading.Lock()
-        self._xid = 0
-        self._xid_lock = threading.Lock()
+        self._send_lock = mm_lock("_ZkSession._send_lock")
+        self._xid = 0  #: guarded-by: _xid_lock
+        self._xid_lock = mm_lock("_ZkSession._xid_lock")
+        #: guarded-by: _pending_lock
         self._pending: dict[int, list] = {}   # xid -> [event, reply|None]
-        self._pending_lock = threading.Lock()
-        self._ping_waiters: list[threading.Event] = []
+        self._pending_lock = mm_lock("_ZkSession._pending_lock")
+        self._ping_waiters: list[threading.Event] = []  #: guarded-by: _pending_lock
         self.dead = threading.Event()
         self.watch_events: "queue.Queue[jute.WatcherEvent]" = queue.Queue()
         self.last_zxid = 0
@@ -324,6 +326,11 @@ class ZookeeperKV(KVStore):
         self._ssl_hostname = (
             tls.server_hostname(host) if tls is not None else None
         )
+        # Rebinds are guarded (_reconnect swap); lock-free READS are the
+        # design — data-plane threads grab a reference and race the swap
+        # benignly (a dead session surfaces as ZkSessionLost and retries
+        # through _reconnect).
+        #: guarded-by: _session_lock [rebind]
         self._session = _ZkSession(endpoint, session_timeout_ms,
                                    auto_ping=True, ssl_ctx=self._ssl_ctx,
                                    ssl_hostname=self._ssl_hostname)
@@ -331,18 +338,26 @@ class ZookeeperKV(KVStore):
         # Guards the session swap ONLY. Lock order: never hold
         # _session_lock while taking _watch_lock (the dispatcher holds
         # _watch_lock and may need _session_lock to reconnect).
-        self._session_lock = threading.Lock()
-        self._leases: dict[int, _ZkSession] = {}
-        self._leases_lock = threading.Lock()
-        self._watches: list[_PrefixWatch] = []
+        self._session_lock = mm_lock("ZookeeperKV._session_lock")
+        # Serializes RECONNECTORS (held across the replacement connect):
+        # N threads hitting ZkSessionLost on one blip cost one handshake
+        # + one server-side session, not N. Probing/swapping threads
+        # still only ever touch _session_lock, so nothing convoys on a
+        # wedged connect except other reconnectors — who would otherwise
+        # be connecting themselves.
+        self._reconnect_lock = mm_lock("ZookeeperKV._reconnect_lock")
+        self._leases: dict[int, _ZkSession] = {}  #: guarded-by: _leases_lock
+        self._leases_lock = mm_lock("ZookeeperKV._leases_lock")
+        self._watches: list[_PrefixWatch] = []  #: guarded-by: _watch_lock
         # RLock: _sync_mirror_locked emits diffs via _deliver while the
         # mirror lock is held (same thread).
-        self._watch_lock = threading.RLock()
-        self._mirror: dict[str, KeyValue] = {}
-        self._mirror_ready = False
+        self._watch_lock = mm_rlock("ZookeeperKV._watch_lock")
+        self._mirror: dict[str, KeyValue] = {}  #: guarded-by: _watch_lock
+        self._mirror_ready = False  #: guarded-by: _watch_lock
         # The session whose one-shot watches currently back the mirror;
         # the dispatcher resyncs whenever the live session differs (a
         # data-plane _req may swap sessions without arming any watches).
+        #: guarded-by: _watch_lock
         self._mirror_session: Optional[_ZkSession] = None
         self._dispatcher: Optional[threading.Thread] = None
         self._idle = threading.Event()
@@ -352,20 +367,40 @@ class ZookeeperKV(KVStore):
 
     def _reconnect(self, failed: _ZkSession) -> _ZkSession:
         """Replace a dead main session with a fresh one (the ZK client's
-        expired-session re-establishment). Watch state heals separately:
-        the caller (or dispatcher) runs a mirror resync AFTER the swap —
-        never while holding _session_lock."""
+        expired-session re-establishment). The replacement connect +
+        handshake runs OUTSIDE _session_lock: a wedged endpoint must not
+        pin the swap lock for the whole connect timeout (every other
+        thread probing the session would convoy behind it) — that lock
+        guards only the probe and the swap. Reconnectors serialize on
+        _reconnect_lock instead, so a blip that kicks N threads into
+        _reconnect performs ONE handshake: the winner connects and
+        swaps, the waiters re-probe and adopt its session. Watch state
+        heals separately: the caller (or dispatcher) runs a mirror
+        resync AFTER the swap — never while holding _session_lock."""
         if self._closed.is_set():
             raise ZkSessionLost("store is closed")
-        with self._session_lock:
-            cur = self._session
-            if cur is not failed and not cur.dead.is_set():
-                return cur  # another thread already reconnected
-            fresh = _ZkSession(
+        with self._reconnect_lock:
+            with self._session_lock:
+                cur = self._session
+                if cur is not failed and not cur.dead.is_set():
+                    return cur  # an earlier reconnector already swapped
+            fresh = _ZkSession(  # analysis-ok: blocking-under-lock — _reconnect_lock exists to serialize exactly this connect; only reconnectors (who would otherwise connect themselves) ever wait on it
                 self._endpoint, self._session_timeout_ms, auto_ping=True,
                 ssl_ctx=self._ssl_ctx, ssl_hostname=self._ssl_hostname,
             )
-            self._session = fresh
+            with self._session_lock:
+                # Re-check closed at swap time: the connect window is the
+                # full handshake timeout, and a close() landing inside it
+                # has already closed self._session — installing fresh
+                # would leak a live socket + pinger thread past close.
+                if self._closed.is_set():
+                    winner = None
+                else:
+                    self._session = fresh
+                    winner = fresh
+        if winner is None:
+            fresh.close(clean=True)
+            raise ZkSessionLost("store is closed")
         log.info("zk session re-established (%s)", hex(fresh.session_id))
         return fresh
 

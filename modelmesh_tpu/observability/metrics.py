@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Optional, Sequence
 
+from modelmesh_tpu.utils.lockdebug import mm_lock
+
 log = logging.getLogger(__name__)
 
 
@@ -122,9 +124,9 @@ class _MetricStripe:
     __slots__ = ("lock", "counters", "hists")
 
     def __init__(self):
-        self.lock = threading.Lock()
-        self.counters: dict[tuple[str, str], float] = {}
-        self.hists: dict[tuple[str, str], _Histogram] = {}
+        self.lock = mm_lock("_MetricStripe.lock")
+        self.counters: dict[tuple[str, str], float] = {}  #: guarded-by: lock
+        self.hists: dict[tuple[str, str], _Histogram] = {}  #: guarded-by: lock
 
 
 # Stripes for the request-path recording locks. 8 comfortably separates
@@ -154,9 +156,9 @@ class PrometheusMetrics(Metrics):
         instance_id: str = "",
         start_server: bool = True,
     ):
-        self._lock = threading.Lock()  # gauges + server lifecycle (rare)
+        self._lock = mm_lock("PrometheusMetrics._lock")  # gauges (rare)
         self._stripes = [_MetricStripe() for _ in range(_N_STRIPES)]
-        self._gauges: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}  #: guarded-by: _lock
         self.per_model = per_model
         self.instance_id = instance_id
         self.port = 0

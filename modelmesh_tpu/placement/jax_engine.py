@@ -35,6 +35,7 @@ from modelmesh_tpu.placement.strategy import (
     PlacementStrategy,
 )
 from modelmesh_tpu.records import InstanceRecord, ModelRecord
+from modelmesh_tpu.utils.lockdebug import mm_lock
 
 log = logging.getLogger(__name__)
 
@@ -1134,33 +1135,35 @@ class JaxPlacementStrategy(PlacementStrategy):
         # auction's carried prices and its Gumbel draw are a matched pair,
         # so incremental refreshes freeze the noise epoch (see refresh())
         # and the seed rotates only on full rebuilds.
-        self._generation = 0
-        self._seed = 0
-        self._refresh_lock = threading.Lock()
+        self._generation = 0  #: guarded-by: _refresh_lock
+        self._seed = 0  #: guarded-by: _refresh_lock
+        self._refresh_lock = mm_lock("JaxPlacementStrategy._refresh_lock")
         # Column-potential / price carries across refreshes (solve_plan
         # warm_g / warm_price).
+        #: guarded-by: _refresh_lock
         self._warm_g: Optional[dict[str, float]] = None
+        #: guarded-by: _refresh_lock
         self._warm_price: Optional[dict[str, float]] = None
         # Delta-snapshot state: the cached columns plus the dirty marks
         # accumulated since the last refresh (mark_dirty, watch-fed).
         # Marks map id -> highest record version announced (0 = version
         # unknown); the version lets a refresh detect marks whose
         # mutation is NEWER than the list snapshot it is patching from
-        # and re-queue them (see _requeue_stale_marks). _dirty_lock is
+        # and re-queue them (see _requeue_stale_marks_locked). _dirty_lock is
         # separate from _refresh_lock so event threads never block behind
         # a multi-hundred-ms solve.
-        self._snap_cache: Optional[SnapshotCache] = None
-        self._dirty_lock = threading.Lock()
-        self._dirty_models: dict = {}
-        self._dirty_instances: dict = {}
+        self._snap_cache: Optional[SnapshotCache] = None  #: guarded-by: _refresh_lock
+        self._dirty_lock = mm_lock("JaxPlacementStrategy._dirty_lock")
+        self._dirty_models: dict = {}  #: guarded-by: _dirty_lock
+        self._dirty_instances: dict = {}  #: guarded-by: _dirty_lock
         # Consecutive delta refreshes since the last full rebuild. Under
         # perpetual small churn the dirty fraction never trips the patch
         # fallback, so without a cap the frozen noise epoch would freeze
-        # an unlucky Gumbel draw FOREVER — _build_cols forces a rebuild
+        # an unlucky Gumbel draw FOREVER — _build_cols_locked forces a rebuild
         # (and thus a seed rotation) every MAX_DELTA_STREAK deltas, which
         # also bounds how long an unmarked-dirty record can serve stale
         # columns.
-        self._delta_streak = 0
+        self._delta_streak = 0  #: guarded-by: _refresh_lock
 
     @property
     def plan(self) -> Optional[GlobalPlan]:
@@ -1181,7 +1184,7 @@ class JaxPlacementStrategy(PlacementStrategy):
         consumes it is patching from a list snapshot OLDER than the
         marked version (the caller's ``items()`` read happened before the
         mutation landed), the mark is re-queued instead of silently
-        consumed — see ``_requeue_stale_marks``. Bare ids keep the
+        consumed — see ``_requeue_stale_marks_locked``. Bare ids keep the
         original best-effort semantics."""
         with self._dirty_lock:
             for entry in models:
@@ -1199,7 +1202,7 @@ class JaxPlacementStrategy(PlacementStrategy):
             self._dirty_models, self._dirty_instances = {}, {}
             return dm, di
 
-    def _requeue_stale_marks(self, dm, di, models, instances) -> None:
+    def _requeue_stale_marks_locked(self, dm, di, models, instances) -> None:
         """Re-queue consumed marks whose record version is NEWER than the
         snapshot just applied: a watch event that landed between the
         refresher's ``items()`` read and ``_take_dirty`` was patched (or
@@ -1224,7 +1227,7 @@ class JaxPlacementStrategy(PlacementStrategy):
         if stale_m or stale_i:
             self.mark_dirty(stale_m, stale_i)
 
-    def _build_cols(self, models, instances, rpm_fn, incremental: bool):
+    def _build_cols_locked(self, models, instances, rpm_fn, incremental: bool):
         """Delta-patch the cached snapshot when allowed, else rebuild (and
         re-prime the cache). Returns (cols, was_delta)."""
         dm, di = self._take_dirty()
@@ -1239,7 +1242,7 @@ class JaxPlacementStrategy(PlacementStrategy):
             )
             if cols is not None:
                 self._delta_streak += 1
-                self._requeue_stale_marks(dm, di, models, instances)
+                self._requeue_stale_marks_locked(dm, di, models, instances)
                 return cols, True
         cols, self._snap_cache = snapshot_columns(
             models, instances, rpm_fn, constraints=self.constraints,
@@ -1248,10 +1251,10 @@ class JaxPlacementStrategy(PlacementStrategy):
         self._delta_streak = 0
         # A rebuild from a stale list has the same race: keep marks whose
         # mutation the rebuilt snapshot provably hasn't seen.
-        self._requeue_stale_marks(dm, di, models, instances)
+        self._requeue_stale_marks_locked(dm, di, models, instances)
         return cols, False
 
-    def _epoch_carries(self, delta: bool):
+    def _epoch_carries_locked(self, delta: bool):
         """Noise-epoch discipline, shared by the blocking ``refresh`` and
         ``PipelinedRefresher.submit`` so the matched-pair rules cannot
         fork: a delta refresh KEEPS the Gumbel seed and may warm-start
@@ -1283,16 +1286,16 @@ class JaxPlacementStrategy(PlacementStrategy):
             delta = None
             if models and instances:
                 t0 = time.perf_counter()
-                cols, delta = self._build_cols(
+                cols, delta = self._build_cols_locked(
                     models, instances, rpm_fn, incremental
                 )
-                # Noise-epoch discipline (_epoch_carries): a frozen draw
+                # Noise-epoch discipline (_epoch_carries_locked): a frozen draw
                 # keeps the warm prices valid AND the plan stable under
                 # small churn — fewer gratuitous model moves. An unlucky
                 # draw is never frozen forever: full rebuilds rotate it,
-                # and _build_cols forces one every MAX_DELTA_STREAK
+                # and _build_cols_locked forces one every MAX_DELTA_STREAK
                 # consecutive deltas even under perpetual small churn.
-                warm_g, warm_price = self._epoch_carries(delta)
+                warm_g, warm_price = self._epoch_carries_locked(delta)
                 plan = finalize_plan(dispatch_solve(
                     cols, seed=self._seed, mesh=self.mesh,
                     warm_g=warm_g, warm_price=warm_price,

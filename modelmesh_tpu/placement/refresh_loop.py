@@ -101,7 +101,7 @@ class PipelinedRefresher:
             return self.drain()
         with strat._refresh_lock:
             t0 = time.perf_counter()
-            cols, delta = strat._build_cols(
+            cols, delta = strat._build_cols_locked(
                 models, instances, rpm_fn, incremental
             )
             prev = self._inflight
@@ -130,9 +130,9 @@ class PipelinedRefresher:
                     donated = self._donate
             # Shared noise-epoch discipline (delta keeps the seed + may
             # warm prices; full rebuild rotates + drops prices) — see
-            # JaxPlacementStrategy._epoch_carries. The device chain,
+            # JaxPlacementStrategy._epoch_carries_locked. The device chain,
             # when taken, supersedes the id-keyed dicts entirely.
-            warm_g, warm_price = strat._epoch_carries(delta)
+            warm_g, warm_price = strat._epoch_carries_locked(delta)
             strat._generation += 1
             pending = dispatch_solve(
                 cols, seed=strat._seed, mesh=strat.mesh,
@@ -145,7 +145,7 @@ class PipelinedRefresher:
                 pending, strat._generation, delta, strat._seed
             )
             self._carry_iids = cols.instance_ids
-            plan = self._finalize_install(prev, consumed=donated) if prev else None
+            plan = self._finalize_install_locked(prev, consumed=donated) if prev else None
         return plan
 
     def drain(self) -> Optional[GlobalPlan]:
@@ -158,14 +158,14 @@ class PipelinedRefresher:
                 return strat._plan
             # An in-flight solve's own carry buffers are only ever donated
             # by a LATER dispatch consuming them; at drain there is none.
-            out = self._finalize_install(prev, consumed=False)
+            out = self._finalize_install_locked(prev, consumed=False)
             # A superseded flight finalizes to None — the freshest
             # installed plan is still the right thing to hand back.
             return out if out is not None else strat._plan
 
     # -- internals ----------------------------------------------------------
 
-    def _finalize_install(
+    def _finalize_install_locked(
         self, flight: _InFlight, consumed: bool
     ) -> Optional[GlobalPlan]:
         """Block on solve N-1, pack the plan, install it atomically.

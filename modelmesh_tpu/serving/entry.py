@@ -31,6 +31,7 @@ from modelmesh_tpu.runtime.spi import (
     ModelLoader,
     ModelLoadException,
 )
+from modelmesh_tpu.utils.lockdebug import mm_condition, mm_lock
 
 log = logging.getLogger(__name__)
 
@@ -77,24 +78,24 @@ class CacheEntry:
         self.info = info
         self.weight_units = weight_units
         self.last_used = last_used if last_used is not None else now_ms()
-        self.state = EntryState.NEW
-        self.error: Optional[str] = None
+        self.state = EntryState.NEW  #: guarded-by: _lock [rebind]
+        self.error: Optional[str] = None  #: guarded-by: _lock
         self.loaded: Optional[LoadedModel] = None
         self.queued_ms: Optional[int] = None
         self.load_started_ms: Optional[int] = None
         self.load_completed_ms: Optional[int] = None
-        self._lock = threading.Lock()
+        self._lock = mm_lock("CacheEntry._lock")
         self._done = threading.Event()
         # Broadcast on EVERY state transition (not just terminal ones):
         # load waiters sleep on this instead of polling, waking exactly
         # when the entry moves — activation, failure, removal, or an
         # intermediate phase change that re-bases their timeout budget
         # (QUEUED -> LOADING starts the per-type load clock).
-        self._state_cv = threading.Condition(self._lock)
-        self._sem: Optional[threading.Semaphore] = None
+        self._state_cv = mm_condition("CacheEntry._state_cv", self._lock)
+        self._sem: Optional[threading.Semaphore] = None  #: guarded-by: _lock
         self.max_concurrency = 0
-        self.inflight = 0
-        self.total_invocations = 0
+        self.inflight = 0  #: guarded-by: _lock
+        self.total_invocations = 0  #: guarded-by: _lock
         # EWMA of invocation latency (ms); drives the latency-based
         # autoscaling threshold (reference MaxConcCacheEntry bandwidth
         # estimate, ModelMesh.java:2641-2797).
@@ -127,7 +128,7 @@ class CacheEntry:
 
     # -- state ------------------------------------------------------------
 
-    def _transition(self, new: EntryState) -> None:
+    def _transition_locked(self, new: EntryState) -> None:
         self.state = new
         if new.is_terminal:
             self._done.set()
@@ -155,7 +156,7 @@ class CacheEntry:
             if loaded.max_concurrency:
                 self.max_concurrency = loaded.max_concurrency
                 self._sem = threading.Semaphore(loaded.max_concurrency)
-            self._transition(EntryState.ACTIVE)
+            self._transition_locked(EntryState.ACTIVE)
             return True
 
     def fail(self, message: str) -> None:
@@ -163,11 +164,11 @@ class CacheEntry:
             if self.state.is_terminal:
                 return
             self.error = message
-            self._transition(EntryState.FAILED)
+            self._transition_locked(EntryState.FAILED)
 
     def remove(self) -> None:
         with self._lock:
-            self._transition(EntryState.REMOVED)
+            self._transition_locked(EntryState.REMOVED)
 
     def wait_active(self, timeout_s: float) -> bool:
         """True if ACTIVE within the timeout; False on timeout. Raises
@@ -238,10 +239,11 @@ class PrioritizedLoadingPool:
     """
 
     def __init__(self, concurrency: int = 8, name: str = "loader"):
+        #: guarded-by: _cv
         self._heap: list[tuple[tuple, int, Callable[[], None]]] = []
-        self._cv = threading.Condition()
-        self._seq = 0
-        self._shutdown = False
+        self._cv = mm_condition("PrioritizedLoadingPool._cv")
+        self._seq = 0  #: guarded-by: _cv
+        self._shutdown = False  #: guarded-by: _cv
         self._threads = [
             threading.Thread(target=self._run, name=f"{name}-{i}", daemon=True)
             for i in range(concurrency)
@@ -306,8 +308,8 @@ class UnloadTracker:
 
     def __init__(self, capacity_units: int):
         self.capacity_units = capacity_units
-        self._pending_units = 0
-        self._cv = threading.Condition()
+        self._pending_units = 0  #: guarded-by: _cv
+        self._cv = mm_condition("UnloadTracker._cv")
 
     @property
     def pending_units(self) -> int:

@@ -71,6 +71,7 @@ from modelmesh_tpu.observability.metrics import Metric as MX
 from modelmesh_tpu.observability.tracing import outgoing_headers
 from modelmesh_tpu.serving.rate import RateTracker
 from modelmesh_tpu.serving.route_cache import RouteCache
+from modelmesh_tpu.utils.lockdebug import mm_lock
 from modelmesh_tpu.utils.pool import BoundedDaemonPool
 
 log = logging.getLogger(__name__)
@@ -312,8 +313,9 @@ class ModelMeshInstance:
         self._cleanup_pool = BoundedDaemonPool(max_workers=4, name="del-clean")
         self._unload_pool = BoundedDaemonPool(max_workers=4, name="unloads")
         self.rate = RateTracker()
+        #: guarded-by: _model_rates_lock
         self._model_rates: dict[str, RateTracker] = {}
-        self._model_rates_lock = threading.Lock()
+        self._model_rates_lock = mm_lock("ModelMeshInstance._model_rates_lock")
         # model_id -> failfast-until timestamp (KV-outage sentinels).
         self._kv_failfast: dict[str, int] = {}
         # Request-path fast path: the epoch-keyed ClusterView snapshot
@@ -338,7 +340,9 @@ class ModelMeshInstance:
 
         # Cached self-advertisement, reused as the cluster-view fallback
         # until our published record round-trips through the watch —
-        # refreshed only on publish, not rebuilt per request.
+        # refreshed only on publish, not rebuilt per request. Rebinds are
+        # guarded; lock-free reads (cluster_view) see old-or-new whole.
+        #: guarded-by: _publish_lock [rebind]
         self._self_record = self._build_instance_record()
         self._session = SessionNode(
             store,
@@ -360,13 +364,19 @@ class ModelMeshInstance:
             from modelmesh_tpu.placement.plan_sync import PlanFollower
 
             self._plan_follower = PlanFollower(store, prefix, self.strategy)
-        self._publish_lock = threading.Lock()
+        self._publish_lock = mm_lock("ModelMeshInstance._publish_lock")
+        # Serializes standalone advertisement puts in BUILD order (see
+        # _publish_now). Only publishers ever take it — never the load
+        # or request paths — so a wedged KV round trip convoys at most
+        # other publishers, exactly like the pre-fast-path behavior.
+        self._publish_io_lock = mm_lock("ModelMeshInstance._publish_io_lock")
+        #: guarded-by: _publish_lock
         self._last_published: Optional[InstanceRecord] = None
         # Publish coalescer state (trailing-flush window; see
         # publish_instance_record).
-        self._coalesce_lock = threading.Lock()
-        self._publish_timer: Optional[threading.Timer] = None
-        self._shutdown_publishes = False
+        self._coalesce_lock = mm_lock("ModelMeshInstance._coalesce_lock")
+        self._publish_timer: Optional[threading.Timer] = None  #: guarded-by: _coalesce_lock
+        self._shutdown_publishes = False  #: guarded-by: _coalesce_lock
         # Watch-driven deletion cleanup (reference registers a registry
         # listener at ModelMesh.java:629; the deletion handler at :2807
         # removes local copies at :2814): when a model is unregistered
@@ -551,22 +561,56 @@ class ModelMeshInstance:
         return rec
 
     def _publish_now(self, force: bool = False) -> None:
-        with self._publish_lock:
-            prev = self._last_published
-            rec = self._build_publish_record_locked()
-            if not force and prev is not None:
-                same = (
-                    prev.model_count == rec.model_count
-                    and abs(prev.used_units - rec.used_units) < 8
-                    and prev.shutting_down == rec.shutting_down
-                    and abs(prev.req_per_minute - rec.req_per_minute)
-                    < max(10, prev.req_per_minute // 10)
-                )
-                if same:
-                    return
-            self._session.update(rec.to_bytes())
-            self._last_published = rec
+        # The KV put runs OUTSIDE _publish_lock (the PR-3 promote-txn
+        # rule generalized): a slow advertisement round trip must not
+        # convoy load completions (_promote_loaded's bookkeeping) or the
+        # record-build fast path on that lock — it guards only the
+        # suppression/self-record bookkeeping. Publishers instead
+        # serialize with EACH OTHER on _publish_io_lock, taken BEFORE the
+        # build: build order == put order == install order, so the final
+        # KV state and the _last_published suppression reference always
+        # carry the newest build (two racing publishers can never commit
+        # out of order and then suppress the repair forever).
+        with self._publish_io_lock:
+            with self._publish_lock:
+                prev = self._last_published
+                rec = self._build_publish_record_locked()
+                if not force and prev is not None and self._adverts_close(
+                    prev, rec
+                ):
+                    # Suppression cross-check: _promote_loaded's
+                    # piggybacked publish commits OUTSIDE the io lock
+                    # (its txn must never convoy on a wedged
+                    # advertisement put), so an interleave can leave the
+                    # committed KV record older than _last_published.
+                    # Before suppressing, verify the advertisement the
+                    # cluster actually sees (watch-fed self record)
+                    # matches too — if it diverged, OR the record is
+                    # gone entirely (an expired/deleted ephemeral the
+                    # watch reported), publish to repair instead of
+                    # suppressing the repair forever. `seen is None`
+                    # before the first publish round-trips the watch
+                    # just costs a redundant put in a tiny window.
+                    seen = self.instances_view.get(self.instance_id)
+                    if seen is not None and self._adverts_close(seen, rec):
+                        return
+            self._session.update(rec.to_bytes())  # analysis-ok: blocking-under-lock — _publish_io_lock exists to serialize advertisement puts in build order; only publishers take it, never the load/request path
+            with self._publish_lock:
+                self._last_published = rec
         self._publish_gauges()
+
+    @staticmethod
+    def _adverts_close(prev: InstanceRecord, rec: InstanceRecord) -> bool:
+        """Change-suppression equivalence for two advertisements
+        (reference ModelMesh.java:5440-5468): no material movement in
+        the fields placement decisions read."""
+        return (
+            prev.model_count == rec.model_count
+            and abs(prev.used_units - rec.used_units) < 8
+            and prev.shutting_down == rec.shutting_down
+            and abs(prev.req_per_minute - rec.req_per_minute)
+            < max(10, prev.req_per_minute // 10)
+        )
 
     def _publish_gauges(self) -> None:
         self.metrics.set_gauge(MX.MODELS_LOADED, len(self.cache))
@@ -1252,8 +1296,15 @@ class ModelMeshInstance:
             ce.remove()
             raise
 
-        ce.state = EntryState.QUEUED
         ce.queued_ms = now_ms()
+        # Guarded transition, NOT a bare state write: a registry-deletion
+        # cleanup racing this insert can have already REMOVED the entry
+        # (remove_if_value succeeded between put_if_absent and here), and
+        # clobbering REMOVED -> QUEUED would let _run_load load and
+        # re-promote a model that was just unregistered. On failure the
+        # submit below is harmless: _run_load's own guarded transitions
+        # abandon a terminal entry immediately.
+        ce.try_transition(EntryState.QUEUED)
         urgent = ctx.hop != RoutingContext.INTERNAL
         self.loading_pool.submit(
             lambda: self._run_load(ce), urgent=urgent, last_used=last_used

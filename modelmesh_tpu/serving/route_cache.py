@@ -31,10 +31,10 @@ Knobs (utils/envs.py): ``MM_ROUTE_CACHE`` (default on) and
 
 from __future__ import annotations
 
-import threading
 from typing import Optional
 
 from modelmesh_tpu.cache.lru import now_ms
+from modelmesh_tpu.utils.lockdebug import mm_lock
 
 DEFAULT_TTL_MS = 1_000
 # Distinct model ids cached before a wholesale reset; a cache, not a
@@ -73,8 +73,12 @@ class RouteCache:
         self.max_models = max_models
         # model_id -> {exclusion_sig: (target, record_version, view_epoch,
         #                              clock_bucket)}
+        # [rebind]: inner-map writes are deliberately lock-free (GIL-
+        # atomic dict ops; validity is carried in the entry) — only the
+        # wholesale resets rebind the dict, and those are guarded.
+        #: guarded-by: _lock [rebind]
         self._by_model: dict[str, dict[frozenset, tuple]] = {}
-        self._lock = threading.Lock()
+        self._lock = mm_lock("RouteCache._lock")
         # Plain-int stats (racy under contention, monotone enough for
         # bench/diagnostics — not billing).
         self.hits = 0

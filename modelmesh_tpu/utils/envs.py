@@ -106,6 +106,14 @@ REGISTRY: dict[str, EnvVar] = {
                "route-cache warming-clock bucket width: bounds how long a "
                "time-dependent (warming/ride-the-load) routing decision "
                "can be served from cache", "serving/route_cache.py"),
+        EnvVar("MM_LOCK_DEBUG", "bool", "0",
+               "instrumented Lock/Condition wrappers: record per-thread "
+               "acquisition stacks and assert lock-acquisition order "
+               "against the witness graph derived by tools/analysis "
+               "(raises LockOrderViolation with a held-locks dump on an "
+               "inversion); read at lock CREATION time — set it before "
+               "constructing instances. Debug/test aid, not for "
+               "production", "utils/lockdebug.py"),
         EnvVar("MM_KV_READ_ONLY", "int", "0",
                "KV-migration read-only mode: block model add/remove, "
                "suppress reaper pruning", "serving/instance.py"),

@@ -38,6 +38,15 @@ from modelmesh_tpu.serving.instance import (
 INFO = ModelInfo(model_type="pipe", model_path="mem://pipe")
 
 
+@pytest.fixture(autouse=True)
+def _lock_debug(monkeypatch):
+    """MM_LOCK_DEBUG=1: every lock the lifecycle paths create in these
+    tests is the instrumented wrapper (utils/lockdebug.py) — a lock-
+    acquisition-order inversion anywhere in the load/evict/publish races
+    exercised here fails the test with a held-locks dump."""
+    monkeypatch.setenv("MM_LOCK_DEBUG", "1")
+
+
 class GatedLoader(ModelLoader):
     """Loads/sizes gated on events so tests can park a load mid-stage."""
 
@@ -445,4 +454,57 @@ class TestBatchMutate:
             assert table.batch_mutate([("a", lambda cur: None)])["a"] is None
             assert table.get("a") is None
         finally:
+            kv.close()
+
+
+class TestQueuedTransitionGuard:
+    def test_removal_racing_load_local_never_clobbers_removed_state(self):
+        """The pre-analysis code did a bare ``ce.state = QUEUED`` in
+        _load_local (an unguarded write to a guarded-by-annotated field):
+        a registry-deletion cleanup landing between the cache insert and
+        that write had its REMOVED clobbered, so the load proceeded and
+        re-promoted a just-unregistered model. The guarded transition
+        must lose to the removal and the load task must abandon."""
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loader = GatedLoader()
+        inst = _instance(kv, loader)
+        try:
+            inst.register_model("m", INFO)
+            mr = inst.registry.get("m")
+
+            load_calls: list[str] = []
+            orig_load = loader.load
+            loader.load = lambda mid, info: (
+                load_calls.append(mid), orig_load(mid, info)
+            )[1]
+
+            fired = threading.Event()
+            real_update = inst.registry.update_or_create
+
+            def racing_update(model_id, mutate, **kw):
+                # Fires during the loading-claim CAS — after the cache
+                # insert, before the queued transition — emulating the
+                # watch-driven deletion cleanup's remove_if_value window.
+                if not fired.is_set():
+                    fired.set()
+                    inst._remove_local(model_id)
+                return real_update(model_id, mutate, **kw)
+
+            inst.registry.update_or_create = racing_update
+            try:
+                ce = inst._load_local("m", mr, RoutingContext())
+            finally:
+                inst.registry.update_or_create = real_update
+            assert fired.is_set()
+            assert ce is not None
+            # the racing removal is never clobbered back to QUEUED
+            assert ce.state is EntryState.REMOVED
+            time.sleep(0.3)  # give a (wrongly) submitted load time to run
+            assert not load_calls, "load ran on a removed entry"
+            assert ce.state is EntryState.REMOVED
+            assert inst.cache.get_quietly("m") is None
+            mr2 = inst.registry.get("m")
+            assert "i-0" not in (mr2.instance_ids if mr2 else {})
+        finally:
+            inst.shutdown()
             kv.close()

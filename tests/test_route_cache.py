@@ -40,6 +40,15 @@ INFO = ModelInfo(model_type="example", model_path="mem://m")
 HOUR = 3_600_000
 
 
+@pytest.fixture(autouse=True)
+def _lock_debug(monkeypatch):
+    """MM_LOCK_DEBUG=1: the routing/invalidation races these tests drive
+    run on instrumented locks (utils/lockdebug.py), so an acquisition-
+    order inversion on the request path fails loudly here instead of
+    deadlocking in production."""
+    monkeypatch.setenv("MM_LOCK_DEBUG", "1")
+
+
 class _InstantLoader(ModelLoader):
     def startup(self) -> LocalInstanceParams:
         return LocalInstanceParams(capacity_bytes=64 << 20, load_timeout_ms=10_000)

@@ -1,0 +1,25 @@
+"""Concurrency & JAX-hazard static analysis for modelmesh_tpu.
+
+Four rule families tuned to this codebase (see docs/static-analysis.md):
+
+- ``guarded-by``      writes to ``#: guarded-by:``-annotated attributes
+                      must happen while the named lock is held
+- ``blocking-under-lock``  KV RPCs, socket I/O, ``time.sleep``, foreign
+                      ``.wait()``/``.join()``/``.result()`` while holding
+                      any registered lock
+- ``lock-order``      the static lock-acquisition graph (nested ``with``
+                      blocks + intra-class call propagation) must be
+                      acyclic and match the checked-in
+                      ``tools/analysis/lock_order.txt``
+- ``jax-*``           tracer leaks, device sync inside lock regions,
+                      unordered dict/set iteration feeding jitted code
+
+Run: ``python -m tools.analysis modelmesh_tpu/``
+"""
+
+from tools.analysis.core import (  # noqa: F401
+    AnalysisContext,
+    Finding,
+    load_baseline,
+    run_analysis,
+)

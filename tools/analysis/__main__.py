@@ -1,0 +1,80 @@
+"""CLI driver: ``python -m tools.analysis [paths...]``.
+
+Exit status 0 = zero unsuppressed findings (the tier-1 gate contract),
+non-zero otherwise. See docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.analysis import core, lockorder
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    root = repo_root()
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="Concurrency & JAX-hazard static analysis",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to analyze (default: modelmesh_tpu/)")
+    ap.add_argument("--baseline",
+                    default=os.path.join(root, "tools", "analysis",
+                                         "findings_baseline.txt"),
+                    help="suppression baseline file")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show every finding)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline with the current findings "
+                         "(justifications must then be filled in by hand)")
+    ap.add_argument("--write-lock-order", action="store_true",
+                    help="regenerate tools/analysis/lock_order.txt from "
+                         "the derived acquisition graph")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [os.path.join(root, "modelmesh_tpu")]
+
+    if args.write_lock_order:
+        ctx = core.build_context(paths, root)
+        out = os.path.join(root, lockorder.DEFAULT_ORDER_FILE)
+        lockorder.write_order_file(ctx, out)
+        print(f"wrote {os.path.relpath(out, root)}")
+        return 0
+
+    findings = core.run_analysis(paths, repo_root=root)
+
+    if args.update_baseline:
+        core.write_baseline(args.baseline, findings)
+        print(f"baseline rewritten with {len(findings)} entries — add a "
+              f"justification to every line (see docs/static-analysis.md)")
+        return 0
+
+    baseline = {} if args.no_baseline else core.load_baseline(args.baseline)
+    fresh = [f for f in findings if f.key() not in baseline]
+    stale = set(baseline) - {f.key() for f in findings}
+
+    for f in fresh:
+        print(f.render())
+    suppressed = len(findings) - len(fresh)
+    print(
+        f"\n{len(fresh)} finding(s) "
+        f"({suppressed} baselined, {len(findings)} total)"
+    )
+    if stale:
+        print(
+            f"note: {len(stale)} baseline entr(ies) no longer fire — "
+            f"prune them:\n  " + "\n  ".join(sorted(stale))
+        )
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,177 @@
+"""Rule family 2 — ``blocking-under-lock``: no blocking work while any
+registered lock is held (the generalization of the PR-3 finding that
+moved the promote txn outside ``_publish_lock``).
+
+Flagged while a lock is held (lexically inside ``with <lock>:``, or
+anywhere in a ``*_locked`` caller-holds-the-lock method):
+
+- KV RPCs: ``.txn/.put/.get/.batch_mutate/.update_or_create/...`` on
+  receivers named ``store``/``registry``/``instances``/``table`` (this
+  codebase's KV handles), plus SessionNode publishes
+  (``session.update``/``._establish``)
+- ZK wire I/O: ``sendall``/``recv``/``connect``/``request``/``_req``/
+  ``_get_data``/``_list_keys``/``_recreate_multi`` and ``_ZkSession`` /
+  ``socket.create_connection`` construction (connect + handshake)
+- ``time.sleep``
+- ``Condition.wait`` on a lock other than (one of) the held lock(s),
+  and any ``Event``-style ``.wait()`` while holding a lock
+- ``.result()`` / ``.join()`` (futures, threads)
+
+The rule is receiver-name based by design: it is tuned to this
+codebase's naming (a dict named ``store`` would false-positive — none
+is) and favors catching every real KV round trip over generality.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import (
+    LOCKED_SUFFIX,
+    AnalysisContext,
+    Finding,
+    ModuleInfo,
+    iter_functions,
+    receiver_and_attr,
+    with_lock_items,
+)
+
+RULE = "blocking-under-lock"
+
+KV_RECEIVERS = {"store", "registry", "instances", "table"}
+KV_METHODS = {
+    "get", "put", "delete", "range", "range_from", "range_paged",
+    "range_interval", "txn", "put_if_version", "delete_if_version",
+    "lease_grant", "lease_keepalive", "lease_revoke", "batch_mutate",
+    "update_or_create", "conditional_set", "conditional_delete",
+    "items", "watch", "snapshot", "compact",
+}
+SESSION_RECEIVERS = {"_session", "session", "_node"}
+SESSION_METHODS = {"update", "_establish", "start"}
+WIRE_METHODS = {
+    "sendall", "recv", "connect", "request", "_req",
+    "_get_data", "_list_keys", "_recreate_multi",
+}
+BLOCKING_CONSTRUCTORS = {"_ZkSession", "create_connection"}
+SYNC_METHODS = {"result", "join"}
+# Caller-holds-lock methods get a synthetic held entry so blocking calls
+# inside them are still flagged.
+CALLER_HELD = ("<caller>", "<held-lock>")
+
+
+class _BlockingVisitor(ast.NodeVisitor):
+    def __init__(self, mod: ModuleInfo, ctx: AnalysisContext,
+                 cls: str, qualname: str, caller_holds: bool):
+        self.mod = mod
+        self.ctx = ctx
+        self.cls = cls
+        self.qualname = qualname
+        self.held: list[tuple[str, str]] = (
+            [CALLER_HELD] if caller_holds else []
+        )
+        self.findings: list[Finding] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        items = with_lock_items(node, self.ctx.registry)
+        self.held.extend(items)
+        for stmt in node.body:
+            self.visit(stmt)
+        if items:
+            del self.held[len(self.held) - len(items):]
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass  # nested defs run later; visited separately with no context
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _flag(self, node: ast.AST, token: str, what: str) -> None:
+        held = ", ".join(
+            f"{r}.{a}" for r, a in self.held if r != "<caller>"
+        ) or "a caller-held lock (*_locked contract)"
+        self.findings.append(Finding(
+            rule=RULE,
+            path=self.mod.relpath,
+            line=node.lineno,
+            qualname=self.qualname,
+            token=token,
+            message=f"{what} while holding {held}",
+        ))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            self._check_call(node)
+        self.generic_visit(node)
+
+    def _check_call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id in BLOCKING_CONSTRUCTORS:
+                self._flag(node, f"{fn.id}()",
+                           f"blocking construction {fn.id}() "
+                           f"(socket connect/handshake)")
+            return
+        if not isinstance(fn, ast.Attribute):
+            return
+        method = fn.attr
+        ra = receiver_and_attr(fn)
+        recv = ra[0] if ra else ""
+        token = f"{recv}.{method}" if recv else method
+
+        if method == "sleep" and recv in ("time", "_time", "_t"):
+            self._flag(node, token, "time.sleep")
+            return
+        if recv == "socket" and method == "create_connection":
+            self._flag(node, token, "socket connect")
+            return
+        if recv in KV_RECEIVERS and method in KV_METHODS:
+            self._flag(node, token, f"KV RPC {token}()")
+            return
+        if recv in SESSION_RECEIVERS and method in SESSION_METHODS:
+            self._flag(node, token, f"session-node KV publish {token}()")
+            return
+        if method in WIRE_METHODS:
+            self._flag(node, token, f"wire I/O {token}()")
+            return
+        if method in SYNC_METHODS:
+            # str.join / os.path.join are not thread joins; a Constant
+            # receiver ("".join) yields ra None and is skipped too.
+            if method == "join" and (ra is None or recv in ("path", "os")):
+                return
+            self._flag(node, token, f"synchronous {method}()")
+            return
+        if method == "wait":
+            # waiting on (one of) the held condition(s) is THE cv
+            # pattern; waiting on anything else pins the held locks for
+            # the duration of a foreign sleep. The condition being
+            # waited on is the RECEIVER of .wait — fn.value.
+            cv_ra = receiver_and_attr(fn.value)
+            reg = self.ctx.registry
+            for held_recv, held_attr in self.held:
+                if held_recv == "<caller>":
+                    continue
+                if cv_ra is not None and (held_recv, held_attr) == cv_ra:
+                    return
+                # held the underlying lock of the cv being waited on
+                if cv_ra is not None and held_recv == cv_ra[0] and reg.alias_of(
+                    self.cls, cv_ra[1]
+                ) == held_attr:
+                    return
+            self._flag(node, token,
+                       f"wait on {token} (not a held condition)")
+
+
+def check(ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in ctx.modules:
+        for cls, func in iter_functions(mod):
+            caller_holds = func.name.endswith(LOCKED_SUFFIX)
+            visitor = _BlockingVisitor(
+                mod, ctx, cls,
+                f"{cls}.{func.name}" if cls else func.name,
+                caller_holds,
+            )
+            for stmt in func.body:
+                visitor.visit(stmt)
+            findings += visitor.findings
+    return findings

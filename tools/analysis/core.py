@@ -1,0 +1,419 @@
+"""Shared infrastructure for the static-analysis rule families.
+
+Everything is stdlib ``ast`` — no third-party dependencies. The driver
+makes two passes: pass 1 over every file builds the ``LockRegistry``
+(which attributes are locks, condition->lock aliases, and the
+``#: guarded-by:`` annotation table); pass 2 runs the rule visitors with
+that cross-module context.
+
+Conventions understood across the rules:
+
+- lock attributes: any ``self.X = threading.Lock()/RLock()/Condition(..)``
+  or the ``mm_lock("Class.attr")`` / ``mm_rlock`` / ``mm_condition``
+  factories from utils/lockdebug.py. Node names are ``ClassName.attr``.
+- ``#: guarded-by: <lock>`` on (or immediately above) an attribute
+  assignment declares the attribute shared-and-guarded. An optional
+  ``[rebind]`` qualifier limits the check to whole-attribute rebinds
+  (``self.attr = ...``) for structures whose inner mutation is
+  deliberately lock-free.
+- methods whose name ends in ``_locked`` are caller-holds-the-lock by
+  contract: the guarded-by rule skips them, the blocking rule treats
+  them as lock-held regions.
+- ``# analysis-ok: <rule>[, <rule>...] — <justification>`` on (or
+  immediately above) a line suppresses the named rules for that line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+LOCK_FACTORIES = {"Lock", "RLock", "mm_lock", "mm_rlock"}
+COND_FACTORIES = {"Condition", "mm_condition"}
+LOCKED_SUFFIX = "_locked"
+
+_ANNOTATION_RE = re.compile(
+    r"#:\s*guarded-by:\s*(?P<lock>\w+)\s*(?:\[(?P<mode>\w+)\])?"
+)
+# Rule names contain single hyphens, so the justification separator is
+# an em/en dash or a double hyphen: "# analysis-ok: <rules> — <why>".
+_SUPPRESS_RE = re.compile(
+    r"#\s*analysis-ok:\s*(?P<rules>[\w-]+(?:\s*,\s*[\w-]+)*)"
+    r"(?:\s*(?:—|–|--)\s*(?P<why>.+))?$"
+)
+_SELF_ASSIGN_RE = re.compile(r"\bself\.(?P<attr>\w+)\s*(?::[^=]+)?=[^=]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    qualname: str      # Class.method or module-level function
+    token: str         # stable identifier of the flagged construct
+    message: str
+
+    def key(self) -> str:
+        """Stable baseline key — deliberately line-number-free so the
+        suppression survives unrelated edits to the file."""
+        return f"{self.rule}|{self.path}|{self.qualname}|{self.token}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.rule}] {self.qualname}: "
+            f"{self.message}"
+        )
+
+
+@dataclass
+class Annotation:
+    attr: str
+    lock: str
+    mode: str          # "full" | "rebind"
+    cls: str           # owning class qualname ("" = module level)
+    path: str
+    line: int
+
+
+@dataclass
+class ModuleInfo:
+    path: str                      # absolute
+    relpath: str                   # repo-relative
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+    # line -> set of suppressed rule names ("*" = all)
+    suppressions: dict[int, set[str]] = field(default_factory=dict)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            rules = self.suppressions.get(ln)
+            if rules and ("*" in rules or rule in rules):
+                return True
+        return False
+
+
+class LockRegistry:
+    """Cross-module lock/annotation knowledge (pass 1 output)."""
+
+    def __init__(self) -> None:
+        # class qualname -> set of lock attr names (includes conditions)
+        self.class_locks: dict[str, set[str]] = {}
+        # (class, cv_attr) -> underlying lock attr (Condition(self._x))
+        self.cond_alias: dict[tuple[str, str], str] = {}
+        # every attr name known to be a lock/condition anywhere
+        self.lock_attr_names: set[str] = set()
+        # attr name -> classes defining it as a lock (for receiver
+        # resolution of non-self lock acquisitions)
+        self.lock_attr_owners: dict[str, set[str]] = {}
+        # class -> {attr: Annotation}
+        self.annotations: dict[str, dict[str, Annotation]] = {}
+        # attr -> annotations across all classes (cross-object writes)
+        self.annotations_by_attr: dict[str, list[Annotation]] = {}
+
+    def add_lock(self, cls: str, attr: str) -> None:
+        self.class_locks.setdefault(cls, set()).add(attr)
+        self.lock_attr_names.add(attr)
+        self.lock_attr_owners.setdefault(attr, set()).add(cls)
+
+    def add_annotation(self, ann: Annotation) -> None:
+        self.annotations.setdefault(ann.cls, {})[ann.attr] = ann
+        self.annotations_by_attr.setdefault(ann.attr, []).append(ann)
+
+    def alias_of(self, cls: str, attr: str) -> Optional[str]:
+        return self.cond_alias.get((cls, attr))
+
+    def node_name(self, cls: str, attr: str) -> str:
+        """Canonical graph node for a lock attr of ``cls`` — conditions
+        bound to another lock collapse onto that lock's node."""
+        alias = self.cond_alias.get((cls, attr))
+        return f"{cls}.{alias or attr}"
+
+
+# --------------------------------------------------------------------- #
+# source collection                                                     #
+# --------------------------------------------------------------------- #
+
+
+def iter_py_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(os.path.abspath(p))
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for f in sorted(files):
+                if f.endswith(".py") and "_pb2" not in f:
+                    out.append(os.path.abspath(os.path.join(root, f)))
+    return sorted(set(out))
+
+
+def load_module(path: str, repo_root: str) -> Optional[ModuleInfo]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+    mod = ModuleInfo(path=path, relpath=rel, source=source, tree=tree)
+    mod.lines = source.splitlines()
+    for i, line in enumerate(mod.lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+            mod.suppressions[i] = rules
+    return mod
+
+
+# --------------------------------------------------------------------- #
+# pass 1: lock + annotation registry                                    #
+# --------------------------------------------------------------------- #
+
+
+def _call_name(call: ast.Call) -> str:
+    """'Lock' for threading.Lock()/Lock(), 'mm_lock' for mm_lock(...)."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _self_attr_target(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _RegistryVisitor(ast.NodeVisitor):
+    def __init__(self, registry: LockRegistry, mod: ModuleInfo):
+        self.registry = registry
+        self.mod = mod
+        self.class_stack: list[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _current_class(self) -> str:
+        return self.class_stack[-1] if self.class_stack else ""
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call):
+            name = _call_name(node.value)
+            cls = self._current_class()
+            for target in node.targets:
+                attr = _self_attr_target(target)
+                if attr is None and isinstance(target, ast.Name) and not cls:
+                    # module-level lock (e.g. proto_splicer._lib_lock)
+                    if name in LOCK_FACTORIES | COND_FACTORIES:
+                        self.registry.add_lock("<module>", target.id)
+                    continue
+                if attr is None:
+                    continue
+                if name in LOCK_FACTORIES:
+                    self.registry.add_lock(cls, attr)
+                elif name in COND_FACTORIES:
+                    self.registry.add_lock(cls, attr)
+                    # Condition(self._x) / mm_condition(name, self._x)
+                    for arg in node.value.args:
+                        bound = _self_attr_target(arg)
+                        if bound is not None:
+                            self.registry.cond_alias[(cls, attr)] = bound
+        self.generic_visit(node)
+
+
+def _collect_annotations(registry: LockRegistry, mod: ModuleInfo) -> None:
+    # Map each line to its enclosing class (for the annotation owner).
+    line_class: dict[int, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            end = getattr(node, "end_lineno", node.lineno)
+            for ln in range(node.lineno, end + 1):
+                # innermost class wins: later (nested) defs overwrite
+                line_class[ln] = node.name
+    n = len(mod.lines)
+    for i, line in enumerate(mod.lines, start=1):
+        m = _ANNOTATION_RE.search(line)
+        if not m:
+            continue
+        attr = None
+        sm = _SELF_ASSIGN_RE.search(line)
+        target_line = i
+        if sm:
+            attr = sm.group("attr")
+        else:
+            # standalone annotation comment: applies to the next
+            # non-comment line's self.<attr> assignment
+            j = i + 1
+            while j <= n and (
+                not mod.lines[j - 1].strip()
+                or mod.lines[j - 1].lstrip().startswith("#")
+            ):
+                j += 1
+            if j <= n:
+                sm = _SELF_ASSIGN_RE.search(mod.lines[j - 1])
+                if sm:
+                    attr = sm.group("attr")
+                    target_line = j
+        if attr is None:
+            continue
+        registry.add_annotation(Annotation(
+            attr=attr,
+            lock=m.group("lock"),
+            mode=(m.group("mode") or "full"),
+            cls=line_class.get(target_line, ""),
+            path=mod.relpath,
+            line=target_line,
+        ))
+
+
+# --------------------------------------------------------------------- #
+# held-lock tracking (shared by the rule visitors)                      #
+# --------------------------------------------------------------------- #
+
+
+def receiver_and_attr(node: ast.AST) -> Optional[tuple[str, str]]:
+    """('self', '_lock') for self._lock; ('stripe', 'lock') for
+    stripe.lock; ('_store', '_lock') for self._store._lock."""
+    if not isinstance(node, ast.Attribute):
+        return None
+    base = node.value
+    if isinstance(base, ast.Name):
+        return base.id, node.attr
+    if isinstance(base, ast.Attribute):
+        return base.attr, node.attr
+    return None
+
+
+def with_lock_items(
+    node: ast.With, registry: LockRegistry
+) -> list[tuple[str, str]]:
+    """(receiver, lock_attr) for each with-item that is a known lock."""
+    out = []
+    for item in node.items:
+        ra = receiver_and_attr(item.context_expr)
+        if ra is not None and ra[1] in registry.lock_attr_names:
+            out.append(ra)
+    return out
+
+
+def qualname_at(mod: ModuleInfo, func: ast.AST, cls: str) -> str:
+    name = getattr(func, "name", "<module>")
+    return f"{cls}.{name}" if cls else name
+
+
+def iter_functions(mod: ModuleInfo):
+    """Yield (class_qualname, function_node) for every def in the module,
+    including methods (class name attached) and nested functions (with
+    the outer function's class)."""
+    def walk(node: ast.AST, cls: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cls, child
+                yield from walk(child, cls)
+            else:
+                yield from walk(child, cls)
+    yield from walk(mod.tree, "")
+
+
+# --------------------------------------------------------------------- #
+# baseline                                                              #
+# --------------------------------------------------------------------- #
+
+
+def load_baseline(path: str) -> dict[str, str]:
+    """baseline key -> justification. Lines: ``key  # justification``."""
+    out: dict[str, str] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            key, _, why = line.partition("#")
+            key = key.strip()
+            if key:
+                out[key] = why.strip()
+    return out
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(
+            "# Static-analysis suppression baseline.\n"
+            "# ONLY deliberate false positives belong here, each with a\n"
+            "# justification after '#'. True positives get FIXED, not\n"
+            "# baselined (docs/static-analysis.md).\n"
+            "# Format: rule|path|qualname|token  # justification\n"
+        )
+        for fd in sorted(findings, key=lambda x: x.key()):
+            f.write(f"{fd.key()}  # TODO: justify or fix\n")
+
+
+# --------------------------------------------------------------------- #
+# driver                                                                #
+# --------------------------------------------------------------------- #
+
+
+@dataclass
+class AnalysisContext:
+    repo_root: str
+    modules: list[ModuleInfo]
+    registry: LockRegistry
+
+
+def build_context(paths: Iterable[str], repo_root: str) -> AnalysisContext:
+    modules = []
+    registry = LockRegistry()
+    for path in iter_py_files(paths):
+        mod = load_module(path, repo_root)
+        if mod is None:
+            continue
+        modules.append(mod)
+        _RegistryVisitor(registry, mod).visit(mod.tree)
+        _collect_annotations(registry, mod)
+    return AnalysisContext(
+        repo_root=repo_root, modules=modules, registry=registry
+    )
+
+
+def run_analysis(
+    paths: Iterable[str],
+    repo_root: Optional[str] = None,
+    lock_order_path: Optional[str] = None,
+) -> list[Finding]:
+    """Run every rule family; returns findings with inline suppressions
+    already applied (baseline filtering is the caller's job)."""
+    from tools.analysis import blocking, guards, jaxhazards, lockorder
+
+    root = repo_root or os.getcwd()
+    ctx = build_context(paths, root)
+    findings: list[Finding] = []
+    findings += guards.check(ctx)
+    findings += blocking.check(ctx)
+    findings += lockorder.check(ctx, lock_order_path)
+    findings += jaxhazards.check(ctx)
+    by_path = {m.relpath: m for m in ctx.modules}
+    kept = []
+    for fd in findings:
+        mod = by_path.get(fd.path)
+        if mod is not None and mod.suppressed(fd.rule, fd.line):
+            continue
+        kept.append(fd)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept

@@ -1,0 +1,223 @@
+"""Rule family 1 — ``guarded-by``: every write to an annotated shared
+attribute must happen while the declared lock is held lexically (a
+``with self.<lock>:`` block, or a method following the ``*_locked``
+caller-holds-the-lock naming contract).
+
+Checked writes:
+- whole-attribute rebinds:   ``self.attr = ...`` / ``+=`` / ``del``
+- subscript stores/deletes:  ``self.attr[k] = ...`` / ``del self.attr[k]``
+- known mutating calls:      ``self.attr.pop/append/clear/update/...``
+- heap mutation:             ``heapq.heappush(self.attr, ...)`` etc.
+
+``[rebind]``-mode annotations check only the first category — for
+structures whose inner mutation is deliberately lock-free (GIL-atomic
+dict ops with validity carried in the entry, e.g. RouteCache._by_model).
+
+Cross-object writes are covered through the attribute-name-keyed
+annotation table: ``strat._warm_g = ...`` under ``with
+strat._refresh_lock:`` resolves against JaxPlacementStrategy's
+annotation even though the receiver isn't ``self``.
+
+``__init__``/``__new__`` are exempt (construction happens-before
+publication), as are ``*_locked`` methods.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.analysis.core import (
+    LOCKED_SUFFIX,
+    AnalysisContext,
+    Annotation,
+    Finding,
+    ModuleInfo,
+    iter_functions,
+    receiver_and_attr,
+    with_lock_items,
+)
+
+RULE = "guarded-by"
+
+MUTATORS = {
+    "append", "extend", "insert", "add", "discard", "remove",
+    "pop", "popitem", "clear", "update", "setdefault",
+}
+HEAPQ_FNS = {"heappush", "heappop", "heapify", "heappushpop", "heapreplace"}
+EXEMPT_FUNCS = {"__init__", "__new__", "__post_init__"}
+
+
+class _Write:
+    __slots__ = ("receiver", "attr", "rebind", "line", "token")
+
+    def __init__(self, receiver: str, attr: str, rebind: bool,
+                 line: int, token: str):
+        self.receiver = receiver
+        self.attr = attr
+        self.rebind = rebind
+        self.line = line
+        self.token = token
+
+
+def _writes_in_target(node: ast.AST, rebind: bool) -> list[_Write]:
+    out = []
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            out += _writes_in_target(elt, rebind)
+        return out
+    if isinstance(node, ast.Starred):
+        return _writes_in_target(node.value, rebind)
+    ra = receiver_and_attr(node)
+    if ra is not None:
+        out.append(_Write(ra[0], ra[1], rebind, node.lineno,
+                          f"{ra[0]}.{ra[1]}"))
+        return out
+    if isinstance(node, ast.Subscript):
+        ra = receiver_and_attr(node.value)
+        if ra is not None:
+            out.append(_Write(ra[0], ra[1], False, node.lineno,
+                              f"{ra[0]}.{ra[1]}[...]"))
+    return out
+
+
+class _GuardVisitor(ast.NodeVisitor):
+    """Walks one function body tracking lexically-held locks."""
+
+    def __init__(self, mod: ModuleInfo, ctx: AnalysisContext,
+                 cls: str, qualname: str):
+        self.mod = mod
+        self.ctx = ctx
+        self.cls = cls
+        self.qualname = qualname
+        self.held: list[tuple[str, str]] = []
+        self.findings: list[Finding] = []
+
+    # -- lock context ------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        items = with_lock_items(node, self.ctx.registry)
+        expanded: list[tuple[str, str]] = []
+        for recv, attr in items:
+            expanded.append((recv, attr))
+            # holding a Condition bound to a lock == holding the lock
+            alias = self.ctx.registry.alias_of(self.cls, attr)
+            if alias and recv == "self":
+                expanded.append((recv, alias))
+        self.held.extend(expanded)
+        for stmt in node.body:
+            self.visit(stmt)
+        for item in node.items:
+            self.visit(item.context_expr)
+        del self.held[len(self.held) - len(expanded):]
+
+    # Nested defs run later, possibly without the current locks held.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- writes ------------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            for w in _writes_in_target(target, rebind=True):
+                self._check(w)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            for w in _writes_in_target(node.target, rebind=True):
+                self._check(w)
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        for w in _writes_in_target(node.target, rebind=True):
+            self._check(w)
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            for w in _writes_in_target(target, rebind=True):
+                self._check(w)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATORS:
+            ra = receiver_and_attr(fn.value)
+            if ra is not None:
+                self._check(_Write(ra[0], ra[1], False, node.lineno,
+                                   f"{ra[0]}.{ra[1]}.{fn.attr}()"))
+        if (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "heapq"
+            and fn.attr in HEAPQ_FNS
+            and node.args
+        ):
+            ra = receiver_and_attr(node.args[0])
+            if ra is not None:
+                self._check(_Write(ra[0], ra[1], False, node.lineno,
+                                   f"heapq.{fn.attr}({ra[0]}.{ra[1]})"))
+        self.generic_visit(node)
+
+    # -- checking ----------------------------------------------------------
+
+    def _annotation_for(self, w: _Write) -> Optional[Annotation]:
+        reg = self.ctx.registry
+        if w.receiver == "self":
+            # Only the enclosing class's own annotations apply to self
+            # writes — the global table would collide on common names
+            # like _cache across unrelated classes.
+            return reg.annotations.get(self.cls, {}).get(w.attr)
+        anns = reg.annotations_by_attr.get(w.attr, [])
+        if len({(a.lock, a.mode) for a in anns}) == 1:
+            return anns[0]
+        return None
+
+    def _check(self, w: _Write) -> None:
+        ann = self._annotation_for(w)
+        if ann is None:
+            return
+        if ann.mode == "rebind" and not w.rebind:
+            return
+        reg = self.ctx.registry
+        for recv, attr in self.held:
+            if recv != w.receiver:
+                continue
+            if attr == ann.lock:
+                return
+            # annotation names a Condition whose alias we hold, or names
+            # the lock while we hold its Condition
+            if reg.alias_of(ann.cls or self.cls, attr) == ann.lock:
+                return
+            if reg.alias_of(ann.cls or self.cls, ann.lock) == attr:
+                return
+        self.findings.append(Finding(
+            rule=RULE,
+            path=self.mod.relpath,
+            line=w.line,
+            qualname=self.qualname,
+            token=w.token,
+            message=(
+                f"write to {w.token} (annotated guarded-by "
+                f"{ann.lock!r} at {ann.path}:{ann.line}) outside a "
+                f"`with {w.receiver}.{ann.lock}` block"
+            ),
+        ))
+
+
+def check(ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in ctx.modules:
+        for cls, func in iter_functions(mod):
+            if func.name in EXEMPT_FUNCS or func.name.endswith(LOCKED_SUFFIX):
+                continue
+            visitor = _GuardVisitor(
+                mod, ctx, cls, f"{cls}.{func.name}" if cls else func.name
+            )
+            for stmt in func.body:
+                visitor.visit(stmt)
+            findings += visitor.findings
+    return findings

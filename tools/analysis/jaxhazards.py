@@ -1,0 +1,289 @@
+"""Rule family 4 — JAX hazards in the solver core.
+
+Three rules, scoped to the JAX-bearing subpackages:
+
+- ``jax-tracer-leak`` (ops/, parallel/, placement/): assignment to
+  ``self.<attr>`` (or a ``global``) inside a jit-compiled function.
+  Under trace, the stored value is a Tracer — it escapes the trace,
+  poisons later non-traced code, and pins the trace's memory.
+- ``jax-sync-under-lock`` (everywhere): ``.block_until_ready()``,
+  ``np.asarray(...)`` / ``jax.device_get(...)`` readbacks, or dispatch
+  of a known-jitted callable while holding a registered lock — a device
+  round trip (or a compile!) inside a lock region convoys every thread
+  behind hardware latency.
+- ``jax-unordered-iter`` (ops/, parallel/): iteration over
+  ``dict.keys()/.values()/.items()`` or ``set(...)`` without
+  ``sorted(...)`` in a function that dispatches jitted code. Iteration
+  order varies across processes (sets hash-order by id); when it feeds
+  bucketing or shape-determining arguments the jit cache re-compiles
+  per ordering and plans diverge between leader and followers.
+
+Jit detection: ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators,
+``name = jax.jit(fn)`` bindings (the bound local ``fn`` is scanned for
+tracer leaks too), and calls through those bound names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.analysis.core import (
+    AnalysisContext,
+    Finding,
+    ModuleInfo,
+    iter_functions,
+    receiver_and_attr,
+    with_lock_items,
+)
+
+TRACER_RULE = "jax-tracer-leak"
+SYNC_RULE = "jax-sync-under-lock"
+ITER_RULE = "jax-unordered-iter"
+
+JAX_DIRS = ("modelmesh_tpu/ops/", "modelmesh_tpu/parallel/",
+            "modelmesh_tpu/placement/")
+ITER_DIRS = ("modelmesh_tpu/ops/", "modelmesh_tpu/parallel/")
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / jit, or partial(jax.jit, ...) — decorator or callee."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    if isinstance(node, ast.Name) and node.id == "jit":
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        if fname == "partial" and node.args and _is_jit_expr(node.args[0]):
+            return True
+        if _is_jit_expr(fn):
+            return True
+    return False
+
+
+def _jit_wrapped_arg(node: ast.Call) -> Optional[str]:
+    """For ``jax.jit(fn, ...)`` return 'fn' (a Name) if present."""
+    if _is_jit_expr(node.func) and node.args and isinstance(
+        node.args[0], ast.Name
+    ):
+        return node.args[0].id
+    return None
+
+
+def _collect_jitted(mod: ModuleInfo) -> tuple[set[str], list[ast.AST]]:
+    """-> (names bound to jitted callables, function nodes that are
+    jit-compiled bodies)."""
+    jitted_names: set[str] = set()
+    jitted_bodies: list[ast.AST] = []
+    defs_by_name: dict[str, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name[node.name] = node
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                jitted_names.add(node.name)
+                jitted_bodies.append(node)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            wrapped = _jit_wrapped_arg(node.value)
+            if wrapped is None:
+                continue
+            for target in node.targets:
+                name = None
+                if isinstance(target, ast.Name):
+                    name = target.id
+                elif isinstance(target, ast.Attribute):
+                    name = target.attr
+                if name:
+                    jitted_names.add(name)
+            body = defs_by_name.get(wrapped)
+            if body is not None:
+                jitted_bodies.append(body)
+    return jitted_names, jitted_bodies
+
+
+def _check_tracer_leaks(
+    mod: ModuleInfo, bodies: list[ast.AST]
+) -> list[Finding]:
+    findings = []
+    for body in bodies:
+        for node in ast.walk(body):
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                ra = receiver_and_attr(t)
+                if ra is not None and ra[0] == "self":
+                    findings.append(Finding(
+                        rule=TRACER_RULE,
+                        path=mod.relpath,
+                        line=t.lineno,
+                        qualname=getattr(body, "name", "<lambda>"),
+                        token=f"self.{ra[1]}",
+                        message=(
+                            f"assignment to self.{ra[1]} inside a "
+                            f"jit-compiled function stores a Tracer on "
+                            f"the instance (leaks the trace; poisons "
+                            f"non-traced readers)"
+                        ),
+                    ))
+            if isinstance(node, ast.Global):
+                findings.append(Finding(
+                    rule=TRACER_RULE,
+                    path=mod.relpath,
+                    line=node.lineno,
+                    qualname=getattr(body, "name", "<lambda>"),
+                    token=f"global:{','.join(node.names)}",
+                    message=(
+                        "global statement inside a jit-compiled function "
+                        "— traced values escaping via globals leak the "
+                        "trace"
+                    ),
+                ))
+    return findings
+
+
+class _SyncUnderLockVisitor(ast.NodeVisitor):
+    def __init__(self, mod: ModuleInfo, ctx: AnalysisContext,
+                 qualname: str, jitted_names: set[str]):
+        self.mod = mod
+        self.ctx = ctx
+        self.qualname = qualname
+        self.jitted = jitted_names
+        self.held: list[tuple[str, str]] = []
+        self.findings: list[Finding] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        items = with_lock_items(node, self.ctx.registry)
+        self.held.extend(items)
+        for stmt in node.body:
+            self.visit(stmt)
+        if items:
+            del self.held[len(self.held) - len(items):]
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _flag(self, node: ast.AST, token: str, what: str) -> None:
+        held = ", ".join(f"{r}.{a}" for r, a in self.held)
+        self.findings.append(Finding(
+            rule=SYNC_RULE, path=self.mod.relpath, line=node.lineno,
+            qualname=self.qualname, token=token,
+            message=f"{what} while holding {held} — device latency "
+                    f"(or a recompile) convoys every waiter on the lock",
+        ))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                if fn.attr == "block_until_ready":
+                    self._flag(node, "block_until_ready",
+                               "block_until_ready()")
+                elif fn.attr == "asarray" and isinstance(
+                    fn.value, ast.Name
+                ) and fn.value.id in ("np", "numpy"):
+                    self._flag(node, "np.asarray",
+                               "np.asarray device readback")
+                elif fn.attr == "device_get":
+                    self._flag(node, "device_get", "jax.device_get")
+                elif fn.attr in self.jitted:
+                    self._flag(node, fn.attr,
+                               f"jit dispatch {fn.attr}()")
+            elif isinstance(fn, ast.Name) and fn.id in self.jitted:
+                self._flag(node, fn.id, f"jit dispatch {fn.id}()")
+            if _is_jit_expr(node.func) and not isinstance(
+                node.func, ast.Name
+            ):
+                self._flag(node, "jax.jit", "jax.jit() compilation")
+        self.generic_visit(node)
+
+
+def _unsorted_iter_expr(node: ast.AST) -> Optional[str]:
+    """'d.items()' if node iterates a dict view / set() unsorted."""
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in (
+            "keys", "values", "items"
+        ):
+            ra = receiver_and_attr(fn)
+            base = ra[0] if ra else "?"
+            return f"{base}.{fn.attr}()"
+        if isinstance(fn, ast.Name) and fn.id == "set":
+            return "set(...)"
+    if isinstance(node, ast.Set):
+        return "{...} set literal"
+    return None
+
+
+def _check_unordered_iter(
+    mod: ModuleInfo, ctx: AnalysisContext, jitted_names: set[str]
+) -> list[Finding]:
+    findings = []
+    for cls, func in iter_functions(mod):
+        calls_jit = any(
+            (isinstance(n, ast.Call) and (
+                (isinstance(n.func, ast.Name) and n.func.id in jitted_names)
+                or (isinstance(n.func, ast.Attribute)
+                    and n.func.attr in jitted_names)
+                or _is_jit_expr(n.func)
+            ))
+            for n in ast.walk(func)
+        )
+        if not calls_jit:
+            continue
+        qual = f"{cls}.{func.name}" if cls else func.name
+        iters: list[tuple[ast.AST, ast.AST]] = []
+        for n in ast.walk(func):
+            if isinstance(n, ast.For):
+                iters.append((n, n.iter))
+            elif isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp)):
+                for gen in n.generators:
+                    iters.append((n, gen.iter))
+        for holder, it in iters:
+            token = _unsorted_iter_expr(it)
+            if token is None:
+                continue
+            findings.append(Finding(
+                rule=ITER_RULE,
+                path=mod.relpath,
+                line=getattr(it, "lineno", holder.lineno),
+                qualname=qual,
+                token=token,
+                message=(
+                    f"iteration over {token} in a function that "
+                    f"dispatches jitted code — hash order varies across "
+                    f"processes; wrap in sorted(...) so bucketing/shape "
+                    f"inputs are deterministic"
+                ),
+            ))
+    return findings
+
+
+def check(ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in ctx.modules:
+        in_jax_dir = any(d in mod.relpath for d in JAX_DIRS)
+        jitted_names, jitted_bodies = _collect_jitted(mod)
+        if in_jax_dir:
+            findings += _check_tracer_leaks(mod, jitted_bodies)
+        # sync-under-lock applies everywhere a lock and jit coexist
+        for cls, func in iter_functions(mod):
+            visitor = _SyncUnderLockVisitor(
+                mod, ctx, f"{cls}.{func.name}" if cls else func.name,
+                jitted_names,
+            )
+            for stmt in func.body:
+                visitor.visit(stmt)
+            findings += visitor.findings
+        if any(d in mod.relpath for d in ITER_DIRS):
+            findings += _check_unordered_iter(mod, ctx, jitted_names)
+    return findings

@@ -1,0 +1,308 @@
+"""Rule family 3 — ``lock-order``: derive the static lock-acquisition
+graph, reject cycles, and pin the canonical order in
+``tools/analysis/lock_order.txt``.
+
+Graph nodes are ``ClassName.attr`` (conditions bound to a lock collapse
+onto the lock's node; ``<module>.attr`` for module-level locks). Edges
+come from:
+
+- lexical nesting: ``with self._a:`` containing ``with self._b:``
+- intra-class call propagation: holding a lock while calling
+  ``self.method()`` adds edges to every lock that method (transitively,
+  within the class) acquires — this is what derives the real
+  ``ZookeeperKV._watch_lock -> ZookeeperKV._session_lock`` edge (the
+  mirror resync reconnecting under the watch lock) and
+  ``JaxPlacementStrategy._refresh_lock -> ._dirty_lock`` (refresh
+  consuming dirty marks).
+
+Non-``self`` receivers resolve through attribute-name uniqueness: if
+exactly one class owns ``_refresh_lock``, ``with strat._refresh_lock:``
+maps onto it; ambiguous names (``_lock``) are skipped rather than
+guessed.
+
+A cycle is a finding (two code paths acquire a lock pair in opposite
+orders — a potential deadlock even if no run has deadlocked yet).
+Drift between the derived graph and the checked-in file is a finding
+telling the author to regenerate (``--write-lock-order``) so review sees
+every ordering change. The checked-in edges also seed the
+``MM_LOCK_DEBUG=1`` runtime validator (utils/lockdebug.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from tools.analysis.core import (
+    AnalysisContext,
+    Finding,
+    receiver_and_attr,
+)
+
+RULE = "lock-order"
+DEFAULT_ORDER_FILE = os.path.join("tools", "analysis", "lock_order.txt")
+
+HEADER = """\
+# Canonical lock-acquisition order for modelmesh_tpu — GENERATED, do not
+# hand-edit. Regenerate with:
+#     python -m tools.analysis --write-lock-order
+# Locks earlier in the list may be held while acquiring later ones;
+# never the reverse. The `->` lines are the statically-derived
+# acquisition edges (outer -> inner); they seed the MM_LOCK_DEBUG=1
+# runtime validator's witness graph (utils/lockdebug.py).
+"""
+
+
+def _node_for(
+    ctx: AnalysisContext, cls: str, recv: str, attr: str
+) -> Optional[str]:
+    reg = ctx.registry
+    if recv == "self" and cls and attr in reg.class_locks.get(cls, ()):
+        return reg.node_name(cls, attr)
+    owners = reg.lock_attr_owners.get(attr, set())
+    if len(owners) == 1:
+        owner = next(iter(owners))
+        return reg.node_name(owner, attr)
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method: direct lock acquisitions, lexical nesting edges, and
+    self-calls with the locks held at the call site."""
+
+    def __init__(self, ctx: AnalysisContext, cls: str):
+        self.ctx = ctx
+        self.cls = cls
+        self.held: list[str] = []
+        self.acquires: set[str] = set()
+        # (held_tuple, callee_name)
+        self.self_calls: list[tuple[tuple[str, ...], str]] = []
+        # (outer, inner, line)
+        self.edges: list[tuple[str, str, int]] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            ra = receiver_and_attr(item.context_expr)
+            if ra is None or ra[1] not in self.ctx.registry.lock_attr_names:
+                continue
+            lock_node = _node_for(self.ctx, self.cls, *ra)
+            if lock_node is None:
+                continue
+            for outer in self.held:
+                if outer != lock_node:
+                    self.edges.append((outer, lock_node, node.lineno))
+            # Push IMMEDIATELY: `with self._a, self._b:` acquires a then
+            # b, so the a->b edge must be recorded like a nested with.
+            self.held.append(lock_node)
+            pushed += 1
+            self.acquires.add(lock_node)
+        for stmt in node.body:
+            self.visit(stmt)
+        if pushed:
+            del self.held[len(self.held) - pushed:]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "self"
+        ):
+            self.self_calls.append((tuple(self.held), fn.attr))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass  # nested defs analyzed on their own
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def derive_graph(
+    ctx: AnalysisContext,
+) -> tuple[set[str], dict[str, set[str]], list[tuple[str, str, str, int]]]:
+    """-> (nodes, edges {outer -> inners}, edge_witnesses
+    [(outer, inner, qualname, line)])."""
+    reg = ctx.registry
+    nodes: set[str] = set()
+    for cls, attrs in reg.class_locks.items():
+        for attr in attrs:
+            nodes.add(reg.node_name(cls, attr))
+
+    # Scan every method: per-class method tables for call propagation.
+    scans: dict[str, dict[str, _MethodScan]] = {}
+    witnesses: list[tuple[str, str, str, int]] = []
+    for mod in ctx.modules:
+        from tools.analysis.core import iter_functions
+
+        for cls, func in iter_functions(mod):
+            scan = _MethodScan(ctx, cls)
+            for stmt in func.body:
+                scan.visit(stmt)
+            if cls:
+                scans.setdefault(cls, {})[func.name] = scan
+            qual = f"{cls}.{func.name}" if cls else func.name
+            for outer, inner, line in scan.edges:
+                witnesses.append((outer, inner, f"{mod.relpath}:{qual}", line))
+
+    # Fixpoint: total acquisitions of each method including self-calls.
+    totals: dict[tuple[str, str], set[str]] = {
+        (cls, name): set(scan.acquires)
+        for cls, methods in scans.items()
+        for name, scan in methods.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for cls, methods in scans.items():
+            for name, scan in methods.items():
+                cur = totals[(cls, name)]
+                for _, callee in scan.self_calls:
+                    callee_total = totals.get((cls, callee))
+                    if callee_total and not callee_total <= cur:
+                        cur |= callee_total
+                        changed = True
+
+    # Call-site edges: locks held at a self-call -> callee's totals.
+    for cls, methods in scans.items():
+        for name, scan in methods.items():
+            for held, callee in scan.self_calls:
+                for inner in sorted(totals.get((cls, callee), ())):
+                    for outer in held:
+                        if outer != inner:
+                            witnesses.append(
+                                (outer, inner,
+                                 f"{cls}.{name} -> self.{callee}()", 0)
+                            )
+
+    edges: dict[str, set[str]] = {}
+    for outer, inner, _, _ in witnesses:
+        edges.setdefault(outer, set()).add(inner)
+        nodes.add(outer)
+        nodes.add(inner)
+    return nodes, edges, witnesses
+
+
+def _find_cycle(edges: dict[str, set[str]]) -> Optional[list[str]]:
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in edges}
+    stack: list[str] = []
+
+    def dfs(n: str) -> Optional[list[str]]:
+        color[n] = GREY
+        stack.append(n)
+        for m in sorted(edges.get(n, ())):
+            c = color.get(m, WHITE)
+            if c == GREY:
+                return stack[stack.index(m):] + [m]
+            if c == WHITE:
+                out = dfs(m)
+                if out:
+                    return out
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(edges):
+        if color.get(n, WHITE) == WHITE:
+            out = dfs(n)
+            if out:
+                return out
+    return None
+
+
+def topo_order(nodes: set[str], edges: dict[str, set[str]]) -> list[str]:
+    """Deterministic Kahn topological order, alphabetical tie-break;
+    isolated locks sort after ordered ones, alphabetically."""
+    indeg: dict[str, int] = {n: 0 for n in nodes}
+    for outer, inners in edges.items():
+        for inner in inners:
+            indeg[inner] = indeg.get(inner, 0) + 1
+    connected = set(edges)
+    for inners in edges.values():
+        connected |= inners
+    ready = sorted(n for n in connected if indeg.get(n, 0) == 0)
+    out: list[str] = []
+    while ready:
+        n = ready.pop(0)
+        out.append(n)
+        for m in sorted(edges.get(n, ())):
+            indeg[m] -= 1
+            if indeg[m] == 0:
+                ready.append(m)
+        ready.sort()
+    out += sorted(nodes - connected)
+    return out
+
+
+def render_order_file(
+    nodes: set[str], edges: dict[str, set[str]]
+) -> str:
+    lines = [HEADER]
+    for n in topo_order(nodes, edges):
+        lines.append(n)
+    lines.append("")
+    lines.append("# acquisition edges (outer -> inner)")
+    for outer in sorted(edges):
+        for inner in sorted(edges[outer]):
+            lines.append(f"{outer} -> {inner}")
+    return "\n".join(lines) + "\n"
+
+
+def write_order_file(ctx: AnalysisContext, path: str) -> str:
+    nodes, edges, _ = derive_graph(ctx)
+    content = render_order_file(nodes, edges)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(content)
+    return content
+
+
+def check(
+    ctx: AnalysisContext, order_path: Optional[str] = None
+) -> list[Finding]:
+    nodes, edges, witnesses = derive_graph(ctx)
+    findings: list[Finding] = []
+
+    cycle = _find_cycle(edges)
+    if cycle is not None:
+        why = []
+        for outer, inner in zip(cycle, cycle[1:]):
+            ws = [w for w in witnesses if w[0] == outer and w[1] == inner]
+            if ws:
+                why.append(f"{outer} -> {inner} ({ws[0][2]})")
+        findings.append(Finding(
+            rule=RULE,
+            path="tools/analysis/lock_order.txt",
+            line=1,
+            qualname="<graph>",
+            token="cycle:" + ">".join(cycle),
+            message=(
+                "lock-acquisition cycle (potential deadlock): "
+                + " -> ".join(cycle) + "; witnesses: " + "; ".join(why)
+            ),
+        ))
+        return findings  # a cyclic graph has no canonical order to diff
+
+    path = order_path or os.path.join(ctx.repo_root, DEFAULT_ORDER_FILE)
+    expected = render_order_file(nodes, edges)
+    actual = None
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            actual = f.read()
+    if actual != expected:
+        findings.append(Finding(
+            rule=RULE,
+            path="tools/analysis/lock_order.txt",
+            line=1,
+            qualname="<graph>",
+            token="drift",
+            message=(
+                "derived lock-acquisition graph differs from the "
+                "checked-in lock_order.txt — regenerate with "
+                "`python -m tools.analysis --write-lock-order` so the "
+                "ordering change is visible in review"
+            ),
+        ))
+    return findings

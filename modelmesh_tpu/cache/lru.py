@@ -33,10 +33,13 @@ lock (ModelCacheUnloadBufManager.java:51-54).
 from __future__ import annotations
 
 import heapq
-import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Generic, Iterator, Optional, TypeVar
 
+# Re-exported time source: most of the serving layer imports now_ms from
+# here; routing it through the injectable clock (utils/clock.py) puts the
+# whole LRU/lifecycle timestamp domain under simulated virtual time.
+from modelmesh_tpu.utils.clock import now_ms  # noqa: F401 — re-export
 from modelmesh_tpu.utils.lockdebug import mm_rlock
 
 K = TypeVar("K")
@@ -44,10 +47,6 @@ V = TypeVar("V")
 
 # listener(key, value, last_used_ms) — called under the eviction lock.
 EvictionListener = Callable[[Any, Any, int], None]
-
-
-def now_ms() -> int:
-    return int(time.time() * 1000)
 
 
 @dataclass

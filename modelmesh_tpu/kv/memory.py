@@ -32,6 +32,7 @@ from modelmesh_tpu.kv.store import (
     WatchEvent,
     WatchHandle,
 )
+from modelmesh_tpu.utils import clock as _clock
 
 
 class _Watcher(WatchHandle):
@@ -49,6 +50,11 @@ class _Watcher(WatchHandle):
 
 class InMemoryKV(KVStore):
     def __init__(self, sweep_interval_s: float = 0.1, history_cap: int = 8192):
+        # Lease deadlines and the expiry sweeper follow the clock installed
+        # at construction: under the simulation's VirtualClock, TTLs expire
+        # in virtual time (ephemeral-node semantics the scenario engine can
+        # compress or jump across).
+        self._clock = _clock.get_clock()
         self._lock = threading.RLock()
         self._data: dict[str, KeyValue] = {}
         self._rev = 0
@@ -57,7 +63,8 @@ class InMemoryKV(KVStore):
         self._leases: dict[int, tuple[float, float, set[str]]] = {}
         self._watchers: set[_Watcher] = set()
         self._events: "queue.Queue" = queue.Queue()
-        self._closed = threading.Event()
+        # clock-aware event: set() wakes a virtual-time sweeper wait too.
+        self._closed = self._clock.new_event()
         # Bounded replay history (etcd compaction analog): a long-running
         # MeshKV process must not grow memory with total write count.
         # Events at or below _compact_rev are unavailable for replay;
@@ -412,7 +419,9 @@ class InMemoryKV(KVStore):
     def lease_grant(self, ttl_s: float) -> int:
         with self._lock:
             lease_id = next(self._lease_seq)
-            self._leases[lease_id] = (time.monotonic() + ttl_s, ttl_s, set())
+            self._leases[lease_id] = (
+                self._clock.monotonic() + ttl_s, ttl_s, set()
+            )
             return lease_id
 
     def lease_keepalive(self, lease_id: int) -> bool:
@@ -421,7 +430,9 @@ class InMemoryKV(KVStore):
             if entry is None:
                 return False
             _, ttl_s, keys = entry
-            self._leases[lease_id] = (time.monotonic() + ttl_s, ttl_s, keys)
+            self._leases[lease_id] = (
+                self._clock.monotonic() + ttl_s, ttl_s, keys
+            )
             return True
 
     def lease_revoke(self, lease_id: int) -> None:
@@ -433,8 +444,8 @@ class InMemoryKV(KVStore):
                 self._delete_locked(key)
 
     def _sweep_loop(self, interval: float) -> None:
-        while not self._closed.wait(interval):
-            now = time.monotonic()
+        while not self._clock.wait_event(self._closed, interval):
+            now = self._clock.monotonic()
             with self._lock:
                 expired = [
                     lid for lid, (dl, _, _) in self._leases.items() if dl < now

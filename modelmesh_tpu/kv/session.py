@@ -12,11 +12,15 @@ Parity targets from the reference's kv-utils usage:
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Optional
 
 from modelmesh_tpu.kv.store import EventType, KVStore
+from modelmesh_tpu.utils import clock as _clock
 from modelmesh_tpu.utils.lockdebug import mm_lock
+
+log = logging.getLogger(__name__)
 
 
 class SessionNode:
@@ -45,7 +49,13 @@ class SessionNode:
         self.ttl_s = ttl_s
         self._interval = keepalive_interval_s or ttl_s / 3.0
         self._lease: Optional[int] = None  #: guarded-by: _lock
-        self._stop = threading.Event()
+        # keepalive-thread-private failure-streak flag (log throttling).
+        self._keepalive_failing = False
+        # Keepalive cadence follows the injectable clock (virtual under
+        # the sim harness); the stop event is clock-aware so close() wakes
+        # a virtual-time wait immediately.
+        self._clock = _clock.get_clock()
+        self._stop = self._clock.new_event()
         self._thread: Optional[threading.Thread] = None
         self._lock = mm_lock("SessionNode._lock")
 
@@ -141,15 +151,35 @@ class SessionNode:
             return Op(self.key, value, lease=self._lease)
 
     def _keepalive_loop(self) -> None:
-        while not self._stop.wait(self._interval):
+        while not self._clock.wait_event(self._stop, self._interval):
             with self._lock:
                 lease = self._lease
-            if lease is None or not self.store.lease_keepalive(lease):
-                # Lease lost (KV hiccup / expiry): re-grant and republish.
+            if lease is not None:
                 try:
-                    self._establish()
-                except Exception:
-                    pass  # retry next tick
+                    alive = self.store.lease_keepalive(lease)
+                except Exception as e:  # noqa: BLE001 — transient store error
+                    # Partition/outage: the lease may still be live server-
+                    # side, so don't churn it — retry next tick; if it DID
+                    # expire meanwhile, the False branch below re-grants.
+                    # First failure of a streak at WARNING so a real
+                    # outage is visible without per-tick spam.
+                    if not self._keepalive_failing:
+                        self._keepalive_failing = True
+                        log.warning(
+                            "session %s keepalive failed (will retry "
+                            "each tick): %s", self.key, e,
+                        )
+                    continue
+                if self._keepalive_failing:
+                    self._keepalive_failing = False
+                    log.info("session %s keepalive recovered", self.key)
+                if alive:
+                    continue
+            # Lease lost (KV hiccup / expiry): re-grant and republish.
+            try:
+                self._establish()
+            except Exception:
+                pass  # retry next tick
 
     def close(self) -> None:
         self._stop.set()

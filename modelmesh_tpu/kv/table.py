@@ -282,6 +282,10 @@ class TableView(Generic[R]):
         self._lock = mm_rlock("TableView._lock")
         self._listeners: list[TableListener] = []
         self._ready = threading.Event()
+        # Notified after every applied change; wait_for blocks on this
+        # instead of sleep-polling (wakeup latency = notification latency).
+        # Own lock, not _lock: waiters must never hold the view lock.
+        self._change_cv = threading.Condition()
         # Monotone view version: bumped on every APPLIED change (stale
         # watch replays don't count). Readers key derived snapshots on it
         # (ModelMeshInstance caches its ClusterView per epoch) so the
@@ -347,6 +351,8 @@ class TableView(Generic[R]):
             if event is not None:
                 for listener in self._listeners:
                     listener(event, id_, rec)
+                with self._change_cv:
+                    self._change_cv.notify_all()
 
     # -- read API ----------------------------------------------------------
 
@@ -386,14 +392,25 @@ class TableView(Generic[R]):
         self,
         predicate: Callable[["TableView[R]"], bool],
         timeout: float = 10.0,
-        poll_s: float = 0.01,
+        poll_s: float = 0.25,
     ) -> None:
-        """Test helper: block until predicate(self) is true."""
+        """Test helper: block until predicate(self) is true.
+
+        Event-driven: woken by the change condition on every applied
+        watch event, so the wait adds notification latency, not poll
+        slack; ``poll_s`` only bounds the re-check cadence for
+        predicates that depend on state outside this view. Deliberately
+        real-time (it bounds real thread progress, like wait_idle)."""
         deadline = time.monotonic() + timeout
         while not predicate(self):
-            if time.monotonic() > deadline:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise TimeoutError("condition not reached")
-            time.sleep(poll_s)
+            with self._change_cv:
+                # Benign race (predicate checked outside the cv): an event
+                # applied between the check and this wait just costs one
+                # poll_s slice, never a missed wakeup past the deadline.
+                self._change_cv.wait(min(remaining, poll_s))
 
     def close(self) -> None:
         self._watch.cancel()

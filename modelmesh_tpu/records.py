@@ -18,14 +18,12 @@ epoch millis throughout (matching the cache timestamps).
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Optional
 
 from modelmesh_tpu.kv.table import Record
-
-
-def now_ms() -> int:
-    return int(time.time() * 1000)
+# Injectable time source (utils/clock.py): record timestamps follow the
+# installed clock so the simulation harness controls them.
+from modelmesh_tpu.utils.clock import now_ms  # noqa: F401 — re-export
 
 
 # Load-failure bookkeeping windows (reference: ModelMesh.java:219-224).

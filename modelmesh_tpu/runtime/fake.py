@@ -17,12 +17,12 @@ from __future__ import annotations
 import argparse
 import logging
 import threading
-import time
 from concurrent import futures
 from typing import Optional
 
 import grpc
 
+from modelmesh_tpu.utils.clock import get_clock
 from modelmesh_tpu.utils.grpcopts import message_size_options
 from modelmesh_tpu.proto import mesh_runtime_pb2 as rpb
 from modelmesh_tpu.runtime import grpc_defs
@@ -55,7 +55,8 @@ class FakeRuntimeServicer:
         self.capacity_bytes = capacity_bytes
         self.default_size_bytes = default_size_bytes
         self.load_delay_s = load_delay_s
-        self._ready_at = time.monotonic() + ready_delay_s
+        self._clock = get_clock()
+        self._ready_at = self._clock.monotonic() + ready_delay_s
         self.load_concurrency = load_concurrency
         self.loaded: dict[str, int] = {}  # model_id -> size
         self.load_count = 0      # successful loads
@@ -68,7 +69,7 @@ class FakeRuntimeServicer:
     def RuntimeStatus(self, request, context):
         status = (
             rpb.RuntimeStatusResponse.READY
-            if time.monotonic() >= self._ready_at
+            if self._clock.monotonic() >= self._ready_at
             else rpb.RuntimeStatusResponse.STARTING
         )
         return rpb.RuntimeStatusResponse(
@@ -90,7 +91,7 @@ class FakeRuntimeServicer:
         if mid.startswith(SLOW_LOAD_PREFIX):
             delay = max(delay, 2.0)
         if delay:
-            time.sleep(delay)
+            self._clock.sleep(delay)
         size = self._size_for(mid)
         with self._lock:
             self.loaded[mid] = size
@@ -134,7 +135,7 @@ class FakeRuntimeServicer:
             # (reference handling at SidecarModelMesh.java:304-322, 961-988).
             context.abort(grpc.StatusCode.NOT_FOUND, f"model {mid} not loaded")
         if SLOW_PREDICT_MARK in mid:
-            time.sleep(3.0)
+            self._clock.sleep(3.0)
         if method.endswith("/Echo"):
             # Large-payload data-plane probe: response mirrors the request,
             # exercising the send path at the same size as the receive path.

@@ -15,7 +15,6 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-import time
 from typing import Optional
 
 import grpc
@@ -23,6 +22,7 @@ import grpc
 from modelmesh_tpu.utils.grpcopts import message_size_options
 from modelmesh_tpu.proto import mesh_runtime_pb2 as rpb
 from modelmesh_tpu.runtime import grpc_defs
+from modelmesh_tpu.utils.clock import get_clock
 from modelmesh_tpu.runtime.spi import (
     LoadedModel,
     LocalInstanceParams,
@@ -82,9 +82,10 @@ class SidecarRuntime(ModelLoader[str]):
     # -- SPI ------------------------------------------------------------------
 
     def startup(self) -> LocalInstanceParams:
-        deadline = time.monotonic() + self._startup_timeout_s
+        clock = get_clock()
+        deadline = clock.monotonic() + self._startup_timeout_s
         last_err: Optional[str] = None
-        while time.monotonic() < deadline:
+        while clock.monotonic() < deadline:
             try:
                 st = self._stub.RuntimeStatus(rpb.RuntimeStatusRequest())
                 if st.status == rpb.RuntimeStatusResponse.READY:
@@ -100,7 +101,7 @@ class SidecarRuntime(ModelLoader[str]):
                 last_err = rpb.RuntimeStatusResponse.Status.Name(st.status)
             except grpc.RpcError as e:
                 last_err = f"{e.code()}: {e.details()}"
-            time.sleep(self._poll_interval_s)
+            clock.sleep(self._poll_interval_s)
         raise ModelLoadException(
             f"model runtime not ready within {self._startup_timeout_s}s "
             f"(last: {last_err})",

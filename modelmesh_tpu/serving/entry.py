@@ -19,11 +19,11 @@ import enum
 import heapq
 import logging
 import threading
-import time
 import traceback
 from typing import Callable, Optional
 
 from modelmesh_tpu.cache.lru import now_ms
+from modelmesh_tpu.utils.clock import get_clock
 from modelmesh_tpu.runtime.spi import (
     CACHE_UNIT_BYTES,
     LoadedModel,
@@ -189,7 +189,7 @@ class CacheEntry:
         latency is notification latency, not poll-interval slack."""
         with self._state_cv:
             if self.state is known and timeout_s > 0:
-                self._state_cv.wait(timeout_s)
+                get_clock().cond_wait(self._state_cv, timeout_s)
             return self.state
 
     # -- invocation gating ---------------------------------------------------
@@ -329,16 +329,17 @@ class UnloadTracker:
         timeout_s: float = DEFAULT_SPACE_WAIT_S,
     ) -> bool:
         """Block until need_units fit beside cache weight + pending unloads."""
-        deadline = time.monotonic() + timeout_s
+        clock = get_clock()
+        deadline = clock.monotonic() + timeout_s
         with self._cv:
             while (
                 cache_weight_fn() + self._pending_units + need_units
                 > self.capacity_units
             ):
-                remaining = deadline - time.monotonic()
+                remaining = deadline - clock.monotonic()
                 if remaining <= 0:
                     return False
-                self._cv.wait(min(remaining, 1.0))
+                clock.cond_wait(self._cv, min(remaining, 1.0))
             return True
 
 

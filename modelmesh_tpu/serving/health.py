@@ -22,8 +22,9 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from typing import Callable, Optional
+
+from modelmesh_tpu.utils.clock import get_clock
 
 log = logging.getLogger(__name__)
 
@@ -93,7 +94,7 @@ class BootstrapProbation:
         self.window_s = window_s
         self.max_failures = max(1, max_failures)
         self.abort_fn = abort_fn
-        self._started = time.monotonic()
+        self._started = get_clock().monotonic()
         self._lock = threading.Lock()
         self._failures = 0
         self._disarmed = False
@@ -115,7 +116,7 @@ class BootstrapProbation:
         initialization so probation guards the load-serving period, not the
         (potentially minutes-long) TPU claim that precedes it."""
         with self._lock:
-            self._started = time.monotonic()
+            self._started = get_clock().monotonic()
 
     def record_success(self) -> None:
         with self._lock:
@@ -125,7 +126,7 @@ class BootstrapProbation:
         with self._lock:
             if self._disarmed:
                 return
-            if time.monotonic() - self._started > self.window_s:
+            if get_clock().monotonic() - self._started > self.window_s:
                 self._disarmed = True
                 return
             self._failures += 1

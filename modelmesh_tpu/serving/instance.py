@@ -71,6 +71,7 @@ from modelmesh_tpu.observability.metrics import Metric as MX
 from modelmesh_tpu.observability.tracing import outgoing_headers
 from modelmesh_tpu.serving.rate import RateTracker
 from modelmesh_tpu.serving.route_cache import RouteCache
+from modelmesh_tpu.utils.clock import get_clock
 from modelmesh_tpu.utils.lockdebug import mm_lock
 from modelmesh_tpu.utils.pool import BoundedDaemonPool
 
@@ -375,7 +376,9 @@ class ModelMeshInstance:
         # Publish coalescer state (trailing-flush window; see
         # publish_instance_record).
         self._coalesce_lock = mm_lock("ModelMeshInstance._coalesce_lock")
-        self._publish_timer: Optional[threading.Timer] = None  #: guarded-by: _coalesce_lock
+        # cancel()-able one-shot from Clock.call_later (threading.Timer or
+        # a virtual timer handle).
+        self._publish_timer = None  #: guarded-by: _coalesce_lock
         self._shutdown_publishes = False  #: guarded-by: _coalesce_lock
         # Watch-driven deletion cleanup (reference registers a registry
         # listener at ModelMesh.java:629; the deletion handler at :2807
@@ -517,13 +520,12 @@ class ModelMeshInstance:
                 if self._shutdown_publishes:
                     return
                 if self._publish_timer is None:
-                    t = threading.Timer(
-                        window_ms / 1000.0, self._publish_flush
+                    # Clock-injected one-shot: a threading.Timer under
+                    # SystemClock; a virtual-deadline timer under the sim.
+                    self._publish_timer = get_clock().call_later(
+                        window_ms / 1000.0, self._publish_flush,
+                        name="publish-coalesce",
                     )
-                    t.daemon = True
-                    t.name = "publish-coalesce"
-                    self._publish_timer = t
-                    t.start()
             return
         if force:
             with self._coalesce_lock:
@@ -1539,6 +1541,7 @@ class ModelMeshInstance:
         within mean+3σ; allow twice that (floored for cold starts) from
         the load start before declaring it stuck.
         """
+        clock = get_clock()
         cap_s = self.load_timeout_s * 1.5
         mtype = ce.info.model_type
         if self.time_stats.samples(mtype) >= self.time_stats.min_samples:
@@ -1549,7 +1552,7 @@ class ModelMeshInstance:
             # applies (a 10s default budget would abort healthy slow first
             # loads and cascade duplicate copies).
             load_budget_s = cap_s
-        deadline = _time.monotonic() + cap_s
+        deadline = clock.monotonic() + cap_s
         state = ce.state
         while True:
             if state is EntryState.ACTIVE:
@@ -1562,7 +1565,7 @@ class ModelMeshInstance:
                 # The client is gone: stop pinning this handler thread on
                 # the load (the load itself continues for other waiters).
                 raise RequestCancelledError(ce.model_id)
-            now = _time.monotonic()
+            now = clock.monotonic()
             remaining = deadline - now
             started = ce.load_started_ms
             if started:
@@ -1882,15 +1885,14 @@ class ModelMeshInstance:
         """Migration: stop accepting placements, trigger copies elsewhere
         for recently-used models, deregister everything (reference
         preShutdown, ModelMesh.java:6959-7143)."""
-        import time as _time
-
+        clock = get_clock()
         self.shutting_down = True
         self.publish_instance_record(force=True)
-        deadline = _time.monotonic() + deadline_s
+        deadline = clock.monotonic() + deadline_s
         recent_cutoff = now_ms() - 3_600_000
         items = list(self.cache.descending_items())  # MRU -> LRU
         for model_id, ce, last_used in items:
-            remaining = deadline - _time.monotonic()
+            remaining = deadline - clock.monotonic()
             if remaining <= 0:
                 break
             if last_used >= recent_cutoff and not self.shutdown_skip_migration:

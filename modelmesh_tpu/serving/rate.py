@@ -9,7 +9,8 @@ ModelMesh.java:5619-5806).
 from __future__ import annotations
 
 import threading
-import time
+
+from modelmesh_tpu.utils import clock as _clock
 
 BUCKETS = 30
 BUCKET_MS = 60_000
@@ -17,7 +18,7 @@ BUCKET_MS = 60_000
 
 class RateTracker:
     def __init__(self, clock_ms=None):
-        self._clock = clock_ms or (lambda: int(time.time() * 1000))
+        self._clock = clock_ms or _clock.now_ms
         self._counts = [0] * BUCKETS
         self._bucket_start = self._clock()
         self._bucket_idx = 0

@@ -21,6 +21,7 @@ ModelMesh.java:1151-1172; behaviors in SURVEY.md section 3.5):
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from typing import Optional
 
@@ -29,6 +30,7 @@ from modelmesh_tpu.kv.store import CasFailed
 from modelmesh_tpu.records import ModelRecord
 from modelmesh_tpu.serving.entry import EntryState
 from modelmesh_tpu.serving.instance import ModelMeshInstance
+from modelmesh_tpu.utils.clock import get_clock
 
 log = logging.getLogger(__name__)
 
@@ -59,6 +61,7 @@ class TaskConfig:
         second_copy_max_age_ms: int = SECOND_COPY_MAX_AGE_MS,
         assume_gone_ms: int = ASSUME_INSTANCE_GONE_MS,
         max_copies: int = 8,
+        jitter_frac: float = 0.1,
     ):
         self.publish_interval_s = publish_interval_s
         self.rate_interval_s = rate_interval_s
@@ -69,6 +72,12 @@ class TaskConfig:
         self.second_copy_max_age_ms = second_copy_max_age_ms
         self.assume_gone_ms = assume_gone_ms
         self.max_copies = max_copies
+        # Cadence jitter (fraction of the interval, 0 disables): each tick
+        # waits interval*(1 ± U[0,jitter]), and the FIRST tick is phase-
+        # shifted by U[0,1)*interval — both drawn from a per-(instance,
+        # task) seeded RNG, so a mass-restarted fleet spreads its
+        # publisher/janitor KV load instead of thundering in lockstep.
+        self.jitter_frac = jitter_frac
 
 
 class BackgroundTasks:
@@ -77,8 +86,14 @@ class BackgroundTasks:
     ):
         self.instance = instance
         self.config = config or TaskConfig()
-        self._stop = threading.Event()
+        self._clock = get_clock()
+        self._stop = self._clock.new_event()
         self._threads: list[threading.Thread] = []
+        # Observability: per-task tick timestamps (clock ms; the FIRST
+        # _TICK_LOG_CAP per task). The sim's jitter scenario reads these
+        # to assert a mass-restarted fleet doesn't fire in lockstep; each
+        # list is appended only by its own task thread.
+        self.tick_times: dict[str, list[int]] = {}
         # model_id -> previous-use timestamp at last rate tick (drives the
         # 1->2 "used, idle, used again" heuristic).
         self._prev_use: dict[str, int] = {}
@@ -106,6 +121,8 @@ class BackgroundTasks:
     def stop(self) -> None:
         self._stop.set()
 
+    _TICK_LOG_CAP = 64
+
     # Tasks that mutate the registry skip their cycle when the KV store is
     # unreachable (reference janitor/reaper guard, ModelMesh.java:5886,
     # 6449) — half-applied reconciliation against a flapping store does
@@ -122,7 +139,24 @@ class BackgroundTasks:
             return False
 
     def _loop(self, name: str, interval: float, fn) -> None:
-        while not self._stop.wait(interval):
+        # Deterministic per-(instance, task) jitter stream: the seed is the
+        # identity, not entropy, so a sim replay sees identical cadences.
+        rng = random.Random(f"{self.instance.instance_id}:{name}")
+        jitter = max(0.0, self.config.jitter_frac)
+        # Initial phase offset — the anti-thundering-herd half: a fleet
+        # restarted at the same instant must not fire its first publisher/
+        # janitor cycle at the same instant too.
+        wait_s = interval * rng.random() if jitter > 0 else interval
+        ticks = self.tick_times.setdefault(name, [])
+        while not self._clock.wait_event(self._stop, wait_s):
+            wait_s = interval * (
+                1.0 + jitter * (2.0 * rng.random() - 1.0)
+            ) if jitter > 0 else interval
+            if len(ticks) < self._TICK_LOG_CAP:
+                # Bounded from the FRONT: consumers (the sim's jitter
+                # spread check) read the first ticks; later ones are
+                # droppable, the earliest never silently evicted.
+                ticks.append(now_ms())
             if self.instance.shutting_down:
                 return
             if name in self._NEEDS_KV and not self._kv_reachable():

@@ -15,8 +15,9 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Optional
+
+from modelmesh_tpu.utils.clock import get_clock
 
 import grpc
 
@@ -44,8 +45,10 @@ class VModelManager:
             store, f"{prefix}/vmodels", VModelRecord
         )
         self.view: TableView[VModelRecord] = TableView(self.table)
+        self._clock = get_clock()
         self._stop = threading.Event()
-        self._kick = threading.Event()
+        # clock-aware: kicks (and close) wake a virtual-time sweep wait.
+        self._kick = self._clock.new_event()
         self._sweeper = threading.Thread(
             target=self._sweep_loop, args=(sweep_interval_s,),
             name=f"vmodel-sweep-{instance.instance_id}", daemon=True,
@@ -284,7 +287,7 @@ class VModelManager:
 
     def _sweep_loop(self, interval: float) -> None:
         while True:
-            kicked = self._kick.wait(interval)
+            kicked = self._clock.wait_event(self._kick, interval)
             self._kick.clear()
             if self._stop.is_set():
                 return
@@ -341,14 +344,14 @@ class VModelManager:
                     poll_s = 5.0
                 else:
                     poll_s = 1.0
-                poll_deadline = time.monotonic() + poll_s
+                poll_deadline = self._clock.monotonic() + poll_s
                 new_tgt, new_have = tgt, have
                 while True:
                     new_tgt = self.instance.registry.get(target)
                     new_have = len(new_tgt.instance_ids) if new_tgt else 0
-                    if new_have > have or time.monotonic() > poll_deadline:
+                    if new_have > have or self._clock.monotonic() > poll_deadline:
                         break
-                    time.sleep(0.05)
+                    self._clock.sleep(0.05)
                 if new_have <= have:
                     break  # no progress (cluster can't fit more copies)
                 tgt, have = new_tgt, new_have

@@ -1,0 +1,28 @@
+"""Deterministic cluster simulation harness.
+
+FoundationDB-style dynamic correctness checking for the distributed
+control plane: N in-process ``ModelMeshInstance``s run against a shared
+fault-injectable KV under **virtual time** (utils/clock.py), a seeded
+scenario engine injects faults (kill, partition, lease expiry, clock
+jumps, slow/failing loads, CAS-conflict amplification, watch delay), and
+machine-checked cluster invariants run at quiescence.
+
+The static half of this correctness story is ``tools/analysis`` (lock
+discipline within a process); this package is the dynamic half —
+cross-instance interleavings through the KV store. See docs/testing.md.
+
+Entry points:
+- ``python -m modelmesh_tpu.sim --seed S --steps K`` — randomized
+  exploration; prints a replayable seed on invariant failure.
+- ``modelmesh_tpu.sim.scenarios`` — scripted regression scenarios
+  replaying previously-fixed distributed races.
+"""
+
+from modelmesh_tpu.sim.harness import SimCluster, SimLoader  # noqa: F401
+from modelmesh_tpu.sim.kv import SimKV, SimKVConfig  # noqa: F401
+from modelmesh_tpu.sim.scenario import (  # noqa: F401
+    Event,
+    Scenario,
+    ScenarioResult,
+    run_scenario,
+)

@@ -1,0 +1,8 @@
+"""CLI: ``python -m modelmesh_tpu.sim --seed S --steps K``."""
+
+import sys
+
+from modelmesh_tpu.sim.explore import main
+
+if __name__ == "__main__":
+    sys.exit(main())

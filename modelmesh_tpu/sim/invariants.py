@@ -1,0 +1,185 @@
+"""Machine-checked cluster invariants, run at scenario quiescence.
+
+Each checker returns a list of violation strings (empty = PASS). They are
+deliberately *quiescent-state* properties: mid-scenario the cluster is
+allowed to be inconsistent (that's what convergence protocols are for);
+after faults stop and enough virtual time passes for the janitor/reaper
+cadences to run, these must hold.
+
+Catalog (see docs/testing.md for the rationale of each):
+- ``demanded_models_served``  — every model the scenario demanded has at
+  least one ACTIVE copy on a live instance, or a recorded load failure,
+  or was unregistered.
+- ``no_dead_placements``      — no registry record points at an instance
+  that has been dead longer than the reaper prune grace.
+- ``registry_cache_convergence`` — live instances' ACTIVE cache entries
+  and the registry's placement maps agree in both directions.
+- ``vmodel_resolution_acyclic``  — vmodel target resolution terminates
+  (no alias cycles, active targets exist in the registry).
+- ``cache_weight_consistent`` — per instance: the cache's accounted
+  weight equals the sum of entry weights, never exceeds capacity, and
+  pending-unload units are non-negative.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from modelmesh_tpu.serving.entry import EntryState
+
+if TYPE_CHECKING:  # pragma: no cover
+    from modelmesh_tpu.sim.harness import SimCluster
+
+
+def demanded_models_served(cluster: "SimCluster") -> list[str]:
+    out: list[str] = []
+    inst = cluster.first_live().instance
+    active: dict[str, set[str]] = {}
+    for pod in cluster.live_pods():
+        for mid in pod.instance.cache.keys():
+            ce = pod.instance.cache.get_quietly(mid)
+            if ce is not None and ce.state is EntryState.ACTIVE:
+                active.setdefault(mid, set()).add(pod.iid)
+    for mid in sorted(cluster.demanded):
+        mr = inst.registry.get(mid)
+        if mr is None:
+            continue  # unregistered (or lost demand) — nothing owed
+        if active.get(mid):
+            continue
+        if mr.load_failures:
+            continue  # failure record IS the answer (fail-fast, not silence)
+        out.append(
+            f"demanded model {mid} has no ACTIVE copy and no failure "
+            f"record (placements={sorted(mr.all_placements)})"
+        )
+    return out
+
+
+def no_dead_placements(
+    cluster: "SimCluster", dead_since_ms: dict[str, int], now_ms: int,
+    grace_ms: int,
+) -> list[str]:
+    """``dead_since_ms``: instance -> virtual time it died (scenario
+    bookkeeping). ``grace_ms`` should be assume_gone_ms + one reaper
+    interval — the window the protocol legitimately allows."""
+    out: list[str] = []
+    inst = cluster.first_live().instance
+    for mid, mr in inst.registry.items():
+        for iid in sorted(mr.all_placements):
+            died = dead_since_ms.get(iid)
+            if died is not None and now_ms - died > grace_ms:
+                out.append(
+                    f"record {mid} still points at {iid}, dead for "
+                    f"{(now_ms - died) / 1000.0:.0f}s (> grace "
+                    f"{grace_ms / 1000.0:.0f}s)"
+                )
+    return out
+
+
+def registry_cache_convergence(cluster: "SimCluster") -> list[str]:
+    out: list[str] = []
+    inst = cluster.first_live().instance
+    records = dict(inst.registry.items())
+    for pod in cluster.live_pods():
+        mmi = pod.instance
+        for mid in mmi.cache.keys():
+            ce = mmi.cache.get_quietly(mid)
+            if ce is None or ce.state is not EntryState.ACTIVE:
+                continue
+            mr = records.get(mid)
+            if mr is None:
+                out.append(
+                    f"{pod.iid} serves {mid} but the registry has no record"
+                )
+            elif pod.iid not in mr.instance_ids:
+                out.append(
+                    f"{pod.iid} serves {mid} but the record does not list "
+                    f"it (instance_ids={sorted(mr.instance_ids)})"
+                )
+    for mid, mr in records.items():
+        for iid in sorted(mr.instance_ids):
+            pod = next((p for p in cluster.live_pods() if p.iid == iid), None)
+            if pod is None:
+                continue  # dead holders are no_dead_placements' concern
+            ce = pod.instance.cache.get_quietly(mid)
+            if ce is None or (
+                ce.state is not EntryState.ACTIVE
+                and not ce.state.is_loading
+            ):
+                out.append(
+                    f"record {mid} lists {iid} but that instance has no "
+                    f"usable copy (entry={ce.state.value if ce else 'none'})"
+                )
+    return out
+
+
+def vmodel_resolution_acyclic(cluster: "SimCluster") -> list[str]:
+    """Vmodels resolve alias -> concrete model. A target naming another
+    vmodel id (aliases-of-aliases) must terminate; active targets must
+    exist in the registry."""
+    out: list[str] = []
+    inst = cluster.first_live().instance
+    from modelmesh_tpu.kv.table import KVTable
+    from modelmesh_tpu.records import VModelRecord
+
+    table: KVTable[VModelRecord] = KVTable(
+        inst.store, f"{inst.config.kv_prefix}/vmodels", VModelRecord
+    )
+    vmodels = dict(table.items())
+    for vmid, vr in vmodels.items():
+        seen = {vmid}
+        cur = vr.active_model
+        while cur in vmodels:
+            if cur in seen:
+                out.append(f"vmodel resolution cycle through {sorted(seen)}")
+                break
+            seen.add(cur)
+            cur = vmodels[cur].active_model
+        else:
+            if cur and inst.registry.get(cur) is None:
+                out.append(
+                    f"vmodel {vmid} resolves to {cur}, which is not in "
+                    "the registry"
+                )
+    return out
+
+
+def cache_weight_consistent(cluster: "SimCluster") -> list[str]:
+    out: list[str] = []
+    for pod in cluster.live_pods():
+        cache = pod.instance.cache
+        with cache.eviction_lock:
+            accounted = cache.weight
+            actual = sum(e.weight for e in cache._entries.values())
+            capacity = cache.capacity
+        if accounted != actual:
+            out.append(
+                f"{pod.iid}: cache weight {accounted} != sum of entry "
+                f"weights {actual} (double-counted or leaked units)"
+            )
+        if accounted > capacity:
+            out.append(
+                f"{pod.iid}: cache weight {accounted} exceeds capacity "
+                f"{capacity}"
+            )
+        if pod.instance.unload_tracker.pending_units < 0:
+            out.append(f"{pod.iid}: negative pending-unload units")
+    return out
+
+
+def check_all(
+    cluster: "SimCluster",
+    dead_since_ms: dict[str, int],
+    now_ms: int,
+    grace_ms: int,
+) -> dict[str, list[str]]:
+    """name -> violations (empty list = PASS); stable key order."""
+    return {
+        "demanded_models_served": demanded_models_served(cluster),
+        "no_dead_placements": no_dead_placements(
+            cluster, dead_since_ms, now_ms, grace_ms
+        ),
+        "registry_cache_convergence": registry_cache_convergence(cluster),
+        "vmodel_resolution_acyclic": vmodel_resolution_acyclic(cluster),
+        "cache_weight_consistent": cache_weight_consistent(cluster),
+    }

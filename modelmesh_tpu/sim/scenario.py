@@ -1,0 +1,259 @@
+"""Seeded scenario engine: declarative fault schedules under virtual time.
+
+A ``Scenario`` is a list of ``Event``s pinned to virtual times. The
+runner installs a ``VirtualClock``, builds a ``SimCluster``, then walks
+time forward in bounded steps — real production cadences (40 s publisher,
+6 min janitor, 7 min reaper, 10 s session TTLs) compress into wall-clock
+milliseconds — firing events as their virtual times arrive. At the
+horizon it heals all partitions, lets the cluster converge for a
+reconciliation window, and runs the invariant suite (sim/invariants.py).
+
+Determinism: the event trace is derived ONLY from the scenario (itself
+built from a seeded RNG in sim/explore.py) and virtual timestamps — the
+same seed replays bit-for-bit identically. Thread scheduling inside a
+step may vary; invariants are *quiescent-state* properties, so verdicts
+are stable.
+
+Event kinds:
+  kill <iid>              crash an instance (lease revoked, no migration)
+  partition <iid>         KV blackout for one instance
+  heal <iid>              end the blackout (held watch events flush)
+  expire_lease <iid>      revoke the session lease under the instance
+  clock_jump <ms>         single large advance (a freeze: leases MAY expire)
+  slow_load <iid> <model> <ms>   per-model virtual load delay
+  fail_load <iid> <model>        arm a one-shot load failure
+  register/ensure/invoke/unregister <model>   workload
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time as _wall
+from typing import Optional
+
+from modelmesh_tpu.serving.tasks import TaskConfig
+from modelmesh_tpu.sim.harness import SimCluster
+from modelmesh_tpu.sim.kv import SimKVConfig
+from modelmesh_tpu.sim import invariants
+from modelmesh_tpu.utils import clock as _clock
+
+log = logging.getLogger(__name__)
+
+# Virtual step the runner advances per tick. Small enough that session
+# keepalives (ttl/3 ≈ 3.3 s) always run between TTL checks — stepping
+# PAST a keepalive's deadline by more than the lease TTL would expire
+# leases that real continuous time would have kept alive. Large enough
+# that an hour of cadence costs ~1.8k steps.
+DEFAULT_STEP_MS = 2_000
+# Real seconds yielded per step so threads woken by the advance run.
+DEFAULT_YIELD_S = 0.001
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    at_ms: int
+    kind: str
+    args: tuple = ()
+
+    def render(self) -> str:
+        return f"@{self.at_ms}ms {self.kind}" + (
+            " " + " ".join(str(a) for a in self.args) if self.args else ""
+        )
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    events: list[Event]
+    n_instances: int = 3
+    horizon_ms: int = 60_000
+    seed: int = 0
+    kv_config: Optional[SimKVConfig] = None
+    task_config: Optional[TaskConfig] = None
+    # Extra convergence window after the horizon before invariants run;
+    # default covers two reaper cycles (prune + proactive load).
+    quiesce_ms: Optional[int] = None
+    instance_kwargs: Optional[dict] = None
+    load_delay_ms: float = 50.0
+    # Scenario-specific quiescent checks: name -> fn(cluster) -> violations.
+    # Run alongside the standard invariant suite; verdicts merge.
+    extra_checks: Optional[dict] = None
+    # Override the runner's virtual step for timing-sensitive scenarios
+    # (observed timestamps quantize onto the step grid).
+    step_ms: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    name: str
+    seed: int
+    trace: list[str]
+    verdicts: dict[str, list[str]]
+    wall_s: float
+
+    @property
+    def ok(self) -> bool:
+        return not any(self.verdicts.values())
+
+    def trace_lines(self) -> list[str]:
+        """The replay-comparable artifact: events + verdicts, no wall."""
+        lines = list(self.trace)
+        for name, violations in self.verdicts.items():
+            lines.append(
+                f"invariant {name}: "
+                + ("PASS" if not violations else "FAIL " + "; ".join(violations))
+            )
+        return lines
+
+    def render(self) -> str:
+        return "\n".join(self.trace_lines())
+
+
+class ScenarioRunner:
+    def __init__(self, scenario: Scenario, step_ms: int = DEFAULT_STEP_MS,
+                 yield_s: float = DEFAULT_YIELD_S):
+        self.scenario = scenario
+        self.step_ms = scenario.step_ms or step_ms
+        self.yield_s = yield_s
+        self.trace: list[str] = []
+        self.dead_since_ms: dict[str, int] = {}
+        self._workers: list[threading.Thread] = []
+
+    # -- event dispatch ----------------------------------------------------
+
+    def _fire(self, cluster: SimCluster, clock: _clock.VirtualClock,
+              ev: Event) -> None:
+        self.trace.append(ev.render())
+        kind, args = ev.kind, ev.args
+        # Pure fault toggles run inline — they never touch the store
+        # through a fault-injectable facade. Everything that CAN block on
+        # injected latency / a virtual-delay load must run off the
+        # advancing thread, or time stops underneath it.
+        if kind == "partition":
+            cluster.partition(args[0])
+            return
+        if kind == "heal":
+            cluster.heal(args[0])
+            return
+        if kind == "expire_lease":
+            cluster.expire_lease(args[0])  # inner store, bypasses facades
+            return
+        if kind == "clock_jump":
+            clock.advance(int(args[0]))
+            return
+        if kind == "slow_load":
+            cluster.slow_load(args[0], args[1], float(args[2]))
+            return
+        if kind == "fail_load":
+            cluster.fail_next_load(args[0], args[1])
+            return
+        if kind == "kill":
+            self.dead_since_ms[args[0]] = clock.now_ms()
+            target, targs = cluster.kill, (args[0],)
+        elif kind == "register":
+            target, targs = cluster.register, (args[0],)
+        elif kind == "unregister":
+            target, targs = cluster.unregister, (args[0],)
+        elif kind == "ensure":
+            chain = int(args[1]) if len(args) > 1 else 0
+            target, targs = cluster.ensure, (args[0], chain)
+        elif kind == "invoke":
+            target, targs = cluster.invoke, (args[0],)
+        else:
+            raise ValueError(f"unknown scenario event kind: {kind}")
+        t = threading.Thread(
+            target=target, args=targs,
+            name=f"sim-ev-{kind}-{args[0]}", daemon=True,
+        )
+        t.start()
+        self._workers.append(t)
+
+    # -- driving -----------------------------------------------------------
+
+    def run(self) -> ScenarioResult:
+        sc = self.scenario
+        t_wall = _wall.perf_counter()
+        clock = _clock.VirtualClock()
+        cluster = None
+        # installed() restores the previous clock and closes this one on
+        # exit; the cluster teardown (inner finally) runs first.
+        with _clock.installed(clock):
+            try:
+                # Construct with faults DISARMED: bootstrap runs on the
+                # runner thread, and an injected virtual-latency sleep
+                # there would deadlock (nobody is advancing time yet).
+                # The fault config arms when the drive loop starts and
+                # disarms before quiescent invariant reads — which run on
+                # this thread too.
+                cluster = SimCluster(
+                    n=sc.n_instances,
+                    seed=sc.seed,
+                    task_config=sc.task_config,
+                    load_delay_ms=sc.load_delay_ms,
+                    instance_kwargs=sc.instance_kwargs,
+                )
+                if sc.kv_config is not None:
+                    cluster.kv.config = sc.kv_config
+                start = clock.now_ms()
+                events = sorted(
+                    sc.events, key=lambda e: (e.at_ms, e.kind, e.args)
+                )
+                idx = 0
+                while clock.now_ms() - start < sc.horizon_ms:
+                    now_rel = clock.now_ms() - start
+                    while idx < len(events) and events[idx].at_ms <= now_rel:
+                        self._fire(cluster, clock, events[idx])
+                        idx += 1
+                    clock.advance(self.step_ms)
+                    _wall.sleep(self.yield_s)
+                for ev in events[idx:]:
+                    self._fire(cluster, clock, ev)
+                # Quiesce: heal every partition (a permanently-partitioned
+                # store has no convergence obligations), then give the
+                # protocol its reconciliation window.
+                for pod in cluster.pods:
+                    cluster.heal(pod.iid)
+                tc = cluster.task_config
+                quiesce = sc.quiesce_ms
+                if quiesce is None:
+                    quiesce = int(
+                        2 * max(tc.reaper_interval_s, tc.janitor_interval_s)
+                        * 1000
+                    ) + tc.assume_gone_ms
+                end = clock.now_ms() + quiesce
+                while clock.now_ms() < end:
+                    clock.advance(self.step_ms)
+                    _wall.sleep(self.yield_s)
+                # Disarm injected latency/conflicts: the invariant suite
+                # (and teardown) reads through the same facades on THIS
+                # thread.
+                cluster.kv.config = SimKVConfig()
+                for t in self._workers:
+                    t.join(timeout=5.0)
+                cluster.kv.inner.wait_idle(timeout=10.0)
+                _wall.sleep(0.05)  # drain listener fan-out
+                grace_ms = tc.assume_gone_ms + int(
+                    tc.reaper_interval_s * 2000
+                )
+                verdicts = invariants.check_all(
+                    cluster, self.dead_since_ms, clock.now_ms(), grace_ms
+                )
+                for name, fn in (sc.extra_checks or {}).items():
+                    verdicts[name] = fn(cluster)
+                return ScenarioResult(
+                    name=sc.name,
+                    seed=sc.seed,
+                    trace=self.trace,
+                    verdicts=verdicts,
+                    wall_s=_wall.perf_counter() - t_wall,
+                )
+            finally:
+                if cluster is not None:
+                    cluster.close()
+
+
+def run_scenario(scenario: Scenario, step_ms: int = DEFAULT_STEP_MS,
+                 yield_s: float = DEFAULT_YIELD_S) -> ScenarioResult:
+    return ScenarioRunner(scenario, step_ms=step_ms, yield_s=yield_s).run()

@@ -1,0 +1,340 @@
+"""Scripted regression scenarios: replays of previously-fixed races.
+
+Each scenario drives the interleaving a past PR's hardening addressed and
+checks the property that hardening restored — with the fix reverted the
+scenario's extra check (or a standard invariant) fails; at HEAD they all
+pass. Run from tests/test_sim_scenarios.py, or ad hoc:
+
+    python -c "from modelmesh_tpu.sim.scenarios import run_all; run_all()"
+
+Catalog (race -> origin):
+- fanout_budget_under_first_load_failure — PR 3's chained fan-out budget:
+  a failed first load must shrink, never inflate, the copies the top-up
+  pass places (total placements hard-capped at 1 + chain).
+- promote_publish_suppression — PR 4's suppression cross-check: promote
+  txns commit advertisements outside the publish io lock, so an
+  interleave can leave KV older than _last_published; suppression must
+  repair, not suppress forever.
+- lease_expiry_republish — PR 4's close/keepalive lease races: expiry
+  under a LIVE instance must re-establish + republish; a lease expiring
+  while the instance is killed must NOT leak a resurrected ephemeral.
+- delete_reregister_race — the watch-driven deletion-cleanup vs
+  re-register converge rule: a re-registration landing mid-cleanup ends
+  with a served copy, not a torn-down one.
+- partition_through_janitor — janitor/reaper reconciliation across a KV
+  blackout: skipped cycles (the _kv_reachable guard) must not leave
+  permanent divergence after heal.
+- mass_restart_jitter — the task-cadence jitter satellite: a fleet whose
+  background tasks all start at t=0 must not fire its publisher ticks in
+  lockstep.
+"""
+
+from __future__ import annotations
+
+from modelmesh_tpu.records import InstanceRecord
+from modelmesh_tpu.serving.tasks import TaskConfig
+from modelmesh_tpu.sim.harness import SimCluster
+from modelmesh_tpu.sim.kv import SimKVConfig
+from modelmesh_tpu.sim.scenario import (
+    Event,
+    Scenario,
+    ScenarioResult,
+    run_scenario,
+)
+
+# Compressed cadences shared by the scripted scenarios (the randomized
+# explorer uses its own): every protocol loop still runs, hours faster.
+def _tasks() -> TaskConfig:
+    return TaskConfig(
+        publish_interval_s=8.0,
+        rate_interval_s=4.0,
+        janitor_interval_s=30.0,
+        reaper_interval_s=30.0,
+        assume_gone_ms=60_000,
+    )
+
+
+# ------------------------------------------------------------------ #
+# 1. chained fan-out budget under first-load failure (PR 3)           #
+# ------------------------------------------------------------------ #
+
+_CHAIN = 2
+
+
+def _check_fanout_budget(cluster: SimCluster):
+    inst = cluster.first_live().instance
+    mr = inst.registry.get("m-chain")
+    if mr is None:
+        return ["m-chain lost its registration"]
+    placements = sorted(mr.all_placements)
+    # 1 original + _CHAIN chained copies is the hard ceiling; the failed
+    # first load must shrink delivery, never bait the top-up past it.
+    if len(placements) > 1 + _CHAIN:
+        return [
+            f"fan-out budget exceeded: {len(placements)} placements "
+            f"{placements} for chain={_CHAIN}"
+        ]
+    return []
+
+
+def fanout_budget_under_first_load_failure() -> Scenario:
+    return Scenario(
+        name="fanout-budget-first-load-failure",
+        seed=101,
+        n_instances=4,
+        horizon_ms=30_000,
+        task_config=_tasks(),
+        events=[
+            Event(0, "register", ("m-chain",)),
+            # The first (local) load on sim-0 fails; the chain fan-out
+            # already dispatched its directed placements at claim time.
+            Event(200, "fail_load", ("sim-0", "m-chain")),
+            Event(400, "slow_load", ("sim-1", "m-chain", 3_000)),
+            Event(600, "ensure", ("m-chain", _CHAIN)),
+        ],
+        extra_checks={"fanout_budget": _check_fanout_budget},
+    )
+
+
+# ------------------------------------------------------------------ #
+# 2. promote-txn / publish suppression interleaving (PR 4)            #
+# ------------------------------------------------------------------ #
+
+
+def _check_advert_fresh(cluster: SimCluster):
+    """The cluster-visible advertisement must converge to each live
+    instance's real state — a suppression decision taken against a newer
+    _last_published than what actually committed (the promote-txn
+    interleave) would freeze a stale model_count here forever."""
+    out = []
+    for pod in cluster.live_pods():
+        kv = cluster.kv.inner.get(pod.instance._session.key)
+        if kv is None:
+            out.append(f"{pod.iid}: no advertisement in the KV")
+            continue
+        seen = InstanceRecord.from_bytes(kv.value, kv.version)
+        real = len(pod.instance.cache)
+        if seen.model_count != real:
+            out.append(
+                f"{pod.iid}: advertised model_count {seen.model_count} "
+                f"!= actual {real} (suppressed repair?)"
+            )
+    return out
+
+
+def promote_publish_suppression() -> Scenario:
+    # Load churn + delayed/reordered watches + amplified CAS conflicts:
+    # the exact environment where promote-piggybacked publishes interleave
+    # with standalone ones.
+    events = [Event(0, "register", (f"m-pub-{i}",)) for i in range(6)]
+    events += [
+        Event(500 + 300 * i, "ensure", (f"m-pub-{i}",)) for i in range(6)
+    ]
+    events += [
+        Event(4_000 + 700 * i, "invoke", (f"m-pub-{i % 6}",))
+        for i in range(12)
+    ]
+    events += [Event(9_000, "unregister", ("m-pub-0",)),
+               Event(9_050, "unregister", ("m-pub-1",))]
+    return Scenario(
+        name="promote-publish-suppression",
+        seed=102,
+        n_instances=3,
+        horizon_ms=30_000,
+        task_config=_tasks(),
+        kv_config=SimKVConfig(
+            latency_ms=1.0, latency_jitter_ms=10.0,
+            cas_conflict_p=0.1, watch_delay_ms=40.0, watch_reorder_p=0.3,
+        ),
+        events=events,
+        extra_checks={"advert_fresh": _check_advert_fresh},
+    )
+
+
+# ------------------------------------------------------------------ #
+# 3. lease expiry: republish for the living, silence for the dead     #
+# ------------------------------------------------------------------ #
+
+
+def _check_session_records(cluster: SimCluster):
+    out = []
+    for pod in cluster.pods:
+        kv = cluster.kv.inner.get(pod.instance._session.key)
+        if pod.alive and kv is None:
+            out.append(
+                f"{pod.iid}: live instance's ephemeral advertisement "
+                "was not re-established after lease expiry"
+            )
+        if not pod.alive and kv is not None:
+            out.append(
+                f"{pod.iid}: dead instance's ephemeral resurrected "
+                "(a post-close keepalive/establish leaked a lease)"
+            )
+    return out
+
+
+def lease_expiry_republish() -> Scenario:
+    return Scenario(
+        name="lease-expiry-republish",
+        seed=103,
+        n_instances=3,
+        horizon_ms=40_000,
+        task_config=_tasks(),
+        events=[
+            Event(0, "register", ("m-lease",)),
+            Event(300, "ensure", ("m-lease",)),
+            # Expire the lease under a healthy instance — twice, across
+            # keepalive cycles: each must re-establish and republish.
+            Event(5_000, "expire_lease", ("sim-1",)),
+            Event(15_000, "expire_lease", ("sim-1",)),
+            # Race an expiry against a crash: the close path must win —
+            # no re-established ephemeral for a dead instance.
+            Event(20_000, "expire_lease", ("sim-2",)),
+            Event(20_000, "kill", ("sim-2",)),
+        ],
+        extra_checks={"session_records": _check_session_records},
+    )
+
+
+# ------------------------------------------------------------------ #
+# 4. registry delete / re-register race through watch cleanup         #
+# ------------------------------------------------------------------ #
+
+
+def _check_reregistered_served(cluster: SimCluster):
+    inst = cluster.first_live().instance
+    mr = inst.registry.get("m-flap")
+    if mr is None:
+        return ["m-flap: final re-registration lost"]
+    return []  # served-ness is demanded_models_served's job
+
+
+def delete_reregister_race() -> Scenario:
+    # Rapid unregister/register flaps under delayed watches: the
+    # watch-driven deletion cleanup races each re-registration; the
+    # converge rule (re-read + re-place after removal) must win.
+    # Flap events are spaced several runner steps apart: each fires on
+    # its own worker thread, and the unregister must have COMMITTED
+    # before the re-register lands — the race under test is cleanup-vs-
+    # re-register through the delayed watch, not thread-spawn order.
+    events = [
+        Event(0, "register", ("m-flap",)),
+        Event(300, "ensure", ("m-flap",)),
+    ]
+    t = 5_000
+    for _ in range(3):
+        events.append(Event(t, "unregister", ("m-flap",)))
+        events.append(Event(t + 1_500, "register", ("m-flap",)))
+        events.append(Event(t + 3_000, "ensure", ("m-flap",)))
+        t += 6_000
+    return Scenario(
+        name="delete-reregister-race",
+        seed=104,
+        n_instances=3,
+        horizon_ms=30_000,
+        task_config=_tasks(),
+        kv_config=SimKVConfig(watch_delay_ms=60.0, watch_reorder_p=0.25),
+        events=events,
+        extra_checks={"reregistered": _check_reregistered_served},
+        step_ms=500,
+    )
+
+
+# ------------------------------------------------------------------ #
+# 5. partition across janitor/reaper cycles                           #
+# ------------------------------------------------------------------ #
+
+
+def _check_partitioned_readvertised(cluster: SimCluster):
+    pod = cluster.by_id("sim-1")
+    kv = cluster.kv.inner.get(pod.instance._session.key)
+    if kv is None:
+        return ["sim-1: advertisement not restored after heal"]
+    return []
+
+
+def partition_through_janitor() -> Scenario:
+    return Scenario(
+        name="partition-through-janitor",
+        seed=105,
+        n_instances=3,
+        horizon_ms=120_000,
+        task_config=_tasks(),
+        events=[
+            Event(0, "register", ("m-part-a",)),
+            Event(200, "register", ("m-part-b",)),
+            Event(500, "ensure", ("m-part-a",)),
+            Event(700, "ensure", ("m-part-b",)),
+            # Blackout sim-1 for ~3 janitor cycles; its lease expires,
+            # peers see it vanish, the janitor guard skips its cycles.
+            Event(10_000, "partition", ("sim-1",)),
+            Event(30_000, "invoke", ("m-part-a",)),
+            Event(100_000, "heal", ("sim-1",)),
+        ],
+        extra_checks={"readvertised": _check_partitioned_readvertised},
+    )
+
+
+# ------------------------------------------------------------------ #
+# 6. mass-restart cadence jitter                                      #
+# ------------------------------------------------------------------ #
+
+def _check_jitter_spread(cluster: SimCluster):
+    """OBSERVED first publisher ticks (BackgroundTasks.tick_times, virtual
+    ms) must spread across the fleet. With the jitter reverted, every
+    task waits exactly the interval from the same start instant — all
+    first ticks collapse onto one timestamp (modulo the runner's step
+    grid, which is why the scenario runs at a fine step)."""
+    firsts = []
+    for pod in cluster.pods:
+        ticks = pod.tasks.tick_times.get("publisher")
+        if not ticks:
+            return [f"{pod.iid}: publisher never ticked"]
+        firsts.append(ticks[0])
+    distinct = len(set(firsts))
+    if distinct < max(2, len(firsts) - 1):
+        return [
+            f"publisher first ticks collapse onto {distinct} instant(s): "
+            f"{sorted(firsts)} — thundering herd on mass restart"
+        ]
+    return []
+
+
+def mass_restart_jitter() -> Scenario:
+    return Scenario(
+        name="mass-restart-jitter",
+        seed=106,
+        n_instances=4,
+        horizon_ms=20_000,
+        task_config=_tasks(),
+        events=[
+            Event(0, "register", ("m-jit",)),
+            Event(300, "ensure", ("m-jit",)),
+        ],
+        extra_checks={"jitter_spread": _check_jitter_spread},
+        # Fine step: first-tick timestamps quantize onto the runner grid,
+        # and the whole point is telling a ~U[0,8s) spread from lockstep.
+        step_ms=200,
+    )
+
+
+ALL = (
+    fanout_budget_under_first_load_failure,
+    promote_publish_suppression,
+    lease_expiry_republish,
+    delete_reregister_race,
+    partition_through_janitor,
+    mass_restart_jitter,
+)
+
+
+def run_all(step_ms: int = 1_000) -> list[ScenarioResult]:
+    results = []
+    for factory in ALL:
+        result = run_scenario(factory(), step_ms=step_ms)
+        print(f"[{'PASS' if result.ok else 'FAIL'}] {result.name} "
+              f"wall={result.wall_s:.1f}s")
+        if not result.ok:
+            print(result.render())
+        results.append(result)
+    return results

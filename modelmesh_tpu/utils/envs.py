@@ -162,6 +162,14 @@ REGISTRY: dict[str, EnvVar] = {
                "iterations per Sinkhorn convergence check when "
                "MM_SOLVER_SINKHORN_TOL is set (default 4)",
                "placement/jax_engine.py"),
+        EnvVar("MM_SIM_SEED", "int", "0",
+               "base seed for the deterministic cluster simulator's "
+               "randomized exploration (python -m modelmesh_tpu.sim); "
+               "the same seed replays the identical fault schedule",
+               "sim/explore.py"),
+        EnvVar("MM_SIM_STEPS", "int", "40",
+               "random fault/workload events generated per simulated "
+               "scenario seed", "sim/explore.py"),
         EnvVar("MM_SOLVER_AUCTION_STALL_TOL", "float", "",
                "auction early-exit stall tolerance: per-round price "
                "movement (price units) and best-overflow improvement "

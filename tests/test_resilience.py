@@ -195,7 +195,7 @@ class TestLatencyBandwidth:
                 mr = inst.registry.get("m-slow")
                 if mr.copy_count >= 2:
                     break
-                time.sleep(0.2)
+                time.sleep(0.05)
             assert inst.registry.get("m-slow").copy_count >= 2
             for t in tasks:
                 t.stop()

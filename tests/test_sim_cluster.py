@@ -1,0 +1,294 @@
+"""Janitor/reaper edge cases under virtual time — behaviors that were
+untestable without multi-minute (or multi-hour) wall sleeps.
+
+Ticks are driven DIRECTLY (start_tasks=False) with the virtual clock
+jumped to precise instants, so boundary conditions are exact: the reaper
+prune at the ASSUME_INSTANCE_GONE_MS boundary is checked at grace-1 ms
+(no prune) and at the boundary (prune).
+"""
+
+import time as _wall
+
+import pytest
+
+from modelmesh_tpu.serving import tasks as tasks_mod
+from modelmesh_tpu.serving.entry import EntryState
+from modelmesh_tpu.sim.harness import SimCluster
+from modelmesh_tpu.utils import clock as clock_mod
+from modelmesh_tpu.utils.clock import VirtualClock
+
+
+@pytest.fixture()
+def sim():
+    """(cluster, clock) under an installed VirtualClock; elections are
+    closed so leadership is set explicitly per tick."""
+    clock = VirtualClock()
+    prev = clock_mod.install(clock)
+    cluster = SimCluster(n=3, start_tasks=False, load_delay_ms=0.0)
+    for pod in cluster.pods:
+        pod.instance._election.close()
+    try:
+        yield cluster, clock
+    finally:
+        cluster.close()
+        clock_mod.install(prev)
+        clock.close()
+
+
+def _wait_real(pred, timeout=5.0, step=0.01):
+    deadline = _wall.monotonic() + timeout
+    while not pred():
+        if _wall.monotonic() > deadline:
+            return False
+        _wall.sleep(step)
+    return True
+
+
+def _settle_views(cluster, n=3, timeout=5.0):
+    """After a jump larger than the session TTL, leases churn: wait
+    (real time — keepalive re-establish needs no further advances) until
+    every live instance re-advertised and the views recovered."""
+    assert _wait_real(
+        lambda: all(
+            len(p.instance.instances_view) >= n for p in cluster.live_pods()
+        ),
+        timeout=timeout,
+    ), "views did not recover after the clock jump"
+
+
+def _load_copy(cluster, pod, model_id, exclude=None):
+    pod.instance.ensure_loaded(model_id, sync=False, exclude=exclude)
+    assert _wait_real(
+        lambda: (
+            (ce := pod.instance.cache.get_quietly(model_id)) is not None
+            and ce.state is EntryState.ACTIVE
+        )
+    ), f"{model_id} did not activate on {pod.iid}"
+
+
+class TestJanitorEdgeCases:
+    def test_failure_record_expiry(self, sim):
+        cluster, clock = sim
+        pod = cluster.pods[0]
+        cluster.register("m-fx")
+        _load_copy(cluster, pod, "m-fx")
+
+        def poison(cur):
+            cur.add_load_failure("sim-9", "injected historical failure")
+            return cur
+
+        inst = pod.instance
+        inst.registry.update_or_create("m-fx", poison)
+        # Within the expiry window the failure must persist (it is the
+        # exclusion that prevents immediate re-placement flapping)...
+        pod.tasks._janitor_tick()
+        assert inst.registry.get("m-fx").load_failures
+        # ... and one virtual expiry window later the janitor drops it.
+        from modelmesh_tpu import records as records_mod
+
+        clock.advance(records_mod.failure_expiry_ms() + 1_000)
+        _settle_views(cluster)
+        pod.tasks._janitor_tick()
+        assert not inst.registry.get("m-fx").load_failures
+
+    def test_cluster_full_scale_down_and_min_age_antithrash(self, sim):
+        cluster, clock = sim
+        inst0 = cluster.pods[0].instance
+        cluster.register("m-sd")
+        _load_copy(cluster, cluster.pods[0], "m-sd")
+        mr = inst0.registry.get("m-sd")
+        # Second copy placed wherever the strategy likes (any non-holder).
+        cluster.pods[1].instance.ensure_loaded(
+            "m-sd", sync=False, exclude=set(mr.all_placements)
+        )
+        assert _wait_real(
+            lambda: len(inst0.registry.get("m-sd").instance_ids) == 2
+        ), "second copy never promoted"
+        mr = inst0.registry.get("m-sd")
+        shedder_id = max(mr.instance_ids.items(), key=lambda kv: (kv[1], kv[0]))[0]
+        shedder = cluster.by_id(shedder_id)
+        # The janitor reads the watch-fed registry VIEW — wait until the
+        # shedder has seen its own second-copy promotion before ticking.
+        shedder.instance.registry_view.wait_for(
+            lambda v: (rec := v.get("m-sd")) is not None
+            and len(rec.instance_ids) == 2
+        )
+        # Anti-thrash: younger than SURPLUS_COPY_MIN_AGE_MS — no shed,
+        # even though local traffic is zero.
+        clock.advance(tasks_mod.SURPLUS_COPY_MIN_AGE_MS - 60_000)
+        _settle_views(cluster)
+        shedder.tasks._janitor_tick()
+        assert len(inst0.registry.get("m-sd").instance_ids) == 2
+        # Past the 10 h surplus cap the copy sheds even though the
+        # cluster is nowhere near full.
+        clock.advance(tasks_mod.SURPLUS_COPY_MAX_AGE_MS)
+        _settle_views(cluster)
+        shedder.tasks._janitor_tick()
+        assert _wait_real(
+            lambda: shedder_id
+            not in (inst0.registry.get("m-sd") or mr).instance_ids
+        ), "surplus copy past the age cap was not shed"
+
+
+class TestReaperEdgeCases:
+    def test_stale_loading_claim_dropped(self, sim):
+        cluster, clock = sim
+        leader = cluster.pods[0]
+        cluster.register("m-claim")
+
+        def claim(cur):
+            cur.claim_loading("sim-ghost", clock.now_ms())
+            return cur
+
+        inst = leader.instance
+        inst.registry.update_or_create("m-claim", claim)
+        inst.is_leader = True
+        # Fresh claim from a non-live instance: kept (it may be a joiner
+        # whose advertisement hasn't landed).
+        leader.tasks._reaper_tick()
+        assert "sim-ghost" in inst.registry.get("m-claim").loading_instances
+        clock.advance(tasks_mod.STALE_LOADING_CLAIM_MS + 1_000)
+        _settle_views(cluster)
+        inst.is_leader = True
+        leader.tasks._reaper_tick()
+        assert "sim-ghost" not in inst.registry.get("m-claim").loading_instances
+
+    def test_prune_exactly_at_assume_gone_boundary(self, sim):
+        cluster, clock = sim
+        leader = cluster.pods[0]
+        inst = leader.instance
+        cluster.register("m-ghosted")
+
+        def haunt(cur):
+            cur.promote_loaded("sim-ghost", clock.now_ms())
+            return cur
+
+        inst.registry.update_or_create("m-ghosted", haunt)
+        inst.is_leader = True
+        leader.tasks._reaper_tick()  # first sighting: starts the clock
+        grace = leader.tasks.config.assume_gone_ms
+        # One millisecond short of the boundary: NOT pruned.
+        clock.advance(grace - 1)
+        _settle_views(cluster)
+        inst.is_leader = True
+        leader.tasks._reaper_tick()
+        assert "sim-ghost" in inst.registry.get("m-ghosted").instance_ids, (
+            "pruned one ms BEFORE the assume-gone boundary"
+        )
+        # Exactly at the boundary (>=): pruned.
+        clock.advance(1)
+        inst.is_leader = True
+        leader.tasks._reaper_tick()
+        assert "sim-ghost" not in inst.registry.get("m-ghosted").instance_ids
+
+
+class TestSimKV:
+    def test_partition_raises_and_heal_flushes_watch_backlog_in_order(self):
+        from modelmesh_tpu.sim.kv import SimKV
+
+        sim = SimKV(seed=1)
+        try:
+            facade = sim.for_instance("i-a")
+            seen = []
+            facade.watch("k/", lambda evs: seen.extend(
+                (ev.kv.key, ev.kv.value) for ev in evs
+            ))
+            facade.put("k/1", b"a")
+            sim.partition("i-a")
+            with pytest.raises(ConnectionError):
+                facade.get("k/1")
+            with pytest.raises(ConnectionError):
+                facade.txn([], [])
+            # Writes from a NON-partitioned peer buffer for i-a...
+            other = sim.for_instance("i-b")
+            other.put("k/2", b"b")
+            other.put("k/2", b"c")
+            sim.inner.wait_idle()
+            assert ("k/2", b"b") not in seen
+            sim.heal("i-a")
+            assert _wait_real(lambda: ("k/2", b"c") in seen)
+            # ... and per-key order survived the buffered catch-up.
+            k2 = [v for k, v in seen if k == "k/2"]
+            assert k2 == [b"b", b"c"]
+        finally:
+            sim.close()
+
+    def test_cas_amplification_is_spurious_conflict_not_corruption(self):
+        from modelmesh_tpu.kv.store import CasFailed
+        from modelmesh_tpu.sim.kv import SimKV, SimKVConfig
+
+        sim = SimKV(seed=3, config=SimKVConfig(cas_conflict_p=0.5))
+        try:
+            facade = sim.for_instance("i-a")
+            # A resilient CAS loop (the codebase contract) still converges
+            # under 50% amplification...
+            ok = 0
+            for i in range(40):
+                for _ in range(64):
+                    try:
+                        kv = facade.get("ctr")
+                        ver = kv.version if kv else 0
+                        facade.put_if_version("ctr", str(i).encode(), ver)
+                        ok += 1
+                        break
+                    except CasFailed:
+                        continue
+            assert ok == 40
+            # ... and the committed state is the real store's (no torn
+            # writes from the injection layer).
+            assert sim.inner.get("ctr").value == b"39"
+        finally:
+            sim.close()
+
+
+class TestVirtualClock:
+    def test_sleep_wakes_on_advance(self):
+        clock = VirtualClock()
+        woke = []
+
+        import threading
+
+        def sleeper():
+            clock.sleep(5.0)
+            woke.append(clock.now_ms())
+
+        t = threading.Thread(target=sleeper, daemon=True)
+        t.start()
+        assert _wait_real(lambda: clock.waiters == 1)
+        clock.advance(4_999)
+        _wall.sleep(0.02)
+        assert not woke, "woke before the virtual deadline"
+        clock.advance(1)
+        t.join(timeout=2)
+        assert woke and woke[0] == clock.now_ms()
+        clock.close()
+
+    def test_event_set_wakes_virtual_wait(self):
+        clock = VirtualClock()
+        ev = clock.new_event()
+        import threading
+
+        out = []
+        t = threading.Thread(
+            target=lambda: out.append(clock.wait_event(ev, 3600.0)),
+            daemon=True,
+        )
+        t.start()
+        assert _wait_real(lambda: clock.waiters == 1)
+        ev.set()  # no advance needed: the kicking event wakes the waiter
+        t.join(timeout=2)
+        assert out == [True]
+        clock.close()
+
+    def test_call_later_fires_at_deadline_and_cancel_holds(self):
+        clock = VirtualClock()
+        fired = []
+        clock.call_later(2.0, lambda: fired.append("a"))
+        cancelled = clock.call_later(2.0, lambda: fired.append("b"))
+        cancelled.cancel()
+        clock.advance(1_999)
+        _wall.sleep(0.02)
+        assert fired == []
+        clock.advance(1)
+        assert _wait_real(lambda: fired == ["a"])
+        clock.close()

@@ -12,7 +12,7 @@ from tests.cluster_util import Cluster
 INFO = ModelInfo(model_type="example", model_path="mem://t")
 
 
-def _wait(pred, timeout=10.0, step=0.05):
+def _wait(pred, timeout=10.0, step=0.02):
     deadline = time.monotonic() + timeout
     while not pred():
         if time.monotonic() > deadline:
@@ -48,13 +48,18 @@ class TestScaleUp:
         c = cluster_with_tasks
         inst = c[0].instance
         inst.register_model("m-hot", INFO)
-        # Repeated use across rate ticks triggers the 1->2 pattern.
-        for _ in range(6):
+        # Repeated use across rate ticks triggers the 1->2 pattern; keep
+        # invoking (cheap) until the second copy lands instead of paying
+        # a fixed multi-second sleep schedule up front.
+        inst.invoke_model("m-hot", PREDICT_METHOD, b"x", [])
+
+        def used_again_and_scaled():
             inst.invoke_model("m-hot", PREDICT_METHOD, b"x", [])
-            time.sleep(0.25)
-        assert _wait(
-            lambda: len(inst.registry.get("m-hot").instance_ids) >= 2
-        ), f"copies: {inst.registry.get('m-hot').instance_ids}"
+            return len(inst.registry.get("m-hot").instance_ids) >= 2
+
+        assert _wait(used_again_and_scaled, step=0.1), (
+            f"copies: {inst.registry.get('m-hot').instance_ids}"
+        )
 
 
 class TestJanitor:

@@ -24,6 +24,17 @@ MM_PUBLISH_COALESCE_MS):
                 and STANDALONE instance-record publish puts — the batched
                 promote+publish txn and the coalesced publisher vs the
                 per-load CAS + publish baseline.
+  flash_crowd — the transfer/ subsystem's headline: time-to-8-copies of
+                one hot model on a 9-instance fleet whose model STORE has
+                contended egress (concurrent store downloads serialize,
+                the BLITZSCALE premise), store-only vs peer weight
+                streaming. Store-only pays ~8 serialized store loads;
+                with MM_PEER_FETCH the 7 receivers wait for copy #1's
+                pending claim and then stream from it, so time-to-8 is
+                bounded by ~one store load + transfers.
+  host_rewarm — demote/re-warm through the host-RAM staging tier: load,
+                evict (the copy demotes to a host snapshot), reload —
+                a device copy from host RAM vs a cold store load.
 
 Each scenario runs both modes (serial baseline: fastpath off, coalescing
 off; pipelined: both on) and reports the speedup / write reduction.
@@ -96,6 +107,85 @@ class _LifecycleLoader(ModelLoader):
     @property
     def requires_unload(self) -> bool:
         return False
+
+
+class _ContendedStore:
+    """Shared model-store egress: one download at a time (the flash-crowd
+    bottleneck BLITZSCALE targets — N concurrent pulls of the same hot
+    model share the store's bandwidth, so N loads cost ~N x one load)."""
+
+    def __init__(self):
+        self._gate = __import__("threading").Lock()
+        self.loads = 0
+
+    def download(self, seconds: float) -> None:
+        with self._gate:
+            self.loads += 1
+            if seconds:
+                time.sleep(seconds)
+
+
+class _StreamingLoader(ModelLoader):
+    """Transfer-capable bench loader: store loads pull through the shared
+    contended store; streamed loads (peer fetch / host re-warm) cost
+    ``stream_ms`` of local copy time."""
+
+    CHUNKS = 8
+    MODEL_BYTES = 256 * 1024
+
+    def __init__(self, store: _ContendedStore, load_ms: float,
+                 stream_ms: float = 1.0):
+        self.store = store
+        self.load_ms = load_ms
+        self.stream_ms = stream_ms
+        self.store_loads = 0
+        self.stream_loads = 0
+
+    def startup(self) -> LocalInstanceParams:
+        return LocalInstanceParams(
+            capacity_bytes=1 << 30, load_timeout_ms=60_000,
+            default_model_size_bytes=self.MODEL_BYTES,
+        )
+
+    def load(self, model_id: str, info: ModelInfo) -> LoadedModel:
+        self.store.download(self.load_ms / 1e3)
+        self.store_loads += 1
+        return LoadedModel(handle=model_id, size_bytes=self.MODEL_BYTES)
+
+    def predict_size(self, model_id: str, info: ModelInfo) -> int:
+        return self.MODEL_BYTES
+
+    def unload(self, model_id: str) -> None:
+        pass
+
+    @property
+    def requires_unload(self) -> bool:
+        return False
+
+    @property
+    def supports_weight_streaming(self) -> bool:
+        return True
+
+    def export_weights(self, model_id: str, handle):
+        from modelmesh_tpu.runtime.spi import WeightChunk
+
+        payload = b"w" * (self.MODEL_BYTES // self.CHUNKS)
+        return iter([
+            WeightChunk(seq=i, payload=payload, layer=i,
+                        last=i == self.CHUNKS - 1)
+            for i in range(self.CHUNKS)
+        ])
+
+    def load_from_stream(self, model_id, info, chunks, partial_ready=None):
+        n = 0
+        for _ in chunks:
+            n += 1
+            if self.stream_ms:
+                time.sleep(self.stream_ms / 1e3 / self.CHUNKS)
+        if n == 0:
+            raise RuntimeError("empty stream")
+        self.stream_loads += 1
+        return LoadedModel(handle=model_id, size_bytes=self.MODEL_BYTES)
 
 
 class _CountingKV:
@@ -233,6 +323,128 @@ def _measure_n_copies(fastpath: bool, n_copies: int, fleet: int,
     }
 
 
+def _streaming_fleet(n, kv, peer_fetch: bool, load_ms: float,
+                     stream_ms: float = 1.0):
+    """n instances sharing one contended store, with both internal
+    transports (Forward + FetchWeights) as direct calls."""
+    store = _ContendedStore()
+    by_endpoint = {}
+
+    def peer_call(endpoint, model_id, method, payload, headers, ctx):
+        return by_endpoint[endpoint].invoke_model(
+            model_id, method, payload, headers, ctx, sync=True
+        )
+
+    def peer_fetch_call(endpoint, model_id, chunk_index, fingerprint):
+        return by_endpoint[endpoint].handle_weight_fetch(
+            model_id, chunk_index, fingerprint
+        )
+
+    loaders, insts = [], []
+    for i in range(n):
+        loader = _StreamingLoader(store, load_ms, stream_ms)
+        loaders.append(loader)
+        inst = ModelMeshInstance(
+            kv,
+            loader,
+            InstanceConfig(
+                instance_id=f"i-{i:02d}", endpoint=f"ep-{i:02d}",
+                load_timeout_s=60, min_churn_age_ms=0,
+                load_fastpath=True, publish_coalesce_ms=0,
+                peer_fetch=peer_fetch,
+            ),
+            peer_call=peer_call,
+            peer_fetch=peer_fetch_call,
+            runtime_call=(
+                lambda ce, method, payload, headers, cancel_event=None:
+                payload
+            ),
+        )
+        by_endpoint[inst.config.endpoint] = inst
+        insts.append(inst)
+    for inst in insts:
+        inst.instances_view.wait_for(lambda v: len(v) >= n, timeout=30)
+    return insts, loaders, store
+
+
+def _measure_flash_crowd(peer_fetch: bool, copies: int, fleet: int,
+                         load_ms: float, reps: int) -> dict:
+    samples, store_loads, stream_loads = [], [], []
+    for r in range(reps):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        insts, loaders, store = _streaming_fleet(
+            fleet, kv, peer_fetch, load_ms
+        )
+        inst = insts[0]
+        mid = f"hot-{r}"
+        inst.register_model(mid, INFO)
+        t0 = time.perf_counter()
+        inst.ensure_loaded(mid, sync=True, chain=copies - 1)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            mr = inst.registry.get(mid)
+            if mr is not None and len(mr.instance_ids) >= copies:
+                break
+            time.sleep(0.002)
+        samples.append((time.perf_counter() - t0) * 1e3)
+        mr = inst.registry.get(mid)
+        got = len(mr.instance_ids) if mr else 0
+        store_loads.append(sum(ld.store_loads for ld in loaders))
+        stream_loads.append(sum(ld.stream_loads for ld in loaders))
+        _close(insts, kv)
+        assert got >= copies, f"only {got}/{copies} copies materialized"
+    return {
+        "reps": reps,
+        "copies": copies,
+        "fleet": fleet,
+        "load_ms": load_ms,
+        "time_to_n_ms": round(statistics.median(samples), 1),
+        "store_loads": max(store_loads),
+        "stream_loads": min(stream_loads),
+    }
+
+
+def _measure_host_rewarm(load_ms: float, reps: int) -> dict:
+    cold, rewarm = [], []
+    for r in range(reps):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        insts, loaders, _ = _streaming_fleet(1, kv, True, load_ms)
+        inst, loader = insts[0], loaders[0]
+        mid = f"warm-{r}"
+        inst.register_model(mid, INFO)
+        t0 = time.perf_counter()
+        inst.ensure_loaded(mid, sync=True)
+        cold.append((time.perf_counter() - t0) * 1e3)
+        # Capacity eviction -> demotion into the host tier.
+        inst.cache.set_capacity(1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            mr = inst.registry.get(mid)
+            if (
+                inst.host_tier.peek(mid) is not None
+                and mr is not None
+                and inst.instance_id in mr.host_instances
+            ):
+                break
+            time.sleep(0.002)
+        assert inst.host_tier.peek(mid) is not None, "demotion never landed"
+        inst.cache.set_capacity(1 << 17)
+        t0 = time.perf_counter()
+        inst.ensure_loaded(mid, sync=True)
+        rewarm.append((time.perf_counter() - t0) * 1e3)
+        assert loader.stream_loads >= 1, "re-warm paid a store load"
+        _close(insts, kv)
+    cold_ms = round(statistics.median(cold), 1)
+    rewarm_ms = round(statistics.median(rewarm), 2)
+    return {
+        "reps": reps,
+        "load_ms": load_ms,
+        "cold_store_ms": cold_ms,
+        "rewarm_ms": rewarm_ms,
+        "speedup": round(cold_ms / max(rewarm_ms, 1e-9), 1),
+    }
+
+
 def _measure_mass_load(fastpath: bool, coalesce_ms: int,
                        models: int) -> dict:
     inner = InMemoryKV(sweep_interval_s=3600.0)
@@ -260,13 +472,21 @@ def _measure_mass_load(fastpath: bool, coalesce_ms: int,
 
 
 def run(load_ms: float = 80.0, size_ms: float = 80.0, n_copies: int = 4,
-        fleet: int = 5, mass_models: int = 500, reps: int = 3) -> dict:
+        fleet: int = 5, mass_models: int = 500, reps: int = 3,
+        crowd_copies: int = 8, crowd_fleet: int = 9) -> dict:
     serial_fs = _measure_first_serve(False, load_ms, size_ms, reps)
     fast_fs = _measure_first_serve(True, load_ms, size_ms, reps)
     serial_nc = _measure_n_copies(False, n_copies, fleet, load_ms, reps)
     fast_nc = _measure_n_copies(True, n_copies, fleet, load_ms, reps)
     serial_ml = _measure_mass_load(False, 0, mass_models)
     fast_ml = _measure_mass_load(True, 25, mass_models)
+    crowd_store = _measure_flash_crowd(
+        False, crowd_copies, crowd_fleet, load_ms, reps
+    )
+    crowd_peer = _measure_flash_crowd(
+        True, crowd_copies, crowd_fleet, load_ms, reps
+    )
+    rewarm = _measure_host_rewarm(load_ms, reps)
     return {
         "first_serve": {
             "serial": serial_fs,
@@ -294,6 +514,24 @@ def run(load_ms: float = 80.0, size_ms: float = 80.0, n_copies: int = 4,
                 / max(fast_ml["standalone_publish_puts"], 1), 1
             ),
         },
+        "flash_crowd": {
+            "store_only": crowd_store,
+            "peer_stream": crowd_peer,
+            "single_store_load_ms": load_ms,
+            # time-to-8 over ONE store load: store-only ~copies x,
+            # peer streaming must stay < 2x.
+            "store_only_vs_single_load": round(
+                crowd_store["time_to_n_ms"] / load_ms, 2
+            ),
+            "peer_stream_vs_single_load": round(
+                crowd_peer["time_to_n_ms"] / load_ms, 2
+            ),
+            "speedup": round(
+                crowd_store["time_to_n_ms"]
+                / max(crowd_peer["time_to_n_ms"], 1e-9), 2
+            ),
+        },
+        "host_rewarm": rewarm,
     }
 
 
@@ -305,10 +543,12 @@ def main() -> int:
     ap.add_argument("--fleet", type=int, default=5)
     ap.add_argument("--mass-models", type=int, default=500)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--crowd-copies", type=int, default=8)
+    ap.add_argument("--crowd-fleet", type=int, default=9)
     args = ap.parse_args()
     print(json.dumps(run(
         args.load_ms, args.size_ms, args.n_copies, args.fleet,
-        args.mass_models, args.reps,
+        args.mass_models, args.reps, args.crowd_copies, args.crowd_fleet,
     )))
     return 0
 

@@ -40,7 +40,7 @@ from typing import Any, Callable, Generic, Iterator, Optional, TypeVar
 # here; routing it through the injectable clock (utils/clock.py) puts the
 # whole LRU/lifecycle timestamp domain under simulated virtual time.
 from modelmesh_tpu.utils.clock import now_ms  # noqa: F401 — re-export
-from modelmesh_tpu.utils.lockdebug import mm_rlock
+from modelmesh_tpu.utils.lockdebug import mm_lock, mm_rlock
 
 K = TypeVar("K")
 V = TypeVar("V")
@@ -304,3 +304,126 @@ class WeightedLRUCache(Generic[K, V]):
         if skipped is not None:
             heapq.heappush(self._heap, skipped)
         return None
+
+
+# listener(key, value, size_bytes) — called under the host-tier lock; must
+# not block (schedule follow-up work, like the device eviction listener).
+HostEvictionListener = Callable[[Any, Any, int], None]
+
+
+class HostTier(Generic[K, V]):
+    """Host-RAM staging tier under the device cache: the demote target.
+
+    Device eviction demotes a copy's serialized weights here instead of
+    dropping them entirely, so a re-warm is a host->device copy (and a
+    peer fetch can be served O(1) from host RAM) rather than a model-store
+    load — the BLITZSCALE tiered-caching layer. Accounting is in BYTES
+    and entirely separate from the device cache's unit accounting:
+    ``used_bytes <= capacity_bytes`` always, with LRU eviction by
+    last-touch time on insert pressure. ``capacity_bytes <= 0`` disables
+    the tier (every put is rejected).
+
+    Values are opaque to the tier (the transfer layer stores serialized
+    chunk snapshots); ``get`` touches recency, ``peek`` doesn't — the
+    same quiet/touch split as the device cache above.
+    """
+
+    def __init__(
+        self,
+        capacity_bytes: int,
+        eviction_listener: Optional[HostEvictionListener] = None,
+    ) -> None:
+        self._capacity = max(int(capacity_bytes), 0)
+        self._listener = eviction_listener
+        # key -> (value, size_bytes, last_used, seq)
+        self._copies: dict[K, list] = {}  #: guarded-by: _lock
+        self._used = 0  #: guarded-by: _lock
+        self._seq = 0  #: guarded-by: _lock
+        self._lock = mm_lock("HostTier._lock")
+
+    @property
+    def enabled(self) -> bool:
+        return self._capacity > 0
+
+    @property
+    def capacity_bytes(self) -> int:
+        return self._capacity
+
+    @property
+    def used_bytes(self) -> int:
+        return self._used
+
+    def __len__(self) -> int:
+        return len(self._copies)
+
+    def __contains__(self, key: K) -> bool:
+        return key in self._copies
+
+    def keys(self) -> list[K]:
+        return list(self._copies.keys())
+
+    def put(self, key: K, value: V, size_bytes: int) -> bool:
+        """Insert/replace a host copy; False when the tier is disabled or
+        the copy alone exceeds the host budget (caller falls back to a
+        plain drop — demotion is best-effort by design). Insertion may
+        evict older host copies (never the new one)."""
+        size_bytes = int(size_bytes)
+        if size_bytes <= 0 or size_bytes > self._capacity:
+            return False
+        with self._lock:
+            prev = self._copies.pop(key, None)
+            if prev is not None:
+                self._used -= prev[1]
+            self._seq += 1
+            self._copies[key] = [value, size_bytes, now_ms(), self._seq]
+            self._used += size_bytes
+            self._evict_over_capacity_locked(exclude=key)
+            return True
+
+    def get(self, key: K) -> Optional[V]:
+        """Lookup, refreshing recency (a re-warm / peer-fetch source hit).
+        The sequence bumps with the timestamp so same-millisecond touches
+        still order exactly (ms granularity is coarser than transfers)."""
+        with self._lock:
+            entry = self._copies.get(key)
+            if entry is None:
+                return None
+            self._seq += 1
+            entry[2] = now_ms()
+            entry[3] = self._seq
+            return entry[0]
+
+    def peek(self, key: K) -> Optional[V]:
+        entry = self._copies.get(key)
+        return None if entry is None else entry[0]
+
+    def size_of(self, key: K) -> int:
+        entry = self._copies.get(key)
+        return 0 if entry is None else entry[1]
+
+    def remove(self, key: K) -> Optional[V]:
+        with self._lock:
+            entry = self._copies.pop(key, None)
+            if entry is None:
+                return None
+            self._used -= entry[1]
+            return entry[0]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._copies.clear()
+            self._used = 0
+
+    def _evict_over_capacity_locked(self, exclude: Optional[K] = None) -> None:
+        while self._used > self._capacity and self._copies:
+            victims = [
+                (e[2], e[3], k)
+                for k, e in self._copies.items() if k != exclude
+            ]
+            if not victims:
+                return  # only the excluded entry remains
+            _, _, victim = min(victims)
+            value, size, _, _ = self._copies.pop(victim)
+            self._used -= size
+            if self._listener is not None:
+                self._listener(victim, value, size)

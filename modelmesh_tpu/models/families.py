@@ -362,6 +362,16 @@ def build_transformer(spec: ModelSpec, model_id: str) -> ServableModel:
     return ServableModel(apply, params, (seq,), np.int32)
 
 
+# Families whose parameters stream in a layer-by-layer servable order
+# (embeddings/first blocks land first), so a copy may begin serving
+# mid-transfer (the serving layer's PARTIAL entry phase). Conv and
+# embedding-bag families are deliberately absent: their single dense
+# readout depends on every preceding parameter, so there is no useful
+# prefix to serve. Consumed lazily by transfer/protocol.py
+# (is_layer_streamable) so the serving core doesn't import JAX for
+# routing decisions.
+LAYER_STREAMABLE_FAMILIES = frozenset({"transformer", "mlp"})
+
 FAMILIES: dict[str, Callable[[ModelSpec, str], ServableModel]] = {
     "mlp": build_mlp,
     "linear": build_linear,

@@ -66,6 +66,11 @@ class JaxModelStore:
             self._models[model_id] = model
         return model.size_bytes
 
+    def install(self, model_id: str, model: ServableModel) -> None:
+        """Register an externally-materialized model (stream-loaded)."""
+        with self._lock:
+            self._models[model_id] = model
+
     def unload(self, model_id: str) -> bool:
         with self._lock:
             return self._models.pop(model_id, None) is not None
@@ -257,6 +262,102 @@ class InProcessJaxLoader(ModelLoader[ServableModel]):
     @property
     def requires_unload(self) -> bool:
         return True
+
+    # -- weight streaming (transfer/ subsystem) ----------------------------
+
+    @property
+    def supports_weight_streaming(self) -> bool:
+        return True
+
+    def export_weights(self, model_id: str, handle: ServableModel):
+        """Chunk stream over the parameter leaves in canonical tree
+        order: one layer index per leaf, large leaves split across
+        chunks. The receiver rebuilds arrays against the deterministic
+        architecture skeleton, so no dtype/shape header is needed on the
+        wire."""
+        import jax
+        import numpy as np
+
+        from modelmesh_tpu.runtime.spi import WeightChunk
+        from modelmesh_tpu.utils import envs
+
+        if handle is None:
+            handle = self.store.get(model_id)
+        if handle is None:
+            return None
+        chunk_bytes = max(envs.get_int("MM_TRANSFER_CHUNK_BYTES"), 1)
+        leaves = jax.tree.leaves(handle.params)
+
+        def gen():
+            seq = 0
+            for layer, leaf in enumerate(leaves):
+                blob = np.asarray(leaf).tobytes()
+                pieces = [
+                    blob[i: i + chunk_bytes]
+                    for i in range(0, len(blob), chunk_bytes)
+                ] or [b""]
+                for j, piece in enumerate(pieces):
+                    last_leaf = layer == len(leaves) - 1
+                    yield WeightChunk(
+                        seq=seq,
+                        payload=piece,
+                        layer=layer,
+                        last=last_leaf and j == len(pieces) - 1,
+                    )
+                    seq += 1
+
+        return gen()
+
+    def load_from_stream(
+        self, model_id: str, info: ModelInfo, chunks, partial_ready=None,
+    ) -> LoadedModel[ServableModel]:
+        """Materialize from a transfer stream: receive leaf bytes, then
+        graft them onto the deterministic architecture skeleton. The
+        skeleton provides apply/treedef/dtypes/shapes; the received
+        bytes provide the values — a shape/size mismatch is a corrupt
+        or mismatched stream and fails the load. ``partial_ready`` is
+        deliberately NOT armed here: a JAX model with missing layers
+        cannot produce correct logits, so this runtime only serves
+        complete copies (synthetic sim/bench loaders exercise the
+        partial-serve machinery)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        by_layer: dict[int, list[bytes]] = {}
+        for chunk in chunks:
+            by_layer.setdefault(chunk.layer, []).append(chunk.payload)
+        try:
+            skeleton = build_model(model_id, info.model_type, info.model_path)
+        except ValueError as e:
+            raise ModelLoadException(str(e)) from e
+        leaves, treedef = jax.tree.flatten(skeleton.params)
+        if sorted(by_layer) != list(range(len(leaves))):
+            raise ModelLoadException(
+                f"{model_id}: stream delivered layers {sorted(by_layer)} "
+                f"but the architecture has {len(leaves)} leaves"
+            )
+        new_leaves = []
+        for i, leaf in enumerate(leaves):
+            blob = b"".join(by_layer[i])
+            want = leaf.size * leaf.dtype.itemsize
+            if len(blob) != want:
+                raise ModelLoadException(
+                    f"{model_id}: layer {i} byte length {len(blob)} != "
+                    f"expected {want} (corrupt stream)"
+                )
+            arr = np.frombuffer(blob, dtype=leaf.dtype).reshape(leaf.shape)
+            new_leaves.append(jnp.asarray(arr))
+        params = jax.tree.unflatten(treedef, new_leaves)
+        model = ServableModel(
+            skeleton.apply, params, skeleton.input_shape, skeleton.input_dtype
+        )
+        # Warm like a store load: first inference must not be a compile.
+        jax.block_until_ready(jax.tree.leaves(model.params))
+        warm = np.zeros((1, *model.input_shape), model.input_dtype)
+        model.predict_bytes(warm.tobytes())
+        self.store.install(model_id, model)
+        return LoadedModel(handle=model, size_bytes=model.size_bytes)
 
 
 def main() -> None:

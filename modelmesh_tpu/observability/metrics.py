@@ -43,6 +43,26 @@ class Metric(enum.Enum):
     LOAD_TIMEOUT_COUNT = ("mm_load_timeout_count", "counter", "waits that hit the load bound")
     CANCEL_COUNT = ("mm_cancel_count", "counter", "client-cancelled requests")
     MULTI_MODEL_COUNT = ("mm_multi_model_count", "counter", "multi-model fan-out calls")
+    # weight-transfer subsystem (transfer/): per-source load counters +
+    # stream accounting
+    LOAD_FROM_STORE_COUNT = ("mm_load_source_store_count", "counter",
+                             "loads materialized from the model store")
+    LOAD_FROM_PEER_COUNT = ("mm_load_source_peer_count", "counter",
+                            "loads streamed from a live peer")
+    LOAD_FROM_HOST_TIER_COUNT = ("mm_load_source_host_count", "counter",
+                                 "loads re-warmed from the host-RAM tier")
+    TRANSFER_FALLBACK_COUNT = ("mm_transfer_fallback_count", "counter",
+                               "peer streams abandoned mid-transfer (fell back to store)")
+    TRANSFER_TX_BYTES = ("mm_transfer_tx_bytes_total", "counter",
+                         "weight bytes served to peer fetchers")
+    TRANSFER_RX_BYTES = ("mm_transfer_rx_bytes_total", "counter",
+                         "weight bytes received over transfer streams")
+    HOST_TIER_DEMOTE_COUNT = ("mm_host_tier_demote_count", "counter",
+                              "evicted copies demoted into the host tier")
+    HOST_TIER_EVICT_COUNT = ("mm_host_tier_evict_count", "counter",
+                             "snapshots evicted from the host tier")
+    PARTIAL_SERVE_COUNT = ("mm_partial_serve_count", "counter",
+                           "copies that began serving mid-transfer (PARTIAL)")
     # histograms (ms)
     API_REQUEST_TIME = ("mm_api_request_time_ms", "histogram", "request latency")
     LOAD_TIME = ("mm_load_time_ms", "histogram", "model load time")
@@ -60,6 +80,12 @@ class Metric(enum.Enum):
     PENDING_UNLOAD_UNITS = ("mm_pending_unload_units", "gauge", "units awaiting unload")
     INSTANCE_RPM = ("mm_instance_rpm", "gauge", "instance requests/min")
     LRU_AGE_SECONDS = ("mm_lru_age_seconds", "gauge", "age of oldest cache entry")
+    TRANSFER_THROUGHPUT_MBPS = ("mm_transfer_throughput_mbps", "gauge",
+                                "last completed transfer's MB/s")
+    HOST_TIER_USED_BYTES = ("mm_host_tier_used_bytes", "gauge",
+                            "host-RAM staging tier bytes in use")
+    HOST_TIER_MODELS = ("mm_host_tier_models", "gauge",
+                        "snapshots resident in the host tier")
     # Leader-published cluster totals (reaper cadence; reference leader
     # gauges, Metric.java cluster scope).
     CLUSTER_INSTANCES = ("mm_cluster_instances", "gauge", "live instances (leader)")

@@ -59,6 +59,13 @@ class ModelRecord(Record):
     # instance_id -> claim timestamp (ms): copies being loaded right now.
     # Acts as the placement claim so concurrent placements don't double-load.
     loading_instances: dict[str, int] = dataclasses.field(default_factory=dict)
+    # instance_id -> demotion timestamp (ms): instances holding a HOST-RAM
+    # snapshot of the weights (transfer/ tier) but NO device copy. Not
+    # servable — never part of all_placements/copy_count — but valid
+    # peer-fetch sources, so a re-scale-up streams from host RAM instead
+    # of the model store. Cleared with the instance by remove_instance
+    # (reaper pruning of dead instances covers host claims for free).
+    host_instances: dict[str, int] = dataclasses.field(default_factory=dict)
     # instance_id -> [failure_ts_ms, message]
     load_failures: dict[str, list] = dataclasses.field(default_factory=dict)
     ref_count: int = 0           # vmodel references
@@ -77,12 +84,37 @@ class ModelRecord(Record):
 
     def promote_loaded(self, instance_id: str, ts: Optional[int] = None) -> None:
         self.loading_instances.pop(instance_id, None)
+        # A device copy supersedes any stale host claim for the same
+        # instance (re-warm promoted the host snapshot back to device).
+        self.host_instances.pop(instance_id, None)
+        self.instance_ids[instance_id] = ts if ts is not None else now_ms()
+
+    def promote_partial(self, instance_id: str, ts: Optional[int] = None) -> None:
+        """Mid-transfer (PARTIAL) promotion: the copy becomes routable
+        (listed in ``instance_ids``) while the ORIGINAL loading claim is
+        kept — the claim tells peers the copy is not yet a valid
+        weight-transfer source and preserves the strict claim ordering
+        receivers wait on. ``promote_loaded`` at stream completion (or
+        ``remove_instance`` on failure) clears it."""
+        self.host_instances.pop(instance_id, None)
+        if instance_id not in self.loading_instances:
+            self.loading_instances[instance_id] = (
+                ts if ts is not None else now_ms()
+            )
         self.instance_ids[instance_id] = ts if ts is not None else now_ms()
 
     def remove_instance(self, instance_id: str) -> bool:
         a = self.instance_ids.pop(instance_id, None) is not None
         b = self.loading_instances.pop(instance_id, None) is not None
-        return a or b
+        c = self.host_instances.pop(instance_id, None) is not None
+        return a or b or c
+
+    def claim_host_copy(self, instance_id: str, ts: Optional[int] = None) -> None:
+        """Advertise a host-tier (demoted) snapshot on this instance."""
+        self.host_instances[instance_id] = ts if ts is not None else now_ms()
+
+    def drop_host_copy(self, instance_id: str) -> bool:
+        return self.host_instances.pop(instance_id, None) is not None
 
     def placed_on(self, instance_id: str) -> bool:
         return (

@@ -13,7 +13,12 @@ from typing import Callable, Mapping, Type
 
 import grpc
 
-from modelmesh_tpu.proto import mesh_api_pb2, mesh_internal_pb2, mesh_runtime_pb2
+from modelmesh_tpu.proto import (
+    mesh_api_pb2,
+    mesh_internal_pb2,
+    mesh_runtime_pb2,
+    mesh_transfer_pb2,
+)
 
 # Metadata keys carrying the model/vmodel id on inference calls
 # (reference: GrpcSupport.java:110-126).
@@ -58,6 +63,11 @@ INTERNAL_SERVICE = "mmtpu.internal.MeshInternal"
 INTERNAL_METHODS: _MethodMap = {
     "Forward": (
         mesh_internal_pb2.ForwardRequest, mesh_internal_pb2.ForwardResponse),
+    # Weight-transfer fetch (live scale-up): chunk-indexed peer pull of a
+    # model's weight snapshot, served beside Forward on the internal port.
+    "FetchWeights": (
+        mesh_transfer_pb2.FetchWeightsRequest,
+        mesh_transfer_pb2.FetchWeightsResponse),
 }
 
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
-from typing import Generic, Optional, TypeVar
+from typing import Callable, Generic, Iterator, Optional, TypeVar
 
 # Cache accounting unit (reference: 8 KiB, ModelLoader.java:37).
 CACHE_UNIT_BYTES = 8 * 1024
@@ -56,6 +56,20 @@ class ModelNotLoadedError(Exception):
     the serving layer purges its entry and retries elsewhere."""
 
 
+@dataclasses.dataclass(frozen=True)
+class WeightChunk:
+    """One unit of a streamed weight transfer (peer fetch / host-tier
+    re-warm). ``layer`` tags the model layer this chunk completes for
+    layer-streamable families (-1 = not layer-aligned); ``last`` marks
+    the end of the stream so a receiver can distinguish a complete
+    transfer from a truncated one."""
+
+    seq: int
+    payload: bytes
+    layer: int = -1
+    last: bool = False
+
+
 class ModelLoader(abc.ABC, Generic[T]):
     """Per-instance loading SPI. All methods may block; the serving core
     runs them on its loading pool with timeouts."""
@@ -86,6 +100,48 @@ class ModelLoader(abc.ABC, Generic[T]):
         """True if capacity isn't freed until unload completes (drives the
         unload-buffer accounting, ModelCacheUnloadBufManager)."""
         return True
+
+    # -- weight streaming (optional capability; transfer/ subsystem) -------
+
+    @property
+    def supports_weight_streaming(self) -> bool:
+        """True when this loader implements the ``export_weights`` /
+        ``load_from_stream`` pair. The serving layer gates every transfer
+        decision (peer fetch, host-tier demotion, serve-before-loaded) on
+        this flag — a plain store-only loader is never asked to stream."""
+        return False
+
+    def export_weights(
+        self, model_id: str, handle: T
+    ) -> Optional[Iterator[WeightChunk]]:
+        """Serialize a LOADED model's weights as an ordered chunk stream
+        (the peer-fetch / host-demotion source). None = unsupported or the
+        runtime can't export this model right now. Chunks must be
+        reproducible for the same loaded copy; the final chunk must carry
+        ``last=True``."""
+        return None
+
+    def load_from_stream(
+        self,
+        model_id: str,
+        info: ModelInfo,
+        chunks: Iterator[WeightChunk],
+        partial_ready: Optional[Callable[["LoadedModel[T]"], None]] = None,
+    ) -> "LoadedModel[T]":
+        """Materialize a model from a chunk stream instead of the model
+        store (peer fetch or host-tier re-warm).
+
+        Contract: loader-side failures raise ``ModelLoadException``;
+        exceptions raised BY the chunk iterator (peer death, stream error
+        mid-transfer) must propagate unwrapped so the serving layer can
+        fall back to a store load. ``partial_ready(loaded)`` may be called
+        at most once, as soon as enough layers have landed to serve
+        requests (layer-streamable families only) — the handle passed must
+        already be usable for inference at that point.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support weight streaming"
+        )
 
 
 @dataclasses.dataclass

@@ -35,6 +35,7 @@ from modelmesh_tpu.observability.tracing import (
 
 from modelmesh_tpu.proto import mesh_api_pb2 as apb
 from modelmesh_tpu.proto import mesh_internal_pb2 as ipb
+from modelmesh_tpu.proto import mesh_transfer_pb2 as tpb
 from modelmesh_tpu.runtime import grpc_defs
 from modelmesh_tpu.runtime.spi import ModelInfo
 from modelmesh_tpu.serving.errors import (
@@ -277,6 +278,26 @@ class MeshInternalServicer:
             payload=result.payload,
             served_by=result.served_by,
             model_status=_STATUS_MAP.get(result.status, apb.UNKNOWN),
+        )
+
+    def FetchWeights(self, request, context):
+        """Weight-transfer fetch (live scale-up): one chunk of this
+        instance's snapshot of the model. Stateless per call; failures
+        the receiver should treat as 'try another source' come back as a
+        NOT_AVAILABLE status rather than an RPC error."""
+        reply = self.instance.handle_weight_fetch(
+            request.model_id, request.chunk_index, request.fingerprint
+        )
+        return tpb.FetchWeightsResponse(
+            status=reply.status,
+            payload=reply.payload,
+            seq=reply.seq,
+            layer=reply.layer,
+            last=reply.last,
+            total_chunks=reply.total_chunks,
+            total_bytes=reply.total_bytes,
+            total_layers=reply.total_layers,
+            fingerprint=reply.fingerprint,
         )
 
 
@@ -732,3 +753,51 @@ def make_grpc_peer_call(channels: Optional[PeerChannels] = None,
 
     peer_call.channels = channels  # for cleanup
     return peer_call
+
+
+def make_grpc_peer_fetch(channels: Optional[PeerChannels] = None,
+                         timeout_s: float = 30.0, tls=None):
+    """Build the instance's weight-fetch transport over gRPC (the
+    FetchWeights method beside Forward). Share the ``channels`` cache
+    with ``make_grpc_peer_call`` so both internal surfaces multiplex one
+    connection per peer."""
+    from modelmesh_tpu.transfer.protocol import FetchReply
+
+    if channels is not None and tls is not None:
+        raise ValueError(
+            "pass tls to the PeerChannels cache, not alongside it — a "
+            "caller-supplied cache keeps its own transport security"
+        )
+    channels = channels or PeerChannels(tls)
+
+    def peer_fetch(endpoint: str, model_id: str, chunk_index: int,
+                   fingerprint: str) -> FetchReply:
+        stub = grpc_defs.make_stub(
+            channels.get(endpoint), grpc_defs.INTERNAL_SERVICE,
+            grpc_defs.INTERNAL_METHODS,
+        )
+        req = tpb.FetchWeightsRequest(
+            model_id=model_id, chunk_index=chunk_index,
+            fingerprint=fingerprint,
+        )
+        try:
+            resp = stub.FetchWeights(req, timeout=timeout_s)
+        except grpc.RpcError as e:
+            # Transport-level failure (peer death, deadline): surfaced as
+            # the mesh's unavailable error so the transfer manager's
+            # mid-stream fallback takes over.
+            raise ServiceUnavailableError(endpoint) from e
+        return FetchReply(
+            status=resp.status,
+            payload=resp.payload,
+            seq=resp.seq,
+            layer=resp.layer,
+            last=resp.last,
+            total_chunks=resp.total_chunks,
+            total_bytes=resp.total_bytes,
+            total_layers=resp.total_layers,
+            fingerprint=resp.fingerprint,
+        )
+
+    peer_fetch.channels = channels
+    return peer_fetch

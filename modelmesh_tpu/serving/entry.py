@@ -47,6 +47,12 @@ class EntryState(enum.Enum):
     WAITING = "waiting"      # waiting for unload space
     LOADING = "loading"
     SIZING = "sizing"
+    # Serve-before-fully-loaded (layer-streamable families only): enough
+    # layers of a streamed transfer have landed to admit requests, while
+    # the tail of the stream is still arriving. Servable AND still
+    # loading; finalizes to ACTIVE when the stream completes (or FAILED/
+    # REMOVED like any in-flight load).
+    PARTIAL = "partial"
     ACTIVE = "active"
     FAILED = "failed"
     REMOVED = "removed"
@@ -59,8 +65,14 @@ class EntryState(enum.Enum):
     def is_loading(self) -> bool:
         return self in (
             EntryState.QUEUED, EntryState.WAITING,
-            EntryState.LOADING, EntryState.SIZING,
+            EntryState.LOADING, EntryState.SIZING, EntryState.PARTIAL,
         )
+
+    @property
+    def is_servable(self) -> bool:
+        """Requests may execute against this copy (fully loaded, or a
+        partial streamed copy past its serve threshold)."""
+        return self in (EntryState.ACTIVE, EntryState.PARTIAL)
 
 
 class CacheEntry:
@@ -93,6 +105,9 @@ class CacheEntry:
         # (QUEUED -> LOADING starts the per-type load clock).
         self._state_cv = mm_condition("CacheEntry._state_cv", self._lock)
         self._sem: Optional[threading.Semaphore] = None  #: guarded-by: _lock
+        # True once begin_partial installed a provisional runtime copy
+        # (sticky — survives later state transitions; see _load_failed).
+        self.partial_started = False
         self.max_concurrency = 0
         self.inflight = 0  #: guarded-by: _lock
         self.total_invocations = 0  #: guarded-by: _lock
@@ -145,6 +160,39 @@ class CacheEntry:
             self._state_cv.notify_all()
             return True
 
+    def claim_chain_fire(self) -> bool:
+        """Atomically claim the one-shot chained-fan-out trigger: True for
+        exactly ONE caller across every path that can fire the chain
+        (claim-time, ride-a-loading-entry, servable hit, completion) —
+        a plain check-then-set raced when two async requests rode the
+        same in-flight load."""
+        with self._lock:
+            if getattr(self, "_chain_fired", False):
+                return False
+            self._chain_fired = True
+            return True
+
+    def begin_partial(self, loaded: LoadedModel) -> bool:
+        """Admit requests on a partially-streamed copy: install the
+        (already-servable) provisional handle and move to PARTIAL. Returns
+        False when the entry is already terminal (evicted/failed mid-
+        stream) — the caller abandons the early-serve and lets the stream
+        outcome decide. Idempotent-ish: a second call just refreshes the
+        handle."""
+        with self._lock:
+            if self.state.is_terminal:
+                return False
+            self.loaded = loaded
+            # Sticky: a provisional runtime copy is resident from here on,
+            # even if a later eviction moves the STATE off PARTIAL — the
+            # failure path keys its unload on this, not on the state.
+            self.partial_started = True
+            if loaded.max_concurrency and self._sem is None:
+                self.max_concurrency = loaded.max_concurrency
+                self._sem = threading.Semaphore(loaded.max_concurrency)
+            self._transition_locked(EntryState.PARTIAL)
+            return True
+
     def complete_load(self, loaded: LoadedModel) -> bool:
         """Finalize to ACTIVE unless removed meanwhile. Returns False if the
         entry was removed — caller must release the runtime copy."""
@@ -153,7 +201,9 @@ class CacheEntry:
                 return False
             self.loaded = loaded
             self.load_completed_ms = now_ms()
-            if loaded.max_concurrency:
+            if loaded.max_concurrency and self._sem is None:
+                # Keep a semaphore installed at PARTIAL time: requests may
+                # already hold slots on it — swapping would leak permits.
                 self.max_concurrency = loaded.max_concurrency
                 self._sem = threading.Semaphore(loaded.max_concurrency)
             self._transition_locked(EntryState.ACTIVE)

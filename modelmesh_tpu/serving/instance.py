@@ -166,6 +166,8 @@ class InstanceConfig:
         read_only: Optional[bool] = None,
         load_fastpath: Optional[bool] = None,
         publish_coalesce_ms: Optional[int] = None,
+        peer_fetch: Optional[bool] = None,
+        host_tier_bytes: Optional[int] = None,
     ):
         self.instance_id = instance_id or f"i-{uuid.uuid4().hex[:8]}"
         self.kv_prefix = kv_prefix.rstrip("/")
@@ -207,6 +209,20 @@ class InstanceConfig:
 
             publish_coalesce_ms = envs.get_int("MM_PUBLISH_COALESCE_MS")
         self.publish_coalesce_ms = publish_coalesce_ms
+        # Live scale-up transfer path (transfer/): peer-to-peer weight
+        # streaming (MM_PEER_FETCH) and the host-RAM staging tier budget
+        # (MM_HOST_TIER_BYTES, 0 disables the tier). Both are inert
+        # unless the loader declares supports_weight_streaming. (Chunk
+        # granularity, MM_TRANSFER_CHUNK_BYTES, belongs to the exporting
+        # loader's serialization — no per-instance knob here.)
+        from modelmesh_tpu.utils import envs as _envs
+
+        if peer_fetch is None:
+            peer_fetch = _envs.get_bool("MM_PEER_FETCH")
+        self.peer_fetch = peer_fetch
+        if host_tier_bytes is None:
+            host_tier_bytes = _envs.get_int("MM_HOST_TIER_BYTES")
+        self.host_tier_bytes = host_tier_bytes
 
 
 class ModelMeshInstance:
@@ -222,6 +238,7 @@ class ModelMeshInstance:
         constraints=None,
         upgrade_tracker=None,
         probation=None,
+        peer_fetch=None,
     ):
         """``peer_call(endpoint, model_id, method, payload, headers, ctx)``
         forwards to a peer (gRPC in production, direct-call in tests).
@@ -230,7 +247,11 @@ class ModelMeshInstance:
         SidecarRuntime.call_model when the loader is a SidecarRuntime); a
         callable without the cancel_event parameter is still accepted —
         cancellation then can't interrupt the call itself, only the waits
-        around it."""
+        around it. ``peer_fetch(endpoint, model_id, chunk_index,
+        fingerprint) -> FetchReply`` pulls one weight chunk from a peer
+        (the mesh-internal FetchWeights channel; gRPC in production,
+        direct-call in the sim/bench) — None disables peer streaming on
+        this instance regardless of config.peer_fetch."""
         self.config = config or InstanceConfig()
         self.instance_id = self.config.instance_id
         self.load_fastpath = self.config.load_fastpath
@@ -326,6 +347,27 @@ class ModelMeshInstance:
         # this cache.
         self.route_cache = RouteCache()
         self._cluster_view_cache: Optional[ClusterView] = None
+
+        # Weight-transfer subsystem (transfer/): host-RAM staging tier +
+        # peer-to-peer streaming manager. The host-tier eviction listener
+        # only SCHEDULES the registry host-claim cleanup — it runs under
+        # the tier's lock.
+        from modelmesh_tpu.cache.lru import HostTier
+        from modelmesh_tpu.transfer.manager import (
+            TransferConfig,
+            WeightTransferManager,
+        )
+
+        self.transfer_config = TransferConfig(
+            peer_fetch=self.config.peer_fetch,
+            host_tier_bytes=self.config.host_tier_bytes,
+        )
+        self.host_tier = HostTier(
+            self.transfer_config.host_tier_bytes,
+            eviction_listener=self._on_host_tier_evict,
+        )
+        self.peer_fetch_transport = peer_fetch
+        self.transfer = WeightTransferManager(self)
 
         prefix = self.config.kv_prefix
         # Bucketed (128): scans page bucket-by-bucket so no range RPC
@@ -700,7 +742,8 @@ class ModelMeshInstance:
         mr = self.registry.get(model_id)
         if mr is None:
             return "NOT_FOUND", None
-        if ce is not None and ce.state is EntryState.ACTIVE:
+        if ce is not None and ce.state.is_servable:
+            # PARTIAL counts as LOADED: the copy is admitting requests.
             return "LOADED", mr
         if ce is not None and ce.state.is_loading:
             return "LOADING", mr
@@ -989,10 +1032,22 @@ class ModelMeshInstance:
         chain_count: int = 0, cancel_event=None,
     ) -> InvokeResult:
         if not sync and ce.state.is_loading:
+            # The chain must propagate even when the async request rides
+            # an IN-FLIGHT load it didn't start: this entry's own
+            # chain_load_count is whatever its original request carried,
+            # so a later ensure(chain=N) landing mid-load would silently
+            # truncate the fan-out (fresh loads fire in _load_local,
+            # servable hits below — this was the remaining gap). The
+            # fan-out excludes all current placements including our
+            # loading claim, and _chain_fired keeps every path
+            # single-shot.
+            if chain_count > 0 and ce.claim_chain_fire():
+                self._spawn_chain(ce.model_id, ce.last_used, chain_count)
             return InvokeResult(b"", self.instance_id, "LOADING")
-        if ce.state is not EntryState.ACTIVE:
+        if not ce.state.is_servable:
             # The request is riding a load (cache miss): track how long it
-            # waited (reference cache-miss-delay metric).
+            # waited (reference cache-miss-delay metric). A PARTIAL
+            # streamed copy is already servable — no miss recorded.
             self.metrics.inc(MX.CACHE_MISS_COUNT, model_id=ce.model_id)
             t_wait = _time.perf_counter()
             ok = self._wait_entry_active(ce, cancel_event=cancel_event)
@@ -1008,7 +1063,7 @@ class ModelMeshInstance:
             raise ModelLoadException(
                 f"{ce.model_id}: timed out waiting for load", timeout=True
             )
-        if ce.state is not EntryState.ACTIVE:
+        if not ce.state.is_servable:
             raise ModelNotHereError(self.instance_id, ce.model_id)
         if method is None:
             # ensure-loaded op: presence is the result. A chain count must
@@ -1017,8 +1072,7 @@ class ModelMeshInstance:
             # the first target is already a holder (the fresh-load path
             # fires its own chain in _run_load; the _chain_fired flag
             # prevents double-fire).
-            if chain_count > 0 and not getattr(ce, "_chain_fired", False):
-                ce._chain_fired = True
+            if chain_count > 0 and ce.claim_chain_fire():
                 self._spawn_chain(ce.model_id, ce.last_used, chain_count)
             return InvokeResult(b"", self.instance_id, "LOADED")
         if not ce.before_invoke(cancel_event=cancel_event):
@@ -1087,9 +1141,8 @@ class ModelMeshInstance:
         this completion-time trigger from double-firing it.
         """
         remaining = getattr(ce, "chain_load_count", 0)
-        if remaining <= 0 or getattr(ce, "_chain_fired", False):
+        if remaining <= 0 or not ce.claim_chain_fire():
             return
-        ce._chain_fired = True
         self._spawn_chain(ce.model_id, ce.last_used, remaining)
 
     def _spawn_chain(self, model_id: str, last_used: int, remaining: int) -> None:
@@ -1318,9 +1371,8 @@ class ModelMeshInstance:
         if (
             self.load_fastpath
             and ctx.chain_load_count > 0
-            and not getattr(ce, "_chain_fired", False)
+            and ce.claim_chain_fire()
         ):
-            ce._chain_fired = True
             self._spawn_chain(model_id, last_used, ctx.chain_load_count)
         return ce
 
@@ -1361,7 +1413,10 @@ class ModelMeshInstance:
             self.metrics.observe(
                 MX.QUEUE_DELAY, ce.load_started_ms - queued_ms, model_id
             )
-            loaded = self.loader.load(model_id, ce.info)
+            # Weight-source resolution (transfer/): host-tier re-warm or
+            # peer stream when available, model store otherwise — with
+            # in-manager fallback to the store on any mid-transfer error.
+            loaded, _source = self.transfer.load_weights(ce)
             # The runtime demonstrably works — disarm bootstrap probation
             # even if this entry is removed before activation below.
             if self.probation is not None:
@@ -1417,6 +1472,28 @@ class ModelMeshInstance:
             self.publish_instance_record()
         return True
 
+    def begin_partial_serve(self, ce: CacheEntry, loaded) -> None:
+        """Serve-before-fully-loaded: a streamed transfer has landed
+        enough layers for this layer-streamable copy to admit requests.
+        Move the entry to PARTIAL (waiters wake immediately) and promote
+        the copy into the registry so the partial copy is advertised and
+        routable mid-transfer; the stream's completion finalizes it to
+        ACTIVE through the normal ``_activate`` path."""
+        if not ce.begin_partial(loaded):
+            return  # evicted/failed mid-stream: the stream outcome decides
+        self.metrics.inc(MX.PARTIAL_SERVE_COUNT, model_id=ce.model_id)
+        log.info(
+            "%s serving partially-streamed (promoting mid-transfer)",
+            ce.model_id,
+        )
+        # partial=True keeps our loading claim beside the promotion:
+        # routable for requests, but flagged to peers as not-yet-a-
+        # transfer-source (and their pending waits keep their anchor).
+        if not self._promote_loaded(
+            ce.model_id, size_units=ce.weight_units, partial=True
+        ):
+            self.publish_instance_record()
+
     def _correct_sizing(self, ce: CacheEntry, loaded) -> None:
         """Overlapped follow-up of a serve-before-sizing activation: run
         the ``model_size`` RPC and re-account the entry from its predicted
@@ -1467,18 +1544,25 @@ class ModelMeshInstance:
             log.warning("size-correction CAS gave up for %s", model_id)
         self.publish_instance_record()
 
-    def _promote_loaded(self, model_id: str, size_units: int = 0) -> bool:
+    def _promote_loaded(
+        self, model_id: str, size_units: int = 0, partial: bool = False,
+    ) -> bool:
         """CAS the loaded promotion into the registry, with the refreshed
         instance-record advertisement riding the SAME store txn (the
         batched-mutation fast path: one KV round trip where the serial
         pipeline paid a promote CAS plus a separate publish put). Returns
         True when the publish rode the txn — the caller can then skip its
-        standalone publish entirely."""
+        standalone publish entirely. ``partial``: a mid-transfer (PARTIAL)
+        promotion keeps the loading claim so peers know the copy is not a
+        transfer source yet (records.promote_partial)."""
 
         def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
             if cur is None:
                 return None
-            cur.promote_loaded(self.instance_id, now_ms())
+            if partial:
+                cur.promote_partial(self.instance_id, now_ms())
+            else:
+                cur.promote_loaded(self.instance_id, now_ms())
             if size_units:
                 cur.size_units = size_units
             return cur
@@ -1555,7 +1639,7 @@ class ModelMeshInstance:
         deadline = clock.monotonic() + cap_s
         state = ce.state
         while True:
-            if state is EntryState.ACTIVE:
+            if state.is_servable:
                 return True
             if state is EntryState.FAILED:
                 raise ModelLoadException(ce.error or "load failed")
@@ -1622,11 +1706,26 @@ class ModelMeshInstance:
 
     def _load_failed(self, ce: CacheEntry, message: str) -> None:
         log.warning("load of %s failed: %s", ce.model_id, message)
+        # An entry that BEGAN partial serving has a provisional runtime
+        # copy resident (the partial_ready contract: servable = device
+        # memory allocated) — the terminal failure must release it like
+        # _activate's removed-entry branch does, or the partially-
+        # streamed weights leak with no entry left to ever trigger the
+        # unload. Sticky flag, not the state: a concurrent eviction may
+        # have moved a PARTIAL entry to REMOVED already (the eviction
+        # skipped the unload — the copy was never was_active).
+        was_partial = getattr(ce, "partial_started", False)
         if self.probation is not None:
             self.probation.record_failure(ce.model_id, message)
         self.metrics.inc(MX.LOAD_FAILED_COUNT, model_id=ce.model_id)
         ce.fail(message)
         self.cache.remove_if_value(ce.model_id, ce)
+        if was_partial:
+            if self.loader.requires_unload:
+                self._async_unload(ce.model_id, ce.weight_units)
+            else:
+                model_id = ce.model_id
+                self._submit_unload(lambda: self.loader.unload(model_id))
         self._record_load_failure(ce.model_id, message)
         self.publish_instance_record()
 
@@ -1669,7 +1768,17 @@ class ModelMeshInstance:
 
         def post_evict():
             try:
-                self._deregister(model_id, record_unload_time=True)
+                # Demote-to-host ahead of the full drop: export the
+                # weights into the host tier BEFORE the runtime unload
+                # releases the handle, so a re-warm is a device copy and
+                # peers can keep fetching from this host. Only full
+                # (was-ACTIVE) copies demote; best-effort by design.
+                demoted = was_active and self.transfer.demote_evicted(
+                    model_id, ce
+                )
+                self._deregister(
+                    model_id, record_unload_time=True, demoted=demoted
+                )
             finally:
                 if do_unload:
                     try:
@@ -1679,6 +1788,53 @@ class ModelMeshInstance:
                         self.publish_instance_record()
 
         self._submit_unload(post_evict)
+
+    def _on_host_tier_evict(self, model_id: str, snap, size_bytes: int) -> None:
+        """Host tier evicted a snapshot (host-capacity pressure). Called
+        under the tier's lock — schedule the registry host-claim cleanup,
+        never CAS inline."""
+        self.metrics.inc(MX.HOST_TIER_EVICT_COUNT, model_id=model_id)
+        self._cleanup_pool.submit(self._drop_host_claim, model_id)
+
+    def _drop_host_claim(self, model_id: str) -> None:
+        def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
+            if cur is None:
+                return None
+            cur.drop_host_copy(self.instance_id)
+            return cur
+
+        try:
+            self.registry.update_or_create(model_id, mutate)
+        except CasFailed:
+            log.warning("host-claim drop CAS gave up for %s", model_id)
+        except Exception:  # noqa: BLE001 — stale claims are reaper-pruned
+            pass
+
+    def handle_weight_fetch(
+        self, model_id: str, chunk_index: int, fingerprint: str = "",
+    ):
+        """Sender side of the mesh-internal FetchWeights channel (served
+        beside Forward): one chunk of this instance's snapshot of the
+        model, from the host tier (exporting a live copy on first
+        demand)."""
+        # Deliberately NOT gated on shutting_down: graceful drain
+        # (pre_shutdown migration) is exactly when peers streaming our
+        # copies is most valuable, and the runtime handle is still alive
+        # for the whole migration pass. A copy torn down mid-fetch just
+        # yields NOT_AVAILABLE / a transport error — the receiver's
+        # store fallback covers it like any other mid-stream fault.
+        reply = self.transfer.handle_fetch(model_id, chunk_index, fingerprint)
+        if not reply.ok and self.host_tier.peek(model_id) is None:
+            # Self-heal a dangling host claim: a receiver dialed us as an
+            # advertised host-tier source but the snapshot is gone (the
+            # demote/evict CAS race) and we have nothing else to serve —
+            # drop the claim so the fleet stops ranking us.
+            mr = self.registry_view.get(model_id)
+            if mr is not None and self.instance_id in getattr(
+                mr, "host_instances", {}
+            ):
+                self._cleanup_pool.submit(self._drop_host_claim, model_id)
+        return reply
 
     def _on_registry_event(self, event, model_id: str, record) -> None:
         """Registry watch listener: prompt local-copy cleanup on deletion.
@@ -1695,6 +1851,9 @@ class ModelMeshInstance:
         self.route_cache.invalidate(model_id)
         if event is not TableEvent.DELETED:
             return
+        # A deleted model's host-tier snapshot is dead weight (the record
+        # that advertised it is gone): release the RAM promptly.
+        self.transfer.drop_host_copy(model_id)
         if self.cache.get_quietly(model_id) is None:
             return
         self._cleanup_pool.submit(self._cleanup_deleted_model, model_id)
@@ -1731,6 +1890,11 @@ class ModelMeshInstance:
             pass
 
     def _remove_local(self, model_id: str) -> bool:
+        # Deliberate removal (unregister / deletion cleanup / shutdown
+        # migration) drops the host-tier snapshot too — unlike capacity
+        # eviction, which demotes into it. The registry host claim falls
+        # with remove_instance in _deregister below.
+        self.transfer.drop_host_copy(model_id)
         ce = self.cache.get_quietly(model_id)
         if ce is None:
             return False
@@ -1829,11 +1993,25 @@ class ModelMeshInstance:
         except Exception:  # noqa: BLE001 - KV outage: fail-fast covers it
             return None
 
-    def _deregister(self, model_id: str, record_unload_time: bool = False) -> None:
+    def _deregister(
+        self, model_id: str, record_unload_time: bool = False,
+        demoted: bool = False,
+    ) -> None:
         def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
             if cur is None:
                 return None
             cur.remove_instance(self.instance_id)
+            # Re-check snapshot residency INSIDE the CAS callback: a
+            # concurrent demotion of another model can have already
+            # evicted ours from the host tier, and its scheduled
+            # _drop_host_claim may have run (as a no-op) before this
+            # claim commits — advertising a claim with nothing behind it
+            # would strand receivers on NOT_AVAILABLE until we reload.
+            # (handle_fetch self-heals the residual CAS-in-flight window.)
+            if demoted and self.host_tier.peek(model_id) is not None:
+                # The device copy is gone but a host-tier snapshot stays:
+                # advertise it as a peer-fetch source (transfer/ tier).
+                cur.claim_host_copy(self.instance_id, now_ms())
             if record_unload_time:
                 cur.last_unload_ms = now_ms()
             return cur

@@ -133,7 +133,12 @@ def main(argv=None) -> None:
 
     from modelmesh_tpu.observability.metrics import NoopMetrics, PrometheusMetrics
     from modelmesh_tpu.observability.payloads import build_processor
-    from modelmesh_tpu.serving.api import MeshServer, make_grpc_peer_call
+    from modelmesh_tpu.serving.api import (
+        MeshServer,
+        PeerChannels,
+        make_grpc_peer_call,
+        make_grpc_peer_fetch,
+    )
     from modelmesh_tpu.serving.bootstrap import (
         PreStopServer,
         register_static_models,
@@ -201,7 +206,10 @@ def main(argv=None) -> None:
             load_timeout_s=args.load_timeout_s,
         ),
         strategy=strategy,
-        peer_call=make_grpc_peer_call(tls=tls),
+        # Forward and FetchWeights share one channel cache: both internal
+        # surfaces multiplex the same connection per peer.
+        peer_call=make_grpc_peer_call(peer_channels := PeerChannels(tls)),
+        peer_fetch=make_grpc_peer_fetch(peer_channels),
         metrics=metrics,
         constraints=constraints,
         upgrade_tracker=UpgradeTracker(),

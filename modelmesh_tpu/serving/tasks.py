@@ -393,6 +393,11 @@ class BackgroundTasks:
         referenced: set[str] = set()
         for _, mr in records:
             referenced |= mr.all_placements
+            # Host-tier claims (transfer/ demotions) are peer-fetch
+            # sources, not servable placements — but a dead holder's
+            # claim must be pruned the same way or receivers keep
+            # dialing a ghost before falling back.
+            referenced |= set(mr.host_instances)
         for iid in referenced - live:
             self._missing_since.setdefault(iid, now)
         for iid in list(self._missing_since):
@@ -417,20 +422,22 @@ class BackgroundTasks:
                 )
             ]
             dead = [iid for iid in mr.instance_ids if iid in gone]
-            if not stale_claims and not dead:
+            dead_hosts = [iid for iid in mr.host_instances if iid in gone]
+            if not stale_claims and not dead and not dead_hosts:
                 continue
 
             def prune(cur):
                 if cur is None:
                     return None
-                for iid in stale_claims + dead:
+                for iid in stale_claims + dead + dead_hosts:
                     cur.remove_instance(iid)
                 return cur
 
             try:
                 inst.registry.update_or_create(model_id, prune)
                 log.info(
-                    "reaper: pruned %s from %s", stale_claims + dead, model_id
+                    "reaper: pruned %s from %s",
+                    stale_claims + dead + dead_hosts, model_id,
                 )
             except CasFailed:
                 pass

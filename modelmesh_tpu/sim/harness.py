@@ -47,7 +47,17 @@ SLOW_LOAD_PREFIX = "slow-load-"
 
 
 class SimLoader(ModelLoader):
-    """In-process loader with virtual-time load delays and fault hooks."""
+    """In-process loader with virtual-time load delays and fault hooks.
+
+    Weight-streaming capable: exports deterministic synthetic chunks
+    (``transfer_chunks`` per model, small payloads — the ACCOUNTED size
+    is the model's declared size, which is what the host tier and the
+    invariants reason about) and re-materializes from a stream. Chunk
+    delay defaults to ZERO virtual time: direct-tick tests drive the
+    clock manually and a sleeping stream would deadlock them; the
+    mid-stream fault hooks key on chunk COUNTS, not time, so scenario
+    determinism doesn't need the delay. Scenarios that want transfers
+    to consume virtual time opt in via ``transfer_chunk_delay_ms``."""
 
     def __init__(
         self,
@@ -55,13 +65,18 @@ class SimLoader(ModelLoader):
         default_size_bytes: int = 8 << 20,
         load_delay_ms: float = 0.0,
         load_concurrency: int = 8,
+        transfer_chunks: int = 8,
+        transfer_chunk_delay_ms: float = 0.0,
     ):
         self.capacity_bytes = capacity_bytes
         self.default_size_bytes = default_size_bytes
         self.load_delay_ms = load_delay_ms
         self.load_concurrency = load_concurrency
+        self.transfer_chunks = max(int(transfer_chunks), 1)
+        self.transfer_chunk_delay_ms = transfer_chunk_delay_ms
         self.loaded_models: dict[str, int] = {}  #: guarded-by: _lock
         self.load_count = 0  #: guarded-by: _lock
+        self.stream_load_count = 0  #: guarded-by: _lock
         self.unload_count = 0  #: guarded-by: _lock
         # model_id -> extra virtual load delay (the slow-loadModel fault).
         self.slow_models: dict[str, float] = {}  #: guarded-by: _lock
@@ -128,6 +143,63 @@ class SimLoader(ModelLoader):
         h = zlib.crc32(model_id.encode()) % 1000
         return int(self.default_size_bytes * (0.5 + h / 1000.0))
 
+    # -- weight streaming --------------------------------------------------
+
+    @property
+    def supports_weight_streaming(self) -> bool:
+        return True
+
+    def export_weights(self, model_id: str, handle):
+        from modelmesh_tpu.runtime.spi import WeightChunk
+
+        with self._lock:
+            if model_id not in self.loaded_models:
+                return None
+        n = self.transfer_chunks
+
+        def gen():
+            for i in range(n):
+                yield WeightChunk(
+                    seq=i,
+                    # Synthetic but deterministic payload; size accounting
+                    # uses the declared model size, not these bytes.
+                    payload=f"{model_id}:{i}".encode(),
+                    layer=i,
+                    last=i == n - 1,
+                )
+
+        return gen()
+
+    def load_from_stream(
+        self, model_id: str, info: ModelInfo, chunks, partial_ready=None,
+    ) -> LoadedModel:
+        size = self._size_for(model_id)
+        seen = 0
+        fired_partial = False
+        for chunk in chunks:
+            if self.transfer_chunk_delay_ms:
+                _clock.sleep(self.transfer_chunk_delay_ms / 1000.0)
+            seen += 1
+            if (
+                partial_ready is not None
+                and not fired_partial
+                and seen * 2 >= self.transfer_chunks
+            ):
+                # Half the layers landed: this synthetic runtime can
+                # serve from here (the PARTIAL-phase test hook). Register
+                # the copy before announcing — the runtime_call probe
+                # checks is_loaded().
+                fired_partial = True
+                with self._lock:
+                    self.loaded_models[model_id] = size
+                partial_ready(LoadedModel(handle=model_id, size_bytes=size))
+        if seen == 0:
+            raise ModelLoadException(f"{model_id}: empty weight stream")
+        with self._lock:
+            self.loaded_models[model_id] = size
+            self.stream_load_count += 1
+        return LoadedModel(handle=model_id, size_bytes=size)
+
 
 class SimPod:
     def __init__(self, instance: ModelMeshInstance, tasks: BackgroundTasks,
@@ -164,6 +236,17 @@ class SimCluster:
         # Instances this scenario demanded copies of (feeds the
         # availability invariant).
         self.demanded: set[str] = set()
+        # Transfer-progress fault hooks: fn(sender_iid, model_id,
+        # chunk_index) called on EVERY peer chunk fetch before it is
+        # served — scenarios arm mid-stream faults here (kill or
+        # partition the sender after K chunks). List mutation is
+        # GIL-atomic; hooks run on the fetching thread.
+        self._transfer_hooks: list = []
+        # (model_id, action, sender_iid) for every armed fault that
+        # actually FIRED — scenario checks assert on this so a fault
+        # that never triggered (stream never started) fails loudly
+        # instead of passing vacuously.
+        self.transfer_faults_fired: list[tuple[str, str, str]] = []
         self._n = 0
         for _ in range(n):
             self.add_instance(
@@ -204,6 +287,7 @@ class SimCluster:
                 **config_kwargs,
             ),
             peer_call=self._peer_call,
+            peer_fetch=self._peer_fetch,
             runtime_call=self._runtime_call,
         )
         tasks = BackgroundTasks(inst, self.task_config)
@@ -231,6 +315,58 @@ class SimCluster:
         return pod.instance.invoke_model(
             model_id, method, payload, list(headers), ctx, sync=True
         )
+
+    def _peer_fetch(self, endpoint: str, model_id: str, chunk_index: int,
+                    fingerprint: str):
+        """Direct-call FetchWeights transport with mid-stream fault
+        injection: every chunk runs the armed transfer hooks first, then
+        re-checks the sender — a hook that killed or partitioned the
+        sender makes THIS chunk fail exactly like the wire would."""
+        pod = self._find(endpoint)
+        if pod is None or not pod.alive:
+            raise ServiceUnavailableError(endpoint)
+        for hook in list(self._transfer_hooks):
+            hook(pod.iid, model_id, chunk_index)
+        if not pod.alive or self.kv.is_partitioned(pod.iid):
+            # A KV partition models a full network partition for the
+            # instance: the transfer channel is unreachable too.
+            raise ServiceUnavailableError(endpoint)
+        return pod.instance.handle_weight_fetch(
+            model_id, chunk_index, fingerprint
+        )
+
+    def add_transfer_hook(self, hook) -> None:
+        self._transfer_hooks.append(hook)
+
+    def arm_transfer_fault(
+        self, model_id: str, after_chunks: int, action: str,
+    ) -> None:
+        """One-shot mid-stream fault: once ``after_chunks`` chunks of
+        ``model_id`` have been served, ``kill`` or ``partition`` the
+        SENDER — the receiver's next chunk fetch fails and its store
+        fallback must take over."""
+        state = {"served": 0, "fired": False}
+
+        def hook(sender_iid: str, mid: str, chunk_index: int) -> None:
+            if mid != model_id or state["fired"]:
+                return
+            state["served"] += 1
+            if state["served"] <= after_chunks:
+                return
+            state["fired"] = True
+            self.transfer_faults_fired.append((model_id, action, sender_iid))
+            log.info(
+                "transfer fault: %s sender %s after %d chunks of %s",
+                action, sender_iid, after_chunks, mid,
+            )
+            if action == "kill":
+                self.kill(sender_iid)
+            elif action == "partition":
+                self.partition(sender_iid)
+            else:
+                raise ValueError(f"unknown transfer fault action {action}")
+
+        self.add_transfer_hook(hook)
 
     def _runtime_call(
         self, ce, method, payload: bytes, headers, cancel_event=None

@@ -18,7 +18,11 @@ Catalog (see docs/testing.md for the rationale of each):
   (no alias cycles, active targets exist in the registry).
 - ``cache_weight_consistent`` — per instance: the cache's accounted
   weight equals the sum of entry weights, never exceeds capacity, and
-  pending-unload units are non-negative.
+  pending-unload units are non-negative; the host staging tier obeys the
+  same conservation law in bytes against its own budget.
+- ``host_claims_converged`` — registry host-tier claims
+  (transfer/ demotions) on LIVE instances have an actual host-resident
+  snapshot behind them.
 """
 
 from __future__ import annotations
@@ -38,7 +42,7 @@ def demanded_models_served(cluster: "SimCluster") -> list[str]:
     for pod in cluster.live_pods():
         for mid in pod.instance.cache.keys():
             ce = pod.instance.cache.get_quietly(mid)
-            if ce is not None and ce.state is EntryState.ACTIVE:
+            if ce is not None and ce.state.is_servable:
                 active.setdefault(mid, set()).add(pod.iid)
     for mid in sorted(cluster.demanded):
         mr = inst.registry.get(mid)
@@ -84,7 +88,7 @@ def registry_cache_convergence(cluster: "SimCluster") -> list[str]:
         mmi = pod.instance
         for mid in mmi.cache.keys():
             ce = mmi.cache.get_quietly(mid)
-            if ce is None or ce.state is not EntryState.ACTIVE:
+            if ce is None or not ce.state.is_servable:
                 continue
             mr = records.get(mid)
             if mr is None:
@@ -164,6 +168,45 @@ def cache_weight_consistent(cluster: "SimCluster") -> list[str]:
             )
         if pod.instance.unload_tracker.pending_units < 0:
             out.append(f"{pod.iid}: negative pending-unload units")
+        # Host-tier byte accounting (transfer/): same conservation law
+        # one tier down — accounted bytes equal the sum of resident
+        # snapshot sizes and never exceed the host budget.
+        tier = pod.instance.host_tier
+        with tier._lock:
+            host_used = tier.used_bytes
+            host_actual = sum(e[1] for e in tier._copies.values())
+            host_cap = tier.capacity_bytes
+        if host_used != host_actual:
+            out.append(
+                f"{pod.iid}: host tier accounts {host_used}B but holds "
+                f"{host_actual}B (leaked or double-counted snapshot)"
+            )
+        if host_used > host_cap:
+            out.append(
+                f"{pod.iid}: host tier {host_used}B exceeds budget "
+                f"{host_cap}B"
+            )
+    return out
+
+
+def host_claims_converged(cluster: "SimCluster") -> list[str]:
+    """Registry host-tier claims and actual host-resident snapshots agree
+    for LIVE instances: a claim with no snapshot behind it sends
+    receivers to a source that will answer NOT_AVAILABLE forever (dead
+    holders are the reaper's job, with the standard grace)."""
+    out: list[str] = []
+    inst = cluster.first_live().instance
+    live = {p.iid: p for p in cluster.live_pods()}
+    for mid, mr in inst.registry.items():
+        for iid in sorted(getattr(mr, "host_instances", {})):
+            pod = live.get(iid)
+            if pod is None:
+                continue
+            if pod.instance.host_tier.peek(mid) is None:
+                out.append(
+                    f"record {mid} claims a host copy on {iid} but that "
+                    "instance holds no snapshot"
+                )
     return out
 
 
@@ -182,4 +225,5 @@ def check_all(
         "registry_cache_convergence": registry_cache_convergence(cluster),
         "vmodel_resolution_acyclic": vmodel_resolution_acyclic(cluster),
         "cache_weight_consistent": cache_weight_consistent(cluster),
+        "host_claims_converged": host_claims_converged(cluster),
     }

@@ -22,6 +22,9 @@ Event kinds:
   clock_jump <ms>         single large advance (a freeze: leases MAY expire)
   slow_load <iid> <model> <ms>   per-model virtual load delay
   fail_load <iid> <model>        arm a one-shot load failure
+  transfer_fault <model> <after_chunks> <kill|partition>
+                          kill/partition the weight-stream SENDER once
+                          it has served that many chunks (mid-stream)
   register/ensure/invoke/unregister <model>   workload
 """
 
@@ -148,6 +151,12 @@ class ScenarioRunner:
             return
         if kind == "fail_load":
             cluster.fail_next_load(args[0], args[1])
+            return
+        if kind == "transfer_fault":
+            # Arm a mid-stream transfer fault (pure toggle: the fault
+            # itself fires later, on the fetching thread, once the
+            # chunk-progress threshold is crossed).
+            cluster.arm_transfer_fault(args[0], int(args[1]), args[2])
             return
         if kind == "kill":
             self.dead_since_ms[args[0]] = clock.now_ms()

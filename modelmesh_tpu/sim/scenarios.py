@@ -27,6 +27,11 @@ Catalog (race -> origin):
 - mass_restart_jitter — the task-cadence jitter satellite: a fleet whose
   background tasks all start at t=0 must not fire its publisher ticks in
   lockstep.
+- transfer_sender_killed_mid_stream / transfer_sender_partitioned_mid_stream
+  — the transfer/ subsystem's fault contract: a weight stream whose
+  SENDER crashes (or is partitioned) mid-transfer must fall back to a
+  store load on the receiver, with the demanded-model-served invariant
+  intact and no phantom registry state at quiescence.
 """
 
 from __future__ import annotations
@@ -318,6 +323,127 @@ def mass_restart_jitter() -> Scenario:
     )
 
 
+# ------------------------------------------------------------------ #
+# 7./8. weight-transfer sender dies / is partitioned mid-stream        #
+# ------------------------------------------------------------------ #
+
+
+def _check_fault_fired(model_id: str, action: str):
+    """Non-vacuity guard: the armed mid-stream fault must actually have
+    FIRED (a transfer was in flight and crossed the chunk threshold) —
+    otherwise the scenario silently stopped exercising the stream path
+    and the fallback check proves nothing."""
+
+    def check(cluster: SimCluster):
+        fired = [
+            (m, a) for m, a, _ in cluster.transfer_faults_fired
+            if m == model_id and a == action
+        ]
+        if not fired:
+            return [
+                f"armed {action} fault for {model_id} never fired — no "
+                "peer stream reached the chunk threshold (vacuous run)"
+            ]
+        return []
+
+    return check
+
+
+def _check_transfer_fallback(model_id: str, expect_live: tuple[str, ...]):
+    """The receiver must end with a servable copy materialized from the
+    STORE after the peer stream broke — and the broken transfer must not
+    leave phantom registry state (a partial promotion that never
+    finalized, or a host claim on the dead/partitioned sender that has
+    no snapshot behind it is caught by the standard invariants)."""
+
+    def check(cluster: SimCluster):
+        out = []
+        from modelmesh_tpu.serving.entry import EntryState
+
+        servable = []
+        for pod in cluster.live_pods():
+            ce = pod.instance.cache.get_quietly(model_id)
+            if ce is not None and ce.state.is_servable:
+                servable.append(pod.iid)
+        if not any(iid in servable for iid in expect_live):
+            out.append(
+                f"{model_id}: no servable copy on the surviving receivers "
+                f"(servable on {servable}; expected among {expect_live})"
+            )
+        # The receiver's store fallback must have actually materialized
+        # the runtime copy, not just flipped entry state.
+        for iid in servable:
+            pod = cluster.by_id(iid)
+            if not pod.loader.is_loaded(model_id):
+                out.append(
+                    f"{model_id}: {iid} advertises a copy its runtime "
+                    "does not hold"
+                )
+        return out
+
+    return check
+
+
+def transfer_sender_killed_mid_stream() -> Scenario:
+    """Flash-style second copy streams from the only holder; the holder
+    is CRASHED after 3 chunks. The receiver must fall back to a store
+    load with no demanded-model-unserved violation at quiescence."""
+    return Scenario(
+        name="transfer-sender-killed-mid-stream",
+        seed=107,
+        n_instances=3,
+        horizon_ms=40_000,
+        task_config=_tasks(),
+        events=[
+            Event(0, "register", ("m-xfer",)),
+            # First copy loads on sim-0 (store, 50ms virtual).
+            Event(200, "ensure", ("m-xfer",)),
+            # Arm: once sim-0 has served 3 chunks of m-xfer, kill it.
+            Event(3_000, "transfer_fault", ("m-xfer", 3, "kill")),
+            # Second copy: the receiver resolves sim-0 as its source,
+            # streams 3 chunks, then the sender dies mid-stream.
+            Event(3_500, "ensure", ("m-xfer", 1)),
+            # Demand keeps flowing after the fault: the fallback copy
+            # must actually serve.
+            Event(20_000, "invoke", ("m-xfer",)),
+        ],
+        extra_checks={
+            "transfer_fallback": _check_transfer_fallback(
+                "m-xfer", ("sim-1", "sim-2")
+            ),
+            "fault_fired": _check_fault_fired("m-xfer", "kill"),
+        },
+    )
+
+
+def transfer_sender_partitioned_mid_stream() -> Scenario:
+    """Same shape, but the sender is network-PARTITIONED (transfer
+    channel unreachable, lease eventually expires) and later heals —
+    receiver falls back to the store; after heal the cluster must
+    reconverge with no invariant violation."""
+    return Scenario(
+        name="transfer-sender-partitioned-mid-stream",
+        seed=108,
+        n_instances=3,
+        horizon_ms=60_000,
+        task_config=_tasks(),
+        events=[
+            Event(0, "register", ("m-part-x",)),
+            Event(200, "ensure", ("m-part-x",)),
+            Event(3_000, "transfer_fault", ("m-part-x", 3, "partition")),
+            Event(3_500, "ensure", ("m-part-x", 1)),
+            Event(25_000, "invoke", ("m-part-x",)),
+            Event(45_000, "heal", ("sim-0",)),
+        ],
+        extra_checks={
+            "transfer_fallback": _check_transfer_fallback(
+                "m-part-x", ("sim-1", "sim-2")
+            ),
+            "fault_fired": _check_fault_fired("m-part-x", "partition"),
+        },
+    )
+
+
 ALL = (
     fanout_budget_under_first_load_failure,
     promote_publish_suppression,
@@ -325,6 +451,8 @@ ALL = (
     delete_reregister_race,
     partition_through_janitor,
     mass_restart_jitter,
+    transfer_sender_killed_mid_stream,
+    transfer_sender_partitioned_mid_stream,
 )
 
 

@@ -97,6 +97,25 @@ REGISTRY: dict[str, EnvVar] = {
                "bypasses): a mass load/unload storm issues O(1) "
                "advertisement puts instead of O(models)",
                "serving/instance.py"),
+        EnvVar("MM_PEER_FETCH", "bool", "1",
+               "peer-to-peer weight streaming on scale-up: a new copy "
+               "streams chunked weights from an already-loaded live peer "
+               "(or a host-tier holder) over the mesh-internal "
+               "FetchWeights channel instead of the model store, with "
+               "store fallback on peer death or stream error; inert for "
+               "loaders without supports_weight_streaming",
+               "serving/instance.py"),
+        EnvVar("MM_HOST_TIER_BYTES", "int", str(256 << 20),
+               "host-RAM staging tier budget per instance (bytes): "
+               "device-evicted copies demote to a host snapshot so "
+               "re-warm is a device copy and peer fetches are served "
+               "O(1) from host RAM; 0 disables the tier (and demotion)",
+               "serving/instance.py"),
+        EnvVar("MM_TRANSFER_CHUNK_BYTES", "int", str(1 << 20),
+               "weight-transfer chunk granularity (bytes per FetchWeights "
+               "round trip), read by the exporting loader's serializer; "
+               "smaller chunks = finer mid-stream fault recovery, larger "
+               "= fewer RPCs per transfer", "models/server.py"),
         EnvVar("MM_ROUTE_CACHE", "bool", "1",
                "memoize the per-model serve-route decision on the request "
                "hot path (invalidated by registry version, instances-view "

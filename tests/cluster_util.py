@@ -15,7 +15,12 @@ import socket
 from modelmesh_tpu.kv import InMemoryKV
 from modelmesh_tpu.runtime.fake import FakeRuntimeServicer, start_fake_runtime
 from modelmesh_tpu.runtime.sidecar import SidecarRuntime
-from modelmesh_tpu.serving.api import MeshServer, PeerChannels, make_grpc_peer_call
+from modelmesh_tpu.serving.api import (
+    MeshServer,
+    PeerChannels,
+    make_grpc_peer_call,
+    make_grpc_peer_fetch,
+)
 from modelmesh_tpu.serving.instance import InstanceConfig, ModelMeshInstance
 from modelmesh_tpu.serving.vmodels import VModelManager
 
@@ -69,6 +74,7 @@ class Cluster:
         self.kv = kv or InMemoryKV(sweep_interval_s=0.05)
         self.channels = PeerChannels()
         peer_call = make_grpc_peer_call(self.channels, timeout_s=15.0)
+        peer_fetch = make_grpc_peer_fetch(self.channels, timeout_s=15.0)
         self.pods: list[Pod] = []
         for i in range(n):
             rt_server, rt_port, servicer = start_fake_runtime(
@@ -86,6 +92,7 @@ class Cluster:
                     **config_kwargs,
                 ),
                 peer_call=peer_call,
+                peer_fetch=peer_fetch,
                 strategy=strategy_factory() if strategy_factory else None,
             )
             vmodels = VModelManager(inst, sweep_interval_s=0.3)

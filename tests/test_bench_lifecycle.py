@@ -21,7 +21,7 @@ class TestBenchLifecycleSmoke:
     def test_tiny_run_produces_all_scenarios(self):
         out = bench_lifecycle.run(
             load_ms=20.0, size_ms=20.0, n_copies=3, fleet=4,
-            mass_models=40, reps=1,
+            mass_models=40, reps=1, crowd_copies=4, crowd_fleet=5,
         )
 
         fs = out["first_serve"]
@@ -51,3 +51,25 @@ class TestBenchLifecycleSmoke:
         assert ml["serial"]["standalone_publish_puts"] >= 40
         assert ml["fastpath"]["standalone_publish_puts"] <= 3
         assert ml["write_reduction"] > 1.0
+
+        # Flash crowd (transfer/): the load-source counters are the
+        # deterministic contract — store-only pays one store download per
+        # copy through the contended store, peer streaming pays exactly
+        # ONE store load and streams the rest. Wall-clock ordering is
+        # asserted loosely (contended store serializes 4 x 20ms, so even
+        # a noisy core keeps streaming well under store-only).
+        fc = out["flash_crowd"]
+        assert fc["store_only"]["store_loads"] == 4
+        assert fc["store_only"]["stream_loads"] == 0
+        assert fc["peer_stream"]["store_loads"] == 1
+        assert fc["peer_stream"]["stream_loads"] == 3
+        assert (
+            fc["peer_stream"]["time_to_n_ms"]
+            < fc["store_only"]["time_to_n_ms"]
+        )
+
+        # Host-tier re-warm: never touches the store again (asserted
+        # inside the harness) and beats the cold load.
+        hr = out["host_rewarm"]
+        assert hr["rewarm_ms"] < hr["cold_store_ms"]
+        assert hr["speedup"] > 1.0

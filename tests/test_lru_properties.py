@@ -180,3 +180,140 @@ LruMachine.TestCase.settings = settings(
     max_examples=120, stateful_step_count=60, deadline=None
 )
 TestLruProperties = LruMachine.TestCase
+
+
+class TieredMachine(RuleBasedStateMachine):
+    """Device cache + HostTier as one system: the transfer/ demote /
+    re-warm / evict paths under random interleavings, against executable
+    oracles for BOTH tiers.
+
+    Conservation laws checked after every step:
+    - device: accounted weight == sum of resident entry weights
+    - host: accounted bytes == sum of resident snapshot sizes, and the
+      budget is never exceeded
+    - a demoted copy is gone from the device tier, and a stale sizing
+      correction (``update_weight_if_value`` against the pre-demotion
+      value) can never resurrect it into EITHER tier's accounting.
+    """
+
+    def __init__(self):
+        super().__init__()
+        from modelmesh_tpu.cache.lru import HostTier
+
+        self.capacity = 100
+        self.host_capacity = 1000
+        self.cache = WeightedLRUCache(self.capacity)
+        self.tier = HostTier(
+            self.host_capacity,
+            eviction_listener=lambda k, v, s: self.host_evicted.append(k),
+        )
+        self.host_evicted: list[str] = []
+        # device oracle: key -> [value, weight]; host oracle: key -> size
+        self.dev: dict[str, list] = {}
+        self.host: dict[str, int] = {}
+        # key -> stale device value captured at demotion time (the
+        # serve-before-sizing correction's dangling reference).
+        self.stale: dict[str, object] = {}
+
+    def _sync_dev_evictions(self):
+        # Mirror device evictions into the oracle (order not under test
+        # here — LruMachine pins it; this machine pins ACCOUNTING).
+        resident = set(self.cache.keys())
+        for k in [k for k in self.dev if k not in resident]:
+            del self.dev[k]
+
+    def _sync_host_evictions(self):
+        for k in self.host_evicted:
+            self.host.pop(k, None)
+        self.host_evicted.clear()
+
+    @rule(k=KEYS, w=st.integers(1, 60))
+    def load(self, k, w):
+        """A copy lands on device (store load or stream)."""
+        v = object()
+        if self.cache.put_if_absent(k, v, w) is None:
+            self.dev[k] = [v, w]
+        self._sync_dev_evictions()
+
+    @rule(k=KEYS, size=st.integers(1, 400))
+    def demote(self, k, size):
+        """Device eviction demotes the copy into the host tier."""
+        e = self.dev.get(k)
+        if e is None:
+            return
+        self.stale[k] = e[0]
+        assert self.cache.remove_if_value(k, e[0])
+        del self.dev[k]
+        if self.tier.put(k, f"snap-{k}", size):
+            self.host[k] = size
+        self._sync_host_evictions()
+
+    @rule(k=KEYS, w=st.integers(1, 60))
+    def rewarm(self, k, w):
+        """Host hit promotes back to device; the snapshot stays resident
+        (still a peer-fetch source)."""
+        if self.tier.get(k) is None:
+            assert k not in self.host
+            return
+        assert k in self.host
+        v = object()
+        if self.cache.put_if_absent(k, v, w) is None:
+            self.dev[k] = [v, w]
+        self._sync_dev_evictions()
+
+    @rule(k=KEYS, w=st.integers(1, 60))
+    def stale_sizing_correction(self, k, w):
+        """The serve-before-sizing follow-up fires after the copy was
+        demoted: it must be a no-op — never resurrect the demoted copy
+        into device accounting."""
+        stale_v = self.stale.get(k)
+        if stale_v is None:
+            return
+        e = self.dev.get(k)
+        if e is not None and e[0] is stale_v:
+            return  # same value re-inserted: legitimate correction target
+        before_dev = self.cache.weight
+        before_host = self.tier.used_bytes
+        assert not self.cache.update_weight_if_value(k, stale_v, w)
+        assert self.cache.weight == before_dev
+        assert self.tier.used_bytes == before_host
+        assert (k in self.cache) == (k in self.dev)
+
+    @rule(k=KEYS, w=st.integers(1, 60))
+    def live_sizing_correction(self, k, w):
+        e = self.dev.get(k)
+        if e is None:
+            assert not self.cache.update_weight_if_value(k, object(), w)
+            return
+        assert self.cache.update_weight_if_value(k, e[0], w)
+        e[1] = w
+        self._sync_dev_evictions()
+
+    @rule(k=KEYS)
+    def drop_host_copy(self, k):
+        """Deliberate removal (model deleted / spec changed)."""
+        out = self.tier.remove(k)
+        assert (out is not None) == (k in self.host)
+        self.host.pop(k, None)
+
+    @invariant()
+    def device_accounting_conserved(self):
+        self._sync_dev_evictions()
+        assert self.cache.weight == sum(e[1] for e in self.dev.values())
+        assert self.cache.weight <= self.capacity
+        assert len(self.cache) == len(self.dev)
+
+    @invariant()
+    def host_accounting_conserved(self):
+        self._sync_host_evictions()
+        assert self.tier.used_bytes == sum(self.host.values())
+        assert self.tier.used_bytes <= self.host_capacity
+        assert len(self.tier) == len(self.host)
+        for k, size in self.host.items():
+            assert self.tier.size_of(k) == size
+
+
+TieredMachine.TestCase.settings = settings(
+    max_examples=120, stateful_step_count=60, deadline=None
+)
+TestTieredProperties = TieredMachine.TestCase

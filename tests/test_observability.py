@@ -85,6 +85,86 @@ class TestPrometheusMetrics:
         # cumulative series are typed counter, not gauge
         assert "# TYPE mm_process_cpu_seconds_total counter" in text
 
+    def test_transfer_metrics_exposition(self):
+        """The transfer/ subsystem's per-source load counters and stream
+        gauges render in the Prometheus output with HELP/TYPE metadata."""
+        m = PrometheusMetrics(instance_id="iT", start_server=False)
+        m.inc(Metric.LOAD_FROM_STORE_COUNT)
+        m.inc(Metric.LOAD_FROM_PEER_COUNT, 2)
+        m.inc(Metric.LOAD_FROM_HOST_TIER_COUNT)
+        m.inc(Metric.TRANSFER_FALLBACK_COUNT)
+        m.inc(Metric.TRANSFER_TX_BYTES, 4096)
+        m.inc(Metric.TRANSFER_RX_BYTES, 8192)
+        m.inc(Metric.HOST_TIER_DEMOTE_COUNT)
+        m.inc(Metric.HOST_TIER_EVICT_COUNT)
+        m.inc(Metric.PARTIAL_SERVE_COUNT)
+        m.set_gauge(Metric.TRANSFER_THROUGHPUT_MBPS, 123.5)
+        m.set_gauge(Metric.HOST_TIER_USED_BYTES, 1 << 20)
+        m.set_gauge(Metric.HOST_TIER_MODELS, 3)
+        text = m.render()
+        assert 'mm_load_source_store_count{instance="iT"} 1.0' in text
+        assert 'mm_load_source_peer_count{instance="iT"} 2.0' in text
+        assert 'mm_load_source_host_count{instance="iT"} 1.0' in text
+        assert 'mm_transfer_fallback_count{instance="iT"} 1.0' in text
+        assert 'mm_transfer_tx_bytes_total{instance="iT"} 4096.0' in text
+        assert 'mm_transfer_rx_bytes_total{instance="iT"} 8192.0' in text
+        assert 'mm_host_tier_demote_count{instance="iT"} 1.0' in text
+        assert 'mm_host_tier_evict_count{instance="iT"} 1.0' in text
+        assert 'mm_partial_serve_count{instance="iT"} 1.0' in text
+        assert 'mm_transfer_throughput_mbps{instance="iT"} 123.5' in text
+        assert f'mm_host_tier_used_bytes{{instance="iT"}} {1 << 20}' in text
+        assert 'mm_host_tier_models{instance="iT"} 3' in text
+        assert "# TYPE mm_load_source_peer_count counter" in text
+        assert "# TYPE mm_host_tier_used_bytes gauge" in text
+        assert "# HELP mm_transfer_rx_bytes_total" in text
+
+    def test_transfer_metrics_recorded_by_lifecycle(self):
+        """End-to-end: a load/evict/re-warm cycle against a streaming
+        loader records per-source counters and host-tier gauges through
+        the real serving paths."""
+        import time
+
+        from modelmesh_tpu.kv import InMemoryKV
+        from modelmesh_tpu.serving.instance import (
+            InstanceConfig,
+            ModelMeshInstance,
+        )
+        from tests.test_transfer import INFO, _StreamLoader
+
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        m = PrometheusMetrics(instance_id="iM", start_server=False)
+        inst = ModelMeshInstance(
+            kv, _StreamLoader(),
+            InstanceConfig(
+                instance_id="obs-0", endpoint="obs-0", load_timeout_s=10,
+                min_churn_age_ms=0, publish_coalesce_ms=0,
+            ),
+            metrics=m,
+            runtime_call=(
+                lambda ce, method, payload, headers, cancel_event=None:
+                payload
+            ),
+        )
+        try:
+            inst.register_model("mx", INFO)
+            inst.ensure_loaded("mx", sync=True)
+            inst.cache.set_capacity(1)  # evict -> demote
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if inst.host_tier.peek("mx") is not None:
+                    break
+                time.sleep(0.01)
+            inst.cache.set_capacity(1 << 14)
+            inst.ensure_loaded("mx", sync=True)  # re-warm from host
+            text = m.render()
+            assert 'mm_load_source_store_count{instance="iM"} 1.0' in text
+            assert 'mm_load_source_host_count{instance="iM"} 1.0' in text
+            assert 'mm_host_tier_demote_count{instance="iM"} 1.0' in text
+            assert 'mm_host_tier_models{instance="iM"} 1' in text
+        finally:
+            inst.shutdown()
+            kv.close()
+
     def test_statsd_does_not_crash_without_server(self):
         s = StatsDMetrics(port=18125)
         s.inc(Metric.LOAD_COUNT)

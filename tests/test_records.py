@@ -31,6 +31,31 @@ class TestModelRecord:
         assert back.load_failures == {"i2": [2000, "OOM"]}
         assert back.copy_count == 1
 
+    def test_host_claims_roundtrip_and_lifecycle(self, kv):
+        """Host-tier claims (transfer/ demotions): serialized, never part
+        of all_placements/copy_count, superseded by promotion, cleared
+        with the instance, and tolerated absent in old payloads."""
+        t = KVTable(kv, "registry", ModelRecord)
+        mr = ModelRecord(model_type="classifier")
+        mr.claim_host_copy("i1", ts=500)
+        t.conditional_set("mh", mr)
+        back = t.get("mh")
+        assert back.host_instances == {"i1": 500}
+        assert back.all_placements == set() and back.copy_count == 0
+        # Promotion supersedes the host claim for the same instance.
+        back.promote_loaded("i1", ts=900)
+        assert back.host_instances == {} and back.instance_ids == {"i1": 900}
+        # remove_instance clears host claims too (reaper pruning path).
+        back.claim_host_copy("i2", ts=901)
+        assert back.remove_instance("i2")
+        assert back.host_instances == {}
+        assert back.drop_host_copy("i9") is False
+        # Old payload without the field deserializes to an empty claim map.
+        legacy = ModelRecord.from_bytes(
+            b'{"model_type":"classifier"}', version=3
+        )
+        assert legacy.host_instances == {}
+
     def test_failure_expiry_and_exhaustion(self):
         mr = ModelRecord()
         now = 10_000_000

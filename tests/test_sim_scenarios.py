@@ -15,6 +15,15 @@ def test_scripted_scenario(factory):
     assert result.ok, f"{result.name} failed:\n{result.render()}"
 
 
+def test_transfer_fault_scenario_replays_bit_for_bit():
+    """The mid-transfer fault scenarios replay deterministically: same
+    seed, same event schedule, identical trace + verdict lines."""
+    first = run_scenario(scenarios.transfer_sender_killed_mid_stream())
+    second = run_scenario(scenarios.transfer_sender_killed_mid_stream())
+    assert first.ok and second.ok
+    assert first.trace_lines() == second.trace_lines()
+
+
 def test_jitter_check_catches_reverted_fix():
     """The spread check must FAIL when cadence jitter is disabled —
     proving the scenario actually observes the behavior it guards

@@ -1,0 +1,689 @@
+"""Weight-transfer subsystem tests: host tier, peer streaming, fallback,
+serve-before-fully-loaded, and the FetchWeights surface.
+
+In-process fleets on one InMemoryKV with direct-call peer transports
+(the same production-sync semantics as the gRPC hop, like
+bench_lifecycle's fleet) — plus unit coverage of HostTier accounting
+and the JAX loader's export/stream pair.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from modelmesh_tpu.cache.lru import HostTier
+from modelmesh_tpu.kv import InMemoryKV
+from modelmesh_tpu.runtime.spi import (
+    LoadedModel,
+    LocalInstanceParams,
+    ModelInfo,
+    ModelLoader,
+    ModelLoadException,
+    WeightChunk,
+)
+from modelmesh_tpu.serving.entry import EntryState
+from modelmesh_tpu.serving.errors import ServiceUnavailableError
+from modelmesh_tpu.serving.instance import (
+    InstanceConfig,
+    ModelMeshInstance,
+    RoutingContext,
+)
+
+
+def _load_local(inst: ModelMeshInstance, model_id: str) -> None:
+    """Force a local load on ``inst`` (the Forward LOAD_LOCAL_ONLY hop)."""
+    inst.invoke_model(
+        model_id, None, b"", [],
+        RoutingContext(hop=RoutingContext.LOAD_LOCAL_ONLY), sync=True,
+    )
+from modelmesh_tpu.transfer.protocol import (
+    FETCH_NOT_AVAILABLE,
+    FETCH_OK,
+    is_layer_streamable,
+    model_fingerprint,
+)
+
+MODEL_BYTES = 64 * 1024
+CHUNKS = 8
+
+
+class _StreamLoader(ModelLoader):
+    """Streaming-capable loader: store loads cost ``load_s`` wall time,
+    streamed loads cost ~nothing — the asymmetry every assertion here
+    leans on."""
+
+    def __init__(self, load_s: float = 0.0, partial_at: int = 0):
+        self.load_s = load_s
+        self.partial_at = partial_at  # chunks before partial_ready fires
+        self.loaded: dict[str, int] = {}
+        self.store_loads = 0
+        self.stream_loads = 0
+        self.unloads = 0
+        self._lock = threading.Lock()
+
+    def startup(self) -> LocalInstanceParams:
+        return LocalInstanceParams(
+            capacity_bytes=1 << 24, load_timeout_ms=30_000,
+            default_model_size_bytes=MODEL_BYTES,
+        )
+
+    def load(self, model_id: str, info: ModelInfo) -> LoadedModel:
+        if self.load_s:
+            time.sleep(self.load_s)
+        with self._lock:
+            self.loaded[model_id] = MODEL_BYTES
+            self.store_loads += 1
+        return LoadedModel(handle=model_id, size_bytes=MODEL_BYTES)
+
+    def predict_size(self, model_id: str, info: ModelInfo) -> int:
+        return MODEL_BYTES
+
+    def unload(self, model_id: str) -> None:
+        with self._lock:
+            self.loaded.pop(model_id, None)
+            self.unloads += 1
+
+    @property
+    def requires_unload(self) -> bool:
+        return False
+
+    @property
+    def supports_weight_streaming(self) -> bool:
+        return True
+
+    def export_weights(self, model_id: str, handle):
+        with self._lock:
+            if model_id not in self.loaded:
+                return None
+        payload = b"w" * (MODEL_BYTES // CHUNKS)
+        return iter([
+            WeightChunk(seq=i, payload=payload, layer=i, last=i == CHUNKS - 1)
+            for i in range(CHUNKS)
+        ])
+
+    def load_from_stream(self, model_id, info, chunks, partial_ready=None):
+        n = 0
+        for chunk in chunks:
+            n += 1
+            if (
+                partial_ready is not None
+                and self.partial_at
+                and n == self.partial_at
+            ):
+                with self._lock:
+                    self.loaded[model_id] = MODEL_BYTES
+                partial_ready(
+                    LoadedModel(handle=model_id, size_bytes=MODEL_BYTES)
+                )
+        if n == 0:
+            raise ModelLoadException(f"{model_id}: empty stream")
+        with self._lock:
+            self.loaded[model_id] = MODEL_BYTES
+            self.stream_loads += 1
+        return LoadedModel(handle=model_id, size_bytes=MODEL_BYTES)
+
+
+def _fleet(n, kv, loaders=None, **config_kwargs):
+    by_endpoint: dict[str, ModelMeshInstance] = {}
+
+    def peer_call(endpoint, model_id, method, payload, headers, ctx):
+        inst = by_endpoint.get(endpoint)
+        if inst is None:
+            raise ServiceUnavailableError(endpoint)
+        return inst.invoke_model(
+            model_id, method, payload, headers, ctx, sync=True
+        )
+
+    def peer_fetch(endpoint, model_id, chunk_index, fingerprint):
+        inst = by_endpoint.get(endpoint)
+        if inst is None:
+            raise ServiceUnavailableError(endpoint)
+        return inst.handle_weight_fetch(model_id, chunk_index, fingerprint)
+
+    insts = []
+    for i in range(n):
+        loader = loaders[i] if loaders else _StreamLoader()
+        inst = ModelMeshInstance(
+            kv,
+            loader,
+            InstanceConfig(
+                instance_id=f"t-{i}", endpoint=f"ep-{i}",
+                load_timeout_s=30, min_churn_age_ms=0,
+                publish_coalesce_ms=0,
+                **config_kwargs,
+            ),
+            peer_call=peer_call,
+            peer_fetch=peer_fetch,
+            runtime_call=(
+                lambda ce, method, payload, headers, cancel_event=None:
+                payload
+            ),
+        )
+        by_endpoint[inst.config.endpoint] = inst
+        insts.append(inst)
+    for inst in insts:
+        inst.instances_view.wait_for(lambda v: len(v) >= n, timeout=30)
+    return insts
+
+
+def _close(insts, kv):
+    for inst in insts:
+        inst.shutdown()
+    kv.close()
+
+
+INFO = ModelInfo(model_type="example", model_path="mem://m")
+STREAMABLE_INFO = ModelInfo(model_type="mlp", model_path="mlp://in=8,out=4")
+
+
+class TestHostTier:
+    def test_put_get_accounting_and_lru_eviction(self):
+        evicted = []
+        tier = HostTier(100, eviction_listener=lambda k, v, s: evicted.append(k))
+        assert tier.put("a", "A", 40)
+        assert tier.put("b", "B", 40)
+        assert tier.used_bytes == 80
+        assert tier.get("a") == "A"  # touches: b becomes LRU
+        assert tier.put("c", "C", 40)
+        assert evicted == ["b"]
+        assert tier.used_bytes == 80 and len(tier) == 2
+        assert tier.peek("b") is None
+
+    def test_oversized_and_disabled_rejected(self):
+        tier = HostTier(100)
+        assert not tier.put("big", "X", 101)
+        assert not HostTier(0).put("a", "A", 1)
+        assert not HostTier(0).enabled
+
+    def test_replace_reaccounts(self):
+        tier = HostTier(100)
+        assert tier.put("a", "A1", 60)
+        assert tier.put("a", "A2", 30)
+        assert tier.used_bytes == 30 and tier.peek("a") == "A2"
+
+    def test_remove_returns_value(self):
+        tier = HostTier(100)
+        tier.put("a", "A", 10)
+        assert tier.remove("a") == "A"
+        assert tier.used_bytes == 0 and tier.remove("a") is None
+
+
+class TestTieredAccountingWalk:
+    """Seeded random interleaving of load/demote/rewarm/evict/correct —
+    the no-hypothesis twin of tests/test_lru_properties.py's
+    TieredMachine, so tier-1 always exercises the conservation law."""
+
+    def test_random_interleaving_conserves_both_tiers(self):
+        import random
+
+        from modelmesh_tpu.cache.lru import WeightedLRUCache
+
+        rng = random.Random(0xC0FFEE)
+        cache: WeightedLRUCache[str, object] = WeightedLRUCache(100)
+        host_evicted: list[str] = []
+        tier = HostTier(
+            1000, eviction_listener=lambda k, v, s: host_evicted.append(k)
+        )
+        dev: dict[str, list] = {}
+        host: dict[str, int] = {}
+        stale: dict[str, object] = {}
+        keys = [f"k{i}" for i in range(8)]
+
+        def sync():
+            resident = set(cache.keys())
+            for k in [k for k in dev if k not in resident]:
+                del dev[k]
+            for k in host_evicted:
+                host.pop(k, None)
+            host_evicted.clear()
+
+        for step in range(3000):
+            k = rng.choice(keys)
+            op = rng.randrange(5)
+            if op == 0:  # load
+                v = object()
+                if cache.put_if_absent(k, v, rng.randint(1, 60)) is None:
+                    dev[k] = [v]
+            elif op == 1 and k in dev:  # demote
+                stale[k] = dev[k][0]
+                assert cache.remove_if_value(k, dev[k][0])
+                del dev[k]
+                size = rng.randint(1, 400)
+                if tier.put(k, f"s-{k}", size):
+                    host[k] = size
+            elif op == 2:  # rewarm
+                if tier.get(k) is not None:
+                    v = object()
+                    if cache.put_if_absent(k, v, rng.randint(1, 60)) is None:
+                        dev[k] = [v]
+            elif op == 3 and k in stale:  # stale sizing correction
+                sv = stale[k]
+                if not (k in dev and dev[k][0] is sv):
+                    before = (cache.weight, tier.used_bytes)
+                    assert not cache.update_weight_if_value(
+                        k, sv, rng.randint(1, 60)
+                    ), "stale correction resurrected a demoted copy"
+                    assert (cache.weight, tier.used_bytes) == before
+            elif op == 4:  # deliberate host drop
+                out = tier.remove(k)
+                assert (out is not None) == (k in host)
+                host.pop(k, None)
+            sync()
+            with cache.eviction_lock:
+                assert cache.weight == sum(
+                    e.weight for e in cache._entries.values()
+                )
+                assert cache.weight <= 100
+            with tier._lock:
+                assert tier.used_bytes == sum(
+                    e[1] for e in tier._copies.values()
+                )
+            assert tier.used_bytes == sum(host.values())
+            assert tier.used_bytes <= 1000
+            assert set(tier.keys()) == set(host)
+
+
+class TestPeerStreaming:
+    def test_second_copy_streams_from_loaded_peer(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loaders = [_StreamLoader(load_s=0.05) for _ in range(3)]
+        insts = _fleet(3, kv, loaders)
+        try:
+            a = insts[0]
+            a.register_model("m1", INFO)
+            a.ensure_loaded("m1", sync=True)
+            assert loaders[0].store_loads == 1
+            # Second copy: must stream from t-0, not hit the store.
+            a.ensure_loaded("m1", sync=True, exclude={"t-0"})
+            total_store = sum(ld.store_loads for ld in loaders)
+            total_stream = sum(ld.stream_loads for ld in loaders)
+            assert total_store == 1, "second copy paid a store load"
+            assert total_stream == 1
+            mr = a.registry.get("m1")
+            assert len(mr.instance_ids) == 2
+            # The sender kept an O(1) host snapshot for future receivers.
+            assert a.host_tier.peek("m1") is not None
+        finally:
+            _close(insts, kv)
+
+    def test_flash_crowd_one_store_load(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loaders = [_StreamLoader(load_s=0.05) for _ in range(4)]
+        insts = _fleet(4, kv, loaders)
+        try:
+            a = insts[0]
+            a.register_model("hot", INFO)
+            # Claim-time fan-out: 3 chained copies dispatch while the
+            # first load is still in the store — receivers must WAIT for
+            # the pending claim and then stream, not triple-hit the store.
+            a.ensure_loaded("hot", sync=True, chain=3)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                mr = a.registry.get("hot")
+                if mr is not None and len(mr.instance_ids) >= 4:
+                    break
+                time.sleep(0.01)
+            mr = a.registry.get("hot")
+            assert len(mr.instance_ids) >= 4
+            assert sum(ld.store_loads for ld in loaders) == 1, (
+                "flash crowd paid more than one store load"
+            )
+            assert sum(ld.stream_loads for ld in loaders) == 3
+        finally:
+            _close(insts, kv)
+
+    def test_peer_fetch_disabled_uses_store(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loaders = [_StreamLoader() for _ in range(2)]
+        insts = _fleet(2, kv, loaders, peer_fetch=False)
+        try:
+            a = insts[0]
+            a.register_model("m2", INFO)
+            a.ensure_loaded("m2", sync=True)
+            a.ensure_loaded("m2", sync=True, exclude={"t-0"})
+            assert sum(ld.store_loads for ld in loaders) == 2
+            assert sum(ld.stream_loads for ld in loaders) == 0
+        finally:
+            _close(insts, kv)
+
+    def test_sender_death_mid_stream_falls_back_to_store(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loaders = [_StreamLoader() for _ in range(2)]
+        dead = threading.Event()
+        real_fetches = []
+
+        insts = _fleet(2, kv, loaders)
+        try:
+            a, b = insts
+            # Wrap b's fetch transport: serve 2 chunks then die.
+            inner = b.peer_fetch_transport
+
+            def dying_fetch(endpoint, model_id, chunk_index, fingerprint):
+                real_fetches.append(chunk_index)
+                if chunk_index >= 2:
+                    dead.set()
+                    raise ServiceUnavailableError(endpoint)
+                return inner(endpoint, model_id, chunk_index, fingerprint)
+
+            b.peer_fetch_transport = dying_fetch
+            a.register_model("m3", INFO)
+            a.ensure_loaded("m3", sync=True)
+            _load_local(b, "m3")
+            assert dead.is_set(), "stream never hit the injected death"
+            # b fell back to the store; the copy still materialized.
+            assert loaders[1].store_loads == 1
+            assert loaders[1].stream_loads == 0
+            ce = b.cache.get_quietly("m3")
+            assert ce is not None and ce.state is EntryState.ACTIVE
+        finally:
+            _close(insts, kv)
+
+
+class TestHostTierLifecycle:
+    def test_evict_demotes_and_rewarm_streams_from_host(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loaders = [_StreamLoader()]
+        insts = _fleet(1, kv, loaders)
+        try:
+            a = insts[0]
+            a.register_model("warm", INFO)
+            a.ensure_loaded("warm", sync=True)
+            assert loaders[0].store_loads == 1
+            # Force a capacity eviction: the copy must demote to host.
+            a.cache.set_capacity(1)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if a.host_tier.peek("warm") is not None:
+                    break
+                time.sleep(0.01)
+            assert a.host_tier.peek("warm") is not None, "no demotion"
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                mr = a.registry.get("warm")
+                if mr is not None and "t-0" in mr.host_instances:
+                    break
+                time.sleep(0.01)
+            mr = a.registry.get("warm")
+            assert "t-0" in mr.host_instances
+            assert "t-0" not in mr.instance_ids
+            # Re-warm: a device copy from the host snapshot, no store.
+            a.cache.set_capacity(1 << 14)
+            a.ensure_loaded("warm", sync=True)
+            assert loaders[0].store_loads == 1
+            assert loaders[0].stream_loads == 1
+            mr = a.registry.get("warm")
+            assert "t-0" in mr.instance_ids
+            assert "t-0" not in mr.host_instances  # claim superseded
+        finally:
+            _close(insts, kv)
+
+    def test_unregister_drops_host_copy(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        insts = _fleet(1, kv)
+        try:
+            a = insts[0]
+            a.register_model("gone", INFO)
+            a.ensure_loaded("gone", sync=True)
+            a.cache.set_capacity(1)  # evict -> demote
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if a.host_tier.peek("gone") is not None:
+                    break
+                time.sleep(0.01)
+            a.unregister_model("gone")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if a.host_tier.peek("gone") is None:
+                    break
+                time.sleep(0.01)
+            assert a.host_tier.peek("gone") is None
+        finally:
+            _close(insts, kv)
+
+
+class TestPartialServe:
+    def test_streamable_family_serves_mid_transfer(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        # Receiver announces partial readiness at chunk 3 of 8.
+        loaders = [_StreamLoader(), _StreamLoader(partial_at=3)]
+        partial_seen = threading.Event()
+
+        insts = _fleet(2, kv, loaders)
+        try:
+            a, b = insts
+            # Gate chunk 4+ until the test observed the PARTIAL phase, so
+            # the mid-transfer state is deterministic, not a race.
+            inner = b.peer_fetch_transport
+
+            def gated_fetch(endpoint, model_id, chunk_index, fingerprint):
+                if chunk_index >= 4:
+                    assert partial_seen.wait(10)
+                return inner(endpoint, model_id, chunk_index, fingerprint)
+
+            b.peer_fetch_transport = gated_fetch
+            a.register_model("p1", STREAMABLE_INFO)
+            a.ensure_loaded("p1", sync=True)
+
+            done = {}
+
+            def load_on_b():
+                try:
+                    _load_local(b, "p1")
+                    done["status"] = "LOADED"
+                except Exception as e:  # noqa: BLE001 — assert on join
+                    done["status"] = f"error: {e}"
+
+            t = threading.Thread(target=load_on_b, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 10
+            ce = None
+            while time.monotonic() < deadline:
+                ce = b.cache.get_quietly("p1")
+                if ce is not None and ce.state is EntryState.PARTIAL:
+                    break
+                time.sleep(0.005)
+            assert ce is not None and ce.state is EntryState.PARTIAL, (
+                "entry never reached PARTIAL"
+            )
+            # Mid-transfer the partial copy is advertised and routable —
+            # but the RETAINED loading claim marks it as not-yet-a-
+            # transfer-source, so peers neither rank it as a sender nor
+            # abandon their pending waits on it.
+            mr = b.registry.get("p1")
+            assert "t-1" in mr.instance_ids
+            assert "t-1" in mr.loading_instances
+            # And it serves: a request against the partial copy succeeds.
+            out = b.invoke_model("p1", "predict", b"x", [])
+            assert out.status == "LOADED"
+            partial_seen.set()
+            t.join(timeout=10)
+            assert done.get("status") == "LOADED"
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if b.cache.get_quietly("p1").state is EntryState.ACTIVE:
+                    break
+                time.sleep(0.005)
+            assert b.cache.get_quietly("p1").state is EntryState.ACTIVE
+            # Completion clears the claim: the copy is a full transfer
+            # source from here on.
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                mr = b.registry.get("p1")
+                if "t-1" not in mr.loading_instances:
+                    break
+                time.sleep(0.005)
+            assert "t-1" not in mr.loading_instances
+            assert "t-1" in mr.instance_ids
+        finally:
+            _close(insts, kv)
+
+    def test_partial_then_total_failure_releases_runtime_copy(self):
+        """Stream dies after PARTIAL began AND the store fallback fails:
+        the provisional runtime copy installed at partial time must be
+        released — not leak with no entry left to trigger the unload."""
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loaders = [_StreamLoader(), _StreamLoader(partial_at=3)]
+        insts = _fleet(2, kv, loaders)
+        try:
+            a, b = insts
+            inner = b.peer_fetch_transport
+
+            def dying_fetch(endpoint, model_id, chunk_index, fingerprint):
+                if chunk_index >= 5:  # after partial_at=3 fired
+                    raise ServiceUnavailableError(endpoint)
+                return inner(endpoint, model_id, chunk_index, fingerprint)
+
+            b.peer_fetch_transport = dying_fetch
+
+            def store_outage(model_id, info):
+                raise ModelLoadException("store down")
+
+            b.loader.load = store_outage
+            a.register_model("pf", STREAMABLE_INFO)
+            a.ensure_loaded("pf", sync=True)
+            # The load op may legitimately RETURN at PARTIAL (the copy is
+            # servable mid-stream); the total failure lands async after
+            # the stream dies and the store fallback raises.
+            try:
+                _load_local(b, "pf")
+            except ModelLoadException:
+                pass
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                ce = b.cache.get_quietly("pf")
+                if ce is None or ce.state is EntryState.FAILED:
+                    break
+                time.sleep(0.01)
+            ce = b.cache.get_quietly("pf")
+            assert ce is None or ce.state is EntryState.FAILED
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if (
+                    loaders[1].unloads >= 1
+                    and "pf" not in loaders[1].loaded
+                ):
+                    break
+                time.sleep(0.01)
+            assert loaders[1].unloads >= 1, (
+                "partial runtime copy never released after total failure"
+            )
+            assert "pf" not in loaders[1].loaded
+        finally:
+            _close(insts, kv)
+
+    def test_non_streamable_family_never_partial(self):
+        # "example" is not in LAYER_STREAMABLE_FAMILIES: partial_ready
+        # must not be armed even though the loader would fire it.
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        loaders = [_StreamLoader(), _StreamLoader(partial_at=3)]
+        insts = _fleet(2, kv, loaders)
+        try:
+            a, b = insts
+            states = []
+            a.register_model("np", INFO)
+            a.ensure_loaded("np", sync=True)
+            orig = b.begin_partial_serve
+            b.begin_partial_serve = lambda ce, loaded: states.append("fired")
+            _load_local(b, "np")
+            assert states == [], "partial serve armed for a dense family"
+            assert loaders[1].stream_loads == 1
+        finally:
+            _close(insts, kv)
+
+    def test_streamability_resolution(self):
+        assert is_layer_streamable("mlp", "")
+        assert is_layer_streamable("x", "transformer://d=64")
+        assert not is_layer_streamable("conv", "conv://size=8")
+        assert not is_layer_streamable("example", "mem://m")
+
+    def test_fallback_set_mirrors_families_declaration(self):
+        """Drift guard: the static mirror used by store-only processes
+        must equal the authoritative declaration in models/families.py —
+        otherwise partial-serve behavior silently flips with import
+        order."""
+        pytest.importorskip("jax")
+        from modelmesh_tpu.models import families
+        from modelmesh_tpu.transfer import protocol
+
+        assert protocol._FALLBACK_STREAMABLE == (
+            families.LAYER_STREAMABLE_FAMILIES
+        )
+
+
+class TestFetchSurface:
+    def test_fetch_chunks_and_manifest(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        insts = _fleet(1, kv)
+        try:
+            a = insts[0]
+            a.register_model("f1", INFO)
+            a.ensure_loaded("f1", sync=True)
+            fp = model_fingerprint(
+                ModelInfo(INFO.model_type, INFO.model_path, INFO.model_key)
+            )
+            r0 = a.handle_weight_fetch("f1", 0, fp)
+            assert r0.status == FETCH_OK and r0.total_chunks == CHUNKS
+            last = a.handle_weight_fetch("f1", r0.total_chunks - 1, fp)
+            assert last.last
+            out_of_range = a.handle_weight_fetch("f1", r0.total_chunks, fp)
+            assert out_of_range.status == FETCH_NOT_AVAILABLE
+        finally:
+            _close(insts, kv)
+
+    def test_fingerprint_mismatch_not_available(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        insts = _fleet(1, kv)
+        try:
+            a = insts[0]
+            a.register_model("f2", INFO)
+            a.ensure_loaded("f2", sync=True)
+            r = a.handle_weight_fetch("f2", 0, "deadbeefdeadbeef")
+            assert r.status == FETCH_NOT_AVAILABLE
+        finally:
+            _close(insts, kv)
+
+    def test_unknown_model_not_available(self):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        insts = _fleet(1, kv)
+        try:
+            r = insts[0].handle_weight_fetch("nope", 0, "")
+            assert r.status == FETCH_NOT_AVAILABLE
+        finally:
+            _close(insts, kv)
+
+
+class TestJaxLoaderStreaming:
+    def test_export_stream_roundtrip_parity(self):
+        jax = pytest.importorskip("jax")  # noqa: F841
+        import numpy as np
+
+        from modelmesh_tpu.models.server import InProcessJaxLoader
+
+        sender = InProcessJaxLoader(capacity_bytes=64 << 20)
+        receiver = InProcessJaxLoader(capacity_bytes=64 << 20)
+        info = ModelInfo("mlp", "mlp://in=8,hidden=16,depth=2,out=4")
+        loaded = sender.load("jm", info)
+        chunks = list(sender.export_weights("jm", loaded.handle))
+        assert chunks[-1].last
+        assert all(c.layer >= 0 for c in chunks)
+        restored = receiver.load_from_stream("jm", info, iter(chunks))
+        assert restored.size_bytes == loaded.size_bytes
+        x = np.random.default_rng(0).standard_normal(8, dtype=np.float32)
+        out_a = loaded.handle.predict_bytes(x.tobytes())
+        out_b = restored.handle.predict_bytes(x.tobytes())
+        assert out_a == out_b
+
+    def test_truncated_stream_fails_load(self):
+        pytest.importorskip("jax")
+        from modelmesh_tpu.models.server import InProcessJaxLoader
+
+        sender = InProcessJaxLoader(capacity_bytes=64 << 20)
+        receiver = InProcessJaxLoader(capacity_bytes=64 << 20)
+        info = ModelInfo("mlp", "mlp://in=8,hidden=16,out=4")
+        loaded = sender.load("jt", info)
+        chunks = list(sender.export_weights("jt", loaded.handle))
+        with pytest.raises(ModelLoadException):
+            receiver.load_from_stream("jt", info, iter(chunks[:-1]))

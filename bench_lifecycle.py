@@ -35,6 +35,15 @@ MM_PUBLISH_COALESCE_MS):
   host_rewarm — demote/re-warm through the host-RAM staging tier: load,
                 evict (the copy demotes to a host snapshot), reload —
                 a device copy from host RAM vs a cold store load.
+  drain       — zero-downtime reconfiguration (reconfig/drain.py): a
+                16-model instance drains while a peer-side probe thread
+                keeps invoking every model. Measures time-to-drain and
+                the SERVING GAP (probe requests that failed) with the
+                peer pre-copy path vs store fallback (MM_PEER_FETCH
+                off: every pre-copy is a serialized contended-store
+                download). Peer pre-copy must produce a ZERO gap; the
+                store fallback stays error-free but pays ~models x one
+                store load of drain time.
 
 Each scenario runs both modes (serial baseline: fastpath off, coalescing
 off; pipelined: both on) and reports the speedup / write reduction.
@@ -445,6 +454,63 @@ def _measure_host_rewarm(load_ms: float, reps: int) -> dict:
     }
 
 
+def _measure_drain(peer_fetch: bool, models: int, fleet: int,
+                   load_ms: float, reps: int) -> dict:
+    """Drain a loaded instance under continuous probe traffic."""
+    import threading
+
+    from modelmesh_tpu.reconfig.drain import DrainController
+
+    drain_ms, gaps, probes, migrated = [], [], [], []
+    for r in range(reps):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        insts, loaders, _store = _streaming_fleet(
+            fleet, kv, peer_fetch, load_ms
+        )
+        src, via = insts[0], insts[1]
+        mids = [f"d-{r}-{i:02d}" for i in range(models)]
+        for mid in mids:
+            src.register_model(mid, INFO)
+            src.ensure_loaded(mid, sync=True)
+        assert len(src.cache) == models, "setup copies not local"
+        failures, successes = [], [0]
+        stop = threading.Event()
+
+        def probe():
+            i = 0
+            while not stop.is_set():
+                mid = mids[i % models]
+                try:
+                    via.invoke_model(mid, "p", b"x", [])
+                    successes[0] += 1
+                except Exception as e:  # noqa: BLE001 — the gap metric
+                    failures.append(f"{mid}: {type(e).__name__}")
+                i += 1
+                time.sleep(0.0005)
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t0 = time.perf_counter()
+        report = DrainController(src, deadline_s=120).drain()
+        drain_ms.append((time.perf_counter() - t0) * 1e3)
+        stop.set()
+        t.join(timeout=10)
+        gaps.append(len(failures))
+        probes.append(successes[0] + len(failures))
+        migrated.append(len(report.migrated))
+        _close(insts, kv)
+    return {
+        "reps": reps,
+        "models": models,
+        "fleet": fleet,
+        "load_ms": load_ms,
+        "drain_ms": round(statistics.median(drain_ms), 1),
+        "migrated": min(migrated),
+        "probe_requests": min(probes),
+        "failed_requests": max(gaps),
+    }
+
+
 def _measure_mass_load(fastpath: bool, coalesce_ms: int,
                        models: int) -> dict:
     inner = InMemoryKV(sweep_interval_s=3600.0)
@@ -473,7 +539,8 @@ def _measure_mass_load(fastpath: bool, coalesce_ms: int,
 
 def run(load_ms: float = 80.0, size_ms: float = 80.0, n_copies: int = 4,
         fleet: int = 5, mass_models: int = 500, reps: int = 3,
-        crowd_copies: int = 8, crowd_fleet: int = 9) -> dict:
+        crowd_copies: int = 8, crowd_fleet: int = 9,
+        drain_models: int = 16, drain_fleet: int = 3) -> dict:
     serial_fs = _measure_first_serve(False, load_ms, size_ms, reps)
     fast_fs = _measure_first_serve(True, load_ms, size_ms, reps)
     serial_nc = _measure_n_copies(False, n_copies, fleet, load_ms, reps)
@@ -487,6 +554,12 @@ def run(load_ms: float = 80.0, size_ms: float = 80.0, n_copies: int = 4,
         True, crowd_copies, crowd_fleet, load_ms, reps
     )
     rewarm = _measure_host_rewarm(load_ms, reps)
+    drain_peer = _measure_drain(
+        True, drain_models, drain_fleet, load_ms, reps
+    )
+    drain_store = _measure_drain(
+        False, drain_models, drain_fleet, load_ms, reps
+    )
     return {
         "first_serve": {
             "serial": serial_fs,
@@ -532,6 +605,18 @@ def run(load_ms: float = 80.0, size_ms: float = 80.0, n_copies: int = 4,
             ),
         },
         "host_rewarm": rewarm,
+        "drain": {
+            "peer_precopy": drain_peer,
+            "store_fallback": drain_store,
+            # Zero-downtime headline: requests failed while the loaded
+            # instance drained (peer pre-copy must be 0), and the drain
+            # duration ratio (store fallback serializes every pre-copy
+            # through the contended store).
+            "speedup": round(
+                drain_store["drain_ms"]
+                / max(drain_peer["drain_ms"], 1e-9), 2
+            ),
+        },
     }
 
 
@@ -545,10 +630,13 @@ def main() -> int:
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--crowd-copies", type=int, default=8)
     ap.add_argument("--crowd-fleet", type=int, default=9)
+    ap.add_argument("--drain-models", type=int, default=16)
+    ap.add_argument("--drain-fleet", type=int, default=3)
     args = ap.parse_args()
     print(json.dumps(run(
         args.load_ms, args.size_ms, args.n_copies, args.fleet,
         args.mass_models, args.reps, args.crowd_copies, args.crowd_fleet,
+        args.drain_models, args.drain_fleet,
     )))
     return 0
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import enum
 import json
+import re
 import threading
 import time
 from dataclasses import asdict
@@ -64,6 +65,31 @@ class Record:
         obj = cls(**{k: v for k, v in d.items() if k in known})  # type: ignore[call-arg]
         obj.version = version
         return obj
+
+
+# A bucket path segment ("<2-hex>/"), the test that tells a bucketed key
+# from a legacy flat one. Model ids are arbitrary strings and MAY contain
+# slashes, so "has a slash" is not the test — only a leading 2-hex-digit
+# segment is a bucket. (An id that itself starts with "<2-hex>/" is
+# genuinely ambiguous against this layout; don't name models that.)
+BUCKET_SEG = re.compile(r"^[0-9a-f]{2}/")
+
+
+def move_txn_parts(
+    target_key: str, legacy_key: str, value: bytes,
+    legacy_version: int, lease: int = 0,
+) -> tuple[list[Compare], list[Op]]:
+    """THE key-move transaction shape — single source of truth for the
+    live layout migration (used by the migrator's sweep, move-on-write
+    conditional_set, and batch_mutate). Two invariants live here and
+    nowhere else: the create is absence-guarded and the legacy delete
+    version-guarded (so exactly one move per key can ever commit), and
+    the put PRECEDES the delete (so watch-fed views admit the canonical
+    key before the legacy tombstone arrives)."""
+    return (
+        [Compare(target_key, 0), Compare(legacy_key, legacy_version)],
+        [Op(target_key, value, lease), Op(legacy_key)],
+    )
 
 
 class TableEvent(enum.Enum):
@@ -130,13 +156,23 @@ class KVTable(Generic[R]):
         BucketedKVTable; TableView routes every watch event through it."""
         return key[len(self.prefix):]
 
+    def scan(
+        self, page_size: int = 1000
+    ) -> Iterator[tuple[str, str, R]]:
+        """Stream (id, store_key, record) in bounded pages. The key is
+        what TableView's per-source-key event fencing needs during a
+        live layout migration (two keys can transiently map to one id);
+        plain callers use items()."""
+        for kv in self.store.range_paged(self.prefix, page_size):
+            yield self.key_to_id(kv.key), kv.key, self.record_cls.from_bytes(
+                kv.value, kv.version
+            )
+
     def items(self, page_size: int = 1000) -> Iterator[tuple[str, R]]:
         """Stream all records in bounded pages — safe at registry scale
         (one flat range() of 100k records would blow the message cap)."""
-        for kv in self.store.range_paged(self.prefix, page_size):
-            yield self.key_to_id(kv.key), self.record_cls.from_bytes(
-                kv.value, kv.version
-            )
+        for id_, _key, rec in self.scan(page_size):
+            yield id_, rec
 
     def update_or_create(
         self, id_: str, mutate: Callable[[Optional[R]], Optional[R]],
@@ -154,15 +190,33 @@ class KVTable(Generic[R]):
             if desired is None:
                 if current is None:
                     return None
-                if self.conditional_delete(id_, current.version):
+                if self._conditional_delete_current(id_, current):
                     return None
                 continue
+            if current is not None and desired is not current:
+                self._adopt_cas_meta(current, desired)
             desired.version = current.version if current is not None else 0
             try:
                 return self.conditional_set(id_, desired)
             except CasFailed:
                 continue
         raise CasFailed(f"update_or_create({id_}): too many CAS conflicts")
+
+    # -- CAS plumbing hooks (overridden by BucketedKVTable's live
+    # migration mode, where a record read from the legacy flat key must
+    # CAS against THAT key and move on write) ---------------------------
+
+    def _conditional_delete_current(self, id_: str, current: R) -> bool:
+        """Delete guarded on the key/version ``current`` was read from."""
+        return self.conditional_delete(id_, current.version)
+
+    def _adopt_cas_meta(self, current: R, desired: R) -> None:
+        """Propagate read-side CAS metadata when a mutate callback
+        returns a NEW object instead of mutating in place."""
+
+    def _record_key(self, id_: str, current: Optional[R]) -> str:
+        """The store key ``current`` was read from (the CAS guard key)."""
+        return self._key(id_)
 
     def batch_mutate(
         self,
@@ -188,21 +242,37 @@ class KVTable(Generic[R]):
             compares: list[Compare] = []
             ops: list[Op] = []
             results: dict[str, Optional[R]] = {}
-            writes: list[tuple[str, R]] = []
+            writes: list[tuple[str, R, bool]] = []
             for id_, mutate in mutations:
                 current = self.get(id_)
                 desired = mutate(current)
                 cur_version = current.version if current is not None else 0
-                key = self._key(id_)
-                compares.append(Compare(key, cur_version))
+                # The guard key is where the CURRENT record lives — during
+                # a live layout migration that may be the legacy flat key.
+                cur_key = self._record_key(id_, current)
+                target = self._key(id_)
+                compares.append(Compare(cur_key, cur_version))
                 if desired is None:
                     results[id_] = None
                     if current is not None:
-                        ops.append(Op(key))  # delete
+                        ops.append(Op(cur_key))  # delete
                 else:
+                    if current is not None and desired is not current:
+                        self._adopt_cas_meta(current, desired)
                     desired.version = cur_version
-                    ops.append(Op(key, desired.to_bytes()))
-                    writes.append((id_, desired))
+                    moved = cur_key != target
+                    if moved:
+                        # Move-on-write (shape owned by move_txn_parts).
+                        # The batch already carries Compare(cur_key,
+                        # cur_version) from above; add the rest.
+                        mc, mo = move_txn_parts(
+                            target, cur_key, desired.to_bytes(), cur_version
+                        )
+                        compares.append(mc[0])
+                        ops.extend(mo)
+                    else:
+                        ops.append(Op(target, desired.to_bytes()))
+                    writes.append((id_, desired, moved))
                     results[id_] = desired
             ops.extend(extra_ops)
             if not ops:
@@ -210,9 +280,14 @@ class KVTable(Generic[R]):
             ok, _ = self.store.txn(compares, ops, [])
             if ok:
                 # Refresh versions like conditional_set does (the
-                # conditionalSetAndGet idiom): written keys bumped once.
-                for id_, rec in writes:
-                    rec.version += 1
+                # conditionalSetAndGet idiom): written keys bumped once;
+                # a moved record is a fresh create at the canonical key.
+                for id_, rec, moved in writes:
+                    if moved:
+                        rec.version = 1
+                        rec._from_flat = False
+                    else:
+                        rec.version += 1
                 return results
         raise CasFailed(
             f"batch_mutate({[i for i, _ in mutations]}): "
@@ -234,21 +309,45 @@ class BucketedKVTable(KVTable[R]):
     bucket without fan-in.
 
     Legacy FLAT keys (``<prefix><id>`` from pre-bucketing versions) are
-    NOT read by this table: migrate them explicitly with
-    ``python -m modelmesh_tpu.kv.migrate`` while the fleet is stopped.
-    (An earlier lazy migrate-on-read was removed deliberately: two keys
-    mapping to one id breaks TableView's per-key version fencing — the
-    PUT/DELETE pair fired spurious DELETED events — and a read that
-    writes both splits the registry across a mixed-version fleet and
-    violates KV-migration read-only mode.)
+    normally NOT read by this table: migrate them with
+    ``python -m modelmesh_tpu.kv.migrate``. During a FENCED LIVE
+    migration (kv/migrate.py: the operator advertises a migration epoch
+    every instance's ``migration_fence`` watches) the table switches to
+    dual-read + move-on-write semantics:
+
+    - reads fall back to the flat key when the bucketed one is absent
+      (bucketed preferred — exactly one value per id), marking the
+      record ``_from_flat`` so its CAS guards the key it came from;
+    - any CAS against a flat-read record commits as one txn that
+      creates the bucketed key (absence-guarded) and deletes the flat
+      one (version-guarded): the first writer to touch a record migrates
+      it, and the migrator's own move txn uses the same guards, so
+      exactly one move per key can ever commit (no split brain);
+    - scans dedupe with bucketed preferred.
+
+    An earlier UNFENCED lazy migrate-on-read was removed deliberately —
+    without the epoch fence, two keys mapping to one id broke TableView
+    (spurious DELETED events) and split CAS writers across a
+    mixed-version fleet. The fence plus TableView's per-source-key event
+    fencing are what make the live mode sound.
     """
 
     def __init__(
         self, store: KVStore, prefix: str, record_cls: Type[R],
-        n_buckets: int = 128,
+        n_buckets: int = 128, migration_fence=None,
     ):
         super().__init__(store, prefix, record_cls)
         self.n_buckets = n_buckets
+        # kv.migrate.MigrationFence (or None): live-migration epoch.
+        self.migration_fence = migration_fence
+
+    def _fence_active(self) -> bool:
+        fence = self.migration_fence
+        return fence is not None and fence.active
+
+    def flat_key(self, id_: str) -> str:
+        """The pre-bucketing legacy key for ``id_``."""
+        return self.prefix + id_
 
     def _bucket(self, id_: str) -> int:
         import zlib
@@ -260,12 +359,127 @@ class BucketedKVTable(KVTable[R]):
 
     def key_to_id(self, key: str) -> str:
         rest = key[len(self.prefix):]
-        _, _, id_ = rest.partition("/")
-        return id_ or rest  # tolerate stray un-bucketed keys
+        if BUCKET_SEG.match(rest):
+            return rest[3:]
+        # Legacy flat key (pre-bucketing layout / mid-live-migration):
+        # the whole rest IS the id — which may itself contain slashes,
+        # so never split on the first one.
+        return rest
 
-    # Scans are inherited: range_paged over the whole prefix already
-    # bounds every RPC by page_size — iterating the 128 bucket prefixes
-    # separately would impose a >=128-RPC floor per scan for nothing.
+    # Scans inherit range_paged over the whole prefix (every RPC bounded
+    # by page_size — iterating 128 bucket prefixes separately would
+    # impose a >=128-RPC floor per scan for nothing); the live-migration
+    # override below only adds the flat/bucketed dedupe.
+
+    def get(self, id_: str) -> Optional[R]:
+        rec = super().get(id_)
+        if rec is None and self._fence_active():
+            kv = self.store.get(self.flat_key(id_))
+            if kv is not None:
+                rec = self.record_cls.from_bytes(kv.value, kv.version)
+                rec._from_flat = True
+            else:
+                # TOCTOU: a move txn can commit between the bucketed
+                # miss and the flat read, making a record that exists
+                # throughout look absent (and absent = "unregistered" to
+                # callers like the janitor, which would drop the serving
+                # copy). The move is atomic, so one more bucketed read
+                # closes the window.
+                rec = super().get(id_)
+        return rec
+
+    def scan(
+        self, page_size: int = 1000
+    ) -> Iterator[tuple[str, str, R]]:
+        if not self._fence_active():
+            yield from super().scan(page_size)
+            return
+        # Dual-scan dedupe, bucketed preferred. Flat entries are buffered
+        # to the end (flat/bucketed keys interleave in sort order, so a
+        # flat record can precede its bucketed twin in the stream); the
+        # buffer is bounded by the unmigrated remainder, which only
+        # shrinks as the migration proceeds. Deliberate trade-off: at
+        # migration START the remainder is the whole registry, so a scan
+        # (TableView seed, janitor pass) holds every flat record and the
+        # flush below pays one canonical-key re-read per still-flat id —
+        # O(remaining) extra gets, correctness-first for the short
+        # operator-run window between advertise(LIVE) and DONE. (The
+        # seed already materializes the full table regardless.)
+        flat: dict[str, tuple[str, R]] = {}
+        bucketed: set[str] = set()
+        for kv in self.store.range_paged(self.prefix, page_size):
+            id_ = self.key_to_id(kv.key)
+            rec = self.record_cls.from_bytes(kv.value, kv.version)
+            if BUCKET_SEG.match(kv.key[len(self.prefix):]):
+                bucketed.add(id_)
+                yield id_, kv.key, rec
+            else:
+                rec._from_flat = True
+                flat[id_] = (kv.key, rec)
+        for id_, (key, rec) in flat.items():
+            if id_ in bucketed:
+                continue
+            # Same TOCTOU as get(): a move landing after this flat entry
+            # was buffered (into a page position already consumed) would
+            # make the buffered copy stale and the bucketed form silently
+            # missing from the stream — re-read the canonical key and
+            # yield whichever form now exists.
+            kv = self.store.get(self._key(id_))
+            if kv is not None:
+                yield id_, kv.key, self.record_cls.from_bytes(
+                    kv.value, kv.version
+                )
+            else:
+                yield id_, key, rec
+
+    def conditional_set(self, id_: str, record: R, lease: int = 0) -> R:
+        if not getattr(record, "_from_flat", False):
+            return super().conditional_set(id_, record, lease)
+        # Move-on-write: the record was read from the legacy flat key —
+        # commit the mutation at the canonical bucketed key and retire
+        # the flat one in ONE txn (shape owned by move_txn_parts; the
+        # migrator's sweep uses the same helper, so this and it are the
+        # mutually-exclusive CAS writers for the move).
+        target = self._key(id_)
+        flat = self.flat_key(id_)
+        ok, _ = self.store.txn(
+            *move_txn_parts(target, flat, record.to_bytes(),
+                            record.version, lease)
+        )
+        if not ok:
+            raise CasFailed(id_)
+        record.version = 1  # fresh create at the canonical key
+        record._from_flat = False
+        return record
+
+    def _conditional_delete_current(self, id_: str, current: R) -> bool:
+        if not getattr(current, "_from_flat", False):
+            return super()._conditional_delete_current(id_, current)
+        flat = self.flat_key(id_)
+        ok, _ = self.store.txn(
+            [Compare(flat, current.version)], [Op(flat)], []
+        )
+        return ok
+
+    def _adopt_cas_meta(self, current: R, desired: R) -> None:
+        if getattr(current, "_from_flat", False):
+            desired._from_flat = True
+
+    def _record_key(self, id_: str, current: Optional[R]) -> str:
+        if current is not None and getattr(current, "_from_flat", False):
+            return self.flat_key(id_)
+        return self._key(id_)
+
+    def delete(self, id_: str) -> bool:
+        # An unregistration mid-migration must retire BOTH forms — and
+        # FLAT FIRST: every move txn guards on the flat key's version,
+        # so once the flat form is gone no mover can re-create the
+        # bucketed one; deleting bucketed first would let a move commit
+        # between the two deletes and resurrect the record.
+        deleted = False
+        if self._fence_active():
+            deleted = self.store.delete(self.flat_key(id_))
+        return super().delete(id_) or deleted
 
 
 class TableView(Generic[R]):
@@ -279,6 +493,14 @@ class TableView(Generic[R]):
     def __init__(self, table: KVTable[R]):
         self.table = table
         self._cache: dict[str, R] = {}  #: guarded-by: _lock
+        # id -> the store key the cached record came from. Normally the
+        # canonical key; during a live layout migration (BucketedKVTable
+        # dual mode) two keys transiently map to one id, and events are
+        # fenced per SOURCE key: a move txn's DELETE of the legacy key
+        # must never evict the just-applied canonical record, and a
+        # legacy-key PUT must never clobber a canonical one — so a
+        # mixed-epoch view holds exactly one record per id throughout.
+        self._src: dict[str, str] = {}  #: guarded-by: _lock
         self._lock = mm_rlock("TableView._lock")
         self._listeners: list[TableListener] = []
         self._ready = threading.Event()
@@ -291,7 +513,7 @@ class TableView(Generic[R]):
         # (ModelMeshInstance caches its ClusterView per epoch) so the
         # request hot path copies the table only when it actually moved.
         self._epoch = 0  #: guarded-by: _lock
-        # Deletions applied by the watch before the initial seed lands;
+        # Store KEYS deleted by the watch before the initial seed lands;
         # the seed must not resurrect them from its older listing. None
         # once seeding completed (the common steady state).
         #: guarded-by: _lock
@@ -301,22 +523,20 @@ class TableView(Generic[R]):
             table.prefix, self._on_events, start_rev=0
         )
         # Seed synchronously for immediate availability; watch replay will
-        # redeliver, which _apply treats idempotently by mod version. The
-        # paged table scan runs OUTSIDE _lock (blocking-under-lock: the
-        # watch dispatcher must never convoy behind an O(table) KV scan),
-        # so a watch event may be APPLIED before the seed lands — the
-        # seed installs version-gated (never clobbering a newer
-        # watch-applied record with the stale listing) and skips keys the
-        # watch already deleted (_seed_tombstones).
-        seed = list(table.items())
+        # redeliver, which the admit rules treat idempotently by version.
+        # The paged table scan runs OUTSIDE _lock (blocking-under-lock:
+        # the watch dispatcher must never convoy behind an O(table) KV
+        # scan), so a watch event may be APPLIED before the seed lands —
+        # the seed installs through the same admit rules (never clobbering
+        # a newer watch-applied record with the stale listing) and skips
+        # keys the watch already deleted (_seed_tombstones).
+        seed = list(table.scan())
         with self._lock:
             tombstones = self._seed_tombstones or ()
-            for id_, rec in seed:
-                if id_ in tombstones:
+            for id_, key, rec in seed:
+                if key in tombstones:
                     continue
-                prev = self._cache.get(id_)
-                if prev is None or rec.version > prev.version:
-                    self._cache[id_] = rec
+                self._admit_locked(id_, key, rec)
             self._seed_tombstones = None
             self._epoch += 1
         self._ready.set()
@@ -324,28 +544,57 @@ class TableView(Generic[R]):
     def add_listener(self, listener: TableListener) -> None:
         self._listeners.append(listener)
 
+    def _admit_locked(
+        self, id_: str, key: str, rec: R
+    ) -> Optional[TableEvent]:
+        """Install ``rec`` (from store key ``key``) unless fenced off;
+        returns the event to fire, or None. Callers hold _lock.
+
+        Versions compare only WITHIN one source key (per-key counters are
+        unrelated across keys); across keys the canonical key wins —
+        that is the bucketed-preferred rule that keeps a migrating view
+        at one record per id."""
+        prev = self._cache.get(id_)
+        if prev is not None:
+            prev_key = self._src.get(id_, key)
+            if prev_key == key:
+                if prev.version >= rec.version:
+                    return None  # stale/duplicate replay
+            elif key != self.table.raw_key(id_):
+                # A non-canonical (legacy) put while the canonical record
+                # is cached: fenced off, the canonical one is newer by
+                # construction (the move created it from the legacy value).
+                return None
+        self._cache[id_] = rec
+        self._src[id_] = key
+        return TableEvent.ADDED if prev is None else TableEvent.UPDATED
+
     def _on_events(self, events: list[WatchEvent]) -> None:
         for ev in events:
             id_ = self.table.key_to_id(ev.kv.key)
             with self._lock:
                 if ev.type is EventType.DELETE:
-                    existed = self._cache.pop(id_, None)
                     if self._seed_tombstones is not None:
-                        self._seed_tombstones.add(id_)
-                    event = TableEvent.DELETED if existed is not None else None
+                        self._seed_tombstones.add(ev.kv.key)
                     rec = None
+                    # Per-source-key fencing: the delete only applies when
+                    # the cached record came from THIS key (a move txn's
+                    # legacy-key tombstone arrives after the canonical
+                    # put and must not evict it).
+                    if (
+                        id_ in self._cache
+                        and self._src.get(id_, ev.kv.key) == ev.kv.key
+                    ):
+                        self._cache.pop(id_, None)
+                        self._src.pop(id_, None)
+                        event = TableEvent.DELETED
+                    else:
+                        event = None
                 else:
                     rec = self.table.record_cls.from_bytes(
                         ev.kv.value, ev.kv.version
                     )
-                    prev = self._cache.get(id_)
-                    if prev is not None and prev.version >= rec.version:
-                        event = None  # stale/duplicate replay
-                    else:
-                        self._cache[id_] = rec
-                        event = (
-                            TableEvent.ADDED if prev is None else TableEvent.UPDATED
-                        )
+                    event = self._admit_locked(id_, ev.kv.key, rec)
                 if event is not None:
                     self._epoch += 1
             if event is not None:

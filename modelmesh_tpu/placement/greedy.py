@@ -26,6 +26,7 @@ from modelmesh_tpu.placement.strategy import (
     PlacementStrategy,
 )
 from modelmesh_tpu.records import InstanceRecord, ModelRecord
+from modelmesh_tpu.reconfig.rolling import upversion_shortlist
 
 # Shortlist thresholds (tunable analogs of the reference's proximity rules).
 FREE_SPACE_SHORTLIST_RATIO = 0.75   # candidates with >= 75% of best free
@@ -85,6 +86,11 @@ class GreedyStrategy(PlacementStrategy):
             ]
             if pref:
                 shortlist = pref
+        # Rolling-upgrade bias (reconfig/rolling.py): while the fleet
+        # spans versions, only newest-version instances compete — applied
+        # BEFORE the load-here shortcut so a down-version requester can't
+        # capture the load and migrate the model backward.
+        shortlist = upversion_shortlist(shortlist)
         if any(iid == req.requesting_instance for iid, _ in shortlist):
             return LOAD_HERE
         # Least busy; stable tie-break on free space then id. min() over a
@@ -112,9 +118,17 @@ class GreedyStrategy(PlacementStrategy):
             rec = live.get(iid)
             if rec is None:
                 continue
-            # Per-type warming penalty: a slow-loading type stays
-            # deprioritized longer after activation than a fast one.
-            key = (now - load_ts < expect, rec.req_per_minute, iid)
+            # DRAINING copies rank behind every healthy one (reconfig/:
+            # traffic shifts to survivors the moment their copies are
+            # servable) but stay eligible — during the pre-copy window
+            # the draining instance may hold the ONLY copy, and serving
+            # it is exactly what makes the drain zero-gap. Per-type
+            # warming penalty: a slow-loading type stays deprioritized
+            # longer after activation than a fast one.
+            key = (
+                rec.draining, now - load_ts < expect,
+                rec.req_per_minute, iid,
+            )
             if best_key is None or key < best_key:
                 best_key, best = key, iid
         if best is not None:

@@ -58,15 +58,19 @@ class ClusterView:
 
     @functools.cached_property
     def _placeable(self) -> list[tuple[str, InstanceRecord]]:
-        return [(i, r) for i, r in self._live if not r.disabled]
+        return [
+            (i, r) for i, r in self._live
+            if not r.disabled and not r.draining
+        ]
 
     def live(self) -> list[tuple[str, InstanceRecord]]:
         return self._live
 
     def placeable(self) -> list[tuple[str, InstanceRecord]]:
-        """Candidates for NEW placements: live and not admin-drained.
-        Serve routing keeps using live() — a disabled instance's
-        already-loaded copies continue serving (drain, not eviction)."""
+        """Candidates for NEW placements: live, not admin-drained, and not
+        DRAINING (reconfig/drain.py). Serve routing keeps using live() —
+        a disabled or draining instance's already-loaded copies continue
+        serving (drain, not eviction)."""
         return self._placeable
 
 

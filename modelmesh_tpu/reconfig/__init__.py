@@ -1,0 +1,24 @@
+"""Zero-downtime reconfiguration: change the fleet while it serves.
+
+Three pillars (ROADMAP item: rolling upgrades / drain / live registry
+migration, framed by "Integrative Dynamic Reconfiguration" — reconfigure
+while serving, with state handoff instead of cold reload):
+
+- ``reconfig.drain``   — ``DrainController``: mark an instance DRAINING
+  (excluded from new placements, deprioritized for serving), pre-copy its
+  hot models to survivors over the ``transfer/`` peer-streaming path,
+  demote cold ones into the host tier, wait for survivor copies to be
+  servable, then deregister cleanly. Wired into
+  ``ModelMeshInstance.pre_shutdown`` so SIGTERM triggers it.
+- ``reconfig.rolling`` — version-aware wave planning over
+  ``InstanceRecord.instance_version``: at most ``MM_UPGRADE_MAX_UNAVAILABLE``
+  instances drain per wave, and placement prefers up-version targets
+  while a rollout is active so models migrate forward, never backward.
+- ``kv.migrate``       — the live (fenced) registry-layout migration is
+  the third pillar; it lives beside the offline migrator in
+  ``modelmesh_tpu/kv/migrate.py``.
+
+Proven in the PR-5 deterministic simulation: ``sim/scenarios.py`` drives
+a full-fleet rolling restart under seeded Zipf load with
+no-demanded-model-unserved and no-request-failure invariants.
+"""

@@ -210,6 +210,13 @@ class InstanceRecord(Record):
     # but NOT migrating and NOT holding peers' readiness (unlike
     # shutting_down).
     disabled: bool = False
+    # Graceful drain in progress (reconfig/drain.py): excluded from new
+    # placements and deprioritized as a serve target (survivor copies are
+    # preferred once servable), but still LIVE — already-loaded copies
+    # keep serving while the drain pre-copies them to survivors. Unlike
+    # shutting_down, a draining instance is still a routable member of
+    # the fleet; unlike disabled, it IS migrating and will deregister.
+    draining: bool = False
     endpoint: str = ""           # host:port of the instance's internal RPC
     location: str = ""           # node/host for anti-affinity
     zone: str = ""

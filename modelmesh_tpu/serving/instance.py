@@ -168,6 +168,8 @@ class InstanceConfig:
         publish_coalesce_ms: Optional[int] = None,
         peer_fetch: Optional[bool] = None,
         host_tier_bytes: Optional[int] = None,
+        drain_on_sigterm: Optional[bool] = None,
+        drain_timeout_ms: Optional[int] = None,
     ):
         self.instance_id = instance_id or f"i-{uuid.uuid4().hex[:8]}"
         self.kv_prefix = kv_prefix.rstrip("/")
@@ -223,6 +225,17 @@ class InstanceConfig:
         if host_tier_bytes is None:
             host_tier_bytes = _envs.get_int("MM_HOST_TIER_BYTES")
         self.host_tier_bytes = host_tier_bytes
+        # Graceful drain (reconfig/drain.py): pre_shutdown runs the
+        # DrainController (DRAINING advertisement, survivor pre-copy over
+        # the transfer path, then deregister) instead of the legacy
+        # immediate shutting_down migration. MM_DRAIN_TIMEOUT_MS bounds
+        # the pre-copy pass.
+        if drain_on_sigterm is None:
+            drain_on_sigterm = _envs.get_bool("MM_DRAIN_ON_SIGTERM")
+        self.drain_on_sigterm = drain_on_sigterm
+        if drain_timeout_ms is None:
+            drain_timeout_ms = _envs.get_int("MM_DRAIN_TIMEOUT_MS")
+        self.drain_timeout_ms = drain_timeout_ms
 
 
 class ModelMeshInstance:
@@ -273,6 +286,10 @@ class ModelMeshInstance:
         # Admin drain via dynamic config `disable` (ModelMesh.java:1008-1061):
         # stop taking NEW loads/placements; keep serving what's loaded.
         self.disabled = False
+        # Graceful drain in progress (reconfig/drain.py): advertised in
+        # the instance record so peers stop placing here and deprioritize
+        # us as a serve target while the drain pre-copies to survivors.
+        self.draining = False
         # Dynamic config `log_each_invocation`.
         self.log_each_invocation = False
         self.is_leader = False
@@ -370,10 +387,19 @@ class ModelMeshInstance:
         self.transfer = WeightTransferManager(self)
 
         prefix = self.config.kv_prefix
+        # Live registry-migration fence (kv/migrate.py): while an
+        # operator-run flat->bucketed migration advertises its epoch,
+        # the registry table dual-reads (bucketed preferred) and every
+        # CAS against a flat-read record moves it — the fleet keeps
+        # serving through the layout change.
+        from modelmesh_tpu.kv.migrate import MigrationFence
+
+        self.migration_fence = MigrationFence(store, prefix)
         # Bucketed (128): scans page bucket-by-bucket so no range RPC
         # carries the whole 100k-model registry (reference ModelMesh.java:169).
         self.registry: KVTable[ModelRecord] = BucketedKVTable(
-            store, f"{prefix}/registry", ModelRecord
+            store, f"{prefix}/registry", ModelRecord,
+            migration_fence=self.migration_fence,
         )
         self.registry_view: TableView[ModelRecord] = TableView(self.registry)
         self.instances: KVTable[InstanceRecord] = KVTable(
@@ -538,6 +564,7 @@ class ModelMeshInstance:
             req_per_minute=self.rate.rpm() if hasattr(self, "rate") else 0,
             shutting_down=self.shutting_down,
             disabled=self.disabled,
+            draining=self.draining,
             endpoint=self.config.endpoint,
             location=self.config.location,
             zone=self.config.zone,
@@ -1292,7 +1319,7 @@ class ModelMeshInstance:
     def _local_load_allowed(self, required_units: int) -> bool:
         """Churn guard: when full, don't evict recently-used entries
         (reference :3872-3884)."""
-        if self.shutting_down or self.disabled:
+        if self.shutting_down or self.disabled or self.draining:
             return False
         free = self.cache.capacity - self.cache.weight
         if free >= required_units:
@@ -1889,21 +1916,34 @@ class ModelMeshInstance:
         except Exception:  # noqa: BLE001 — best-effort; demand-load covers
             pass
 
-    def _remove_local(self, model_id: str) -> bool:
+    def _remove_local(self, model_id: str, demote: bool = False) -> bool:
         # Deliberate removal (unregister / deletion cleanup / shutdown
         # migration) drops the host-tier snapshot too — unlike capacity
         # eviction, which demotes into it. The registry host claim falls
-        # with remove_instance in _deregister below.
-        self.transfer.drop_host_copy(model_id)
+        # with remove_instance in _deregister below. ``demote=True``
+        # (drain of a cold copy, reconfig/drain.py) follows the eviction
+        # convention instead: snapshot into the host tier BEFORE the
+        # runtime unload releases the handle and advertise the host claim
+        # with the deregistration, so the copy stays a peer-fetch source
+        # for the rest of the drain window.
         ce = self.cache.get_quietly(model_id)
         if ce is None:
+            if not demote:
+                self.transfer.drop_host_copy(model_id)
             return False
+        demoted = False
+        if demote:
+            demoted = ce.state is EntryState.ACTIVE and (
+                self.transfer.demote_evicted(model_id, ce)
+            )
+        else:
+            self.transfer.drop_host_copy(model_id)
         if not self.cache.remove_if_value(model_id, ce):
             return False
         was_active = ce.state is EntryState.ACTIVE
         ce.remove()
         self._drop_model_rate(model_id)
-        self._deregister(model_id)
+        self._deregister(model_id, demoted=demoted)
         if was_active and self.loader.requires_unload:
             self._async_unload(model_id, ce.weight_units)
         return True
@@ -2059,10 +2099,22 @@ class ModelMeshInstance:
     # shutdown                                                           #
     # ------------------------------------------------------------------ #
 
-    def pre_shutdown(self, deadline_s: float = 30.0) -> None:
-        """Migration: stop accepting placements, trigger copies elsewhere
-        for recently-used models, deregister everything (reference
-        preShutdown, ModelMesh.java:6959-7143)."""
+    def pre_shutdown(self, deadline_s: Optional[float] = None) -> None:
+        """Graceful shutdown migration. Default path (MM_DRAIN_ON_SIGTERM):
+        the reconfig DrainController — advertise DRAINING while still
+        serving, pre-copy hot models to survivors over the transfer path
+        (each local copy is dropped only after its survivor is servable:
+        zero serving gap), host-tier demote the cold ones, then flip
+        shutting_down and deregister. Legacy path (knob off): the
+        reference preShutdown shape (ModelMesh.java:6959-7143) — flip
+        shutting_down first, then migrate best-effort."""
+        if deadline_s is None:
+            deadline_s = self.config.drain_timeout_ms / 1000.0
+        if self.config.drain_on_sigterm:
+            from modelmesh_tpu.reconfig.drain import DrainController
+
+            DrainController(self, deadline_s=deadline_s).drain()
+            return
         clock = get_clock()
         self.shutting_down = True
         self.publish_instance_record(force=True)
@@ -2104,6 +2156,7 @@ class ModelMeshInstance:
         self._session.close()
         self.registry_view.close()
         self.instances_view.close()
+        self.migration_fence.close()
         close = getattr(self.loader, "close", None)
         if close:
             close()

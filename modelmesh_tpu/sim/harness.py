@@ -236,6 +236,28 @@ class SimCluster:
         # Instances this scenario demanded copies of (feeds the
         # availability invariant).
         self.demanded: set[str] = set()
+        # Per-request outcome log: (virtual_ms, model_id, ok, error).
+        # The reconfiguration scenarios' no-failure-spike check reads
+        # this — "no demanded model unserved at any virtual instant" is
+        # asserted over the observed probe traffic, not just quiescence.
+        self.request_log: list[tuple[int, str, bool, str]] = []
+        # instance_id -> virtual ms it died (kill or post-drain); the
+        # runner merges this into the dead-placement grace bookkeeping
+        # for deaths IT didn't schedule (e.g. rolling-upgrade waves).
+        self.deaths: dict[str, int] = {}
+        # Drain reports by instance id (reconfig/drain.py), for scenario
+        # checks (non-vacuity: the drained pod really migrated copies).
+        self.drain_reports: dict = {}
+        # reconfig/rolling.py UpgradeReport of the last rolling_upgrade.
+        self.upgrade_report = None
+        # Defaults reused when a scenario adds replacement instances
+        # mid-run (rolling upgrade waves).
+        self._default_instance = dict(
+            capacity_bytes=capacity_bytes,
+            start_tasks=start_tasks,
+            load_delay_ms=load_delay_ms,
+            **(instance_kwargs or {}),
+        )
         # Transfer-progress fault hooks: fn(sender_iid, model_id,
         # chunk_index) called on EVERY peer chunk fetch before it is
         # served — scenarios arm mid-stream faults here (kill or
@@ -394,6 +416,69 @@ class SimCluster:
             raise KeyError(iid)
         return pod
 
+    def spawn(self, instance_version: str = "") -> SimPod:
+        """Add a replacement instance with the cluster's construction
+        defaults — the rolling-upgrade 'new pod at the new version'."""
+        kwargs = dict(self._default_instance)
+        if instance_version:
+            kwargs["instance_version"] = instance_version
+        return self.add_instance(**kwargs)
+
+    def drain(self, iid: str):
+        """Graceful drain + terminate (reconfig/drain.py semantics): the
+        instance pre-copies its hot copies to survivors, deregisters,
+        and only then dies. Returns the DrainReport."""
+        from modelmesh_tpu.reconfig.drain import DrainController
+
+        pod = self.by_id(iid)
+        if not pod.alive:
+            return None
+        report = DrainController(pod.instance).drain()
+        self.drain_reports[iid] = report
+        self.kill(iid)
+        return report
+
+    def rolling_upgrade(
+        self, target_version: str, max_unavailable: int = 1,
+    ):
+        """Drive the fleet to ``target_version`` in drain waves — the
+        reconfig/rolling.py coordinator with its hooks mapped onto this
+        cluster. Runs synchronously on the calling (worker) thread."""
+        from modelmesh_tpu.reconfig.rolling import RollingUpgradeCoordinator
+
+        def list_instances():
+            return [
+                (p.iid, p.instance._build_instance_record())
+                for p in self.live_pods()
+            ]
+
+        def replace(_old_iid: str, version: str) -> str:
+            return self.spawn(version).iid
+
+        def wait_ready(expect_n: int) -> None:
+            # Readiness = every live pod SEES every live pod (the
+            # replacements included) — a raw count would be satisfied by
+            # the killed pods' not-yet-deleted records and let the next
+            # wave start while replacements are invisible to routing.
+            live_ids = {p.iid for p in self.live_pods()}
+            for pod in self.live_pods():
+                pod.instance.instances_view.wait_for(
+                    lambda v: live_ids <= {iid for iid, _ in v.items()},
+                    timeout=10,
+                )
+
+        coordinator = RollingUpgradeCoordinator(
+            target_version,
+            list_instances=list_instances,
+            drain_instance=self.drain,
+            replace_instance=replace,
+            wait_ready=wait_ready,
+            max_unavailable=max_unavailable,
+        )
+        report = coordinator.run()
+        self.upgrade_report = report
+        return report
+
     def kill(self, iid: str) -> None:
         """Crash an instance: tasks stop, the serving surface vanishes,
         the session lease is revoked (peers see the ephemeral record
@@ -401,6 +486,7 @@ class SimCluster:
         pod = self.by_id(iid)
         if not pod.alive:
             return
+        self.deaths.setdefault(iid, _clock.get_clock().now_ms())
         pod.alive = False
         pod.tasks.stop()
         pod.instance.shutting_down = True
@@ -456,11 +542,17 @@ class SimCluster:
 
     def invoke(self, model_id: str, via: Optional[str] = None) -> None:
         self.demanded.add(model_id)
-        pod = self.by_id(via) if via else self.first_live()
+        now = _clock.get_clock().now_ms()
         try:
+            pod = self.by_id(via) if via else self.first_live()
             pod.instance.invoke_model(model_id, "/sim/Predict", b"x", [])
         except Exception as e:  # noqa: BLE001 — demand may race faults
+            self.request_log.append(
+                (now, model_id, False, f"{type(e).__name__}: {e}")
+            )
             log.debug("sim invoke(%s) raced a fault: %s", model_id, e)
+        else:
+            self.request_log.append((now, model_id, True, ""))
 
     def unregister(self, model_id: str) -> None:
         try:
@@ -468,6 +560,38 @@ class SimCluster:
             self.demanded.discard(model_id)
         except Exception as e:  # noqa: BLE001
             log.debug("sim unregister(%s) raced a fault: %s", model_id, e)
+
+    # -- quiescence --------------------------------------------------------
+
+    def pools_pending(self) -> int:
+        """Queued/running async janitorial tasks (deregisters, unloads,
+        deletion cleanups) across live pods. Non-zero at invariant time
+        means a registry mutation is still in flight — the source of the
+        registry_cache_convergence flake the quiesce drain closes."""
+        total = 0
+        for pod in self.live_pods():
+            inst = pod.instance
+            total += inst._unload_pool.pending + inst._cleanup_pool.pending
+        return total
+
+    def quiesce_async_work(
+        self, clock, step_ms: int = 2_000, wall_timeout_s: float = 10.0,
+    ) -> bool:
+        """Pump virtual time until every pod's cleanup/unload pool is
+        idle (a pending task may be sleeping on injected virtual
+        latency). Wall-bounded: a task wedged on something external
+        (e.g. an unreleased hold gate) times out rather than hanging the
+        run — the caller's inline janitor pass then repairs whatever the
+        stuck mutation would have."""
+        import time as _wall
+
+        deadline = _wall.monotonic() + wall_timeout_s
+        while self.pools_pending():
+            if _wall.monotonic() >= deadline:
+                return False
+            clock.advance(step_ms)
+            _wall.sleep(0.001)
+        return True
 
     # -- teardown ----------------------------------------------------------
 

@@ -23,6 +23,13 @@ Catalog (see docs/testing.md for the rationale of each):
 - ``host_claims_converged`` — registry host-tier claims
   (transfer/ demotions) on LIVE instances have an actual host-resident
   snapshot behind them.
+- ``draining_deregistered`` — the DRAINING vocabulary (reconfig/): a
+  live instance still advertising ``draining`` at quiescence has
+  finished its drain, so no registry placement may point at it. Without
+  this term the suite would misread a drained-but-alive pod's leftover
+  placements as some other checker's problem (it is neither a dead
+  placement — the pod is alive — nor a cache mismatch once the local
+  copy is gone).
 """
 
 from __future__ import annotations
@@ -210,6 +217,30 @@ def host_claims_converged(cluster: "SimCluster") -> list[str]:
     return out
 
 
+def draining_deregistered(cluster: "SimCluster") -> list[str]:
+    """A LIVE instance still advertising DRAINING at quiescence has
+    completed (or deadline-swept) its drain: every local copy was
+    migrated/deregistered, so a registry placement or loading claim
+    still pointing at it is a drain that lost state — not a dead
+    placement (the pod is alive) and invisible to the cache-convergence
+    check (the local cache is already empty)."""
+    out: list[str] = []
+    draining = {
+        p.iid for p in cluster.live_pods() if p.instance.draining
+    }
+    if not draining:
+        return out
+    inst = cluster.first_live().instance
+    for mid, mr in inst.registry.items():
+        for iid in sorted(mr.all_placements):
+            if iid in draining:
+                out.append(
+                    f"record {mid} still places on {iid}, which finished "
+                    "draining (deregistration lost?)"
+                )
+    return out
+
+
 def check_all(
     cluster: "SimCluster",
     dead_since_ms: dict[str, int],
@@ -226,4 +257,5 @@ def check_all(
         "vmodel_resolution_acyclic": vmodel_resolution_acyclic(cluster),
         "cache_weight_consistent": cache_weight_consistent(cluster),
         "host_claims_converged": host_claims_converged(cluster),
+        "draining_deregistered": draining_deregistered(cluster),
     }

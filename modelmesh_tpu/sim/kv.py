@@ -109,6 +109,7 @@ class _InstanceKV(KVStore):
 
     def _before_op(self, op: str, key: str = "") -> None:
         self.sim.check_partition(self.owner)
+        self.sim.check_hold(self.owner, op, key)
         cfg = self.sim.config
         if cfg.latency_ms or cfg.latency_jitter_ms:
             extra = cfg.latency_jitter_ms * self._draw("lat:" + op, key)
@@ -152,6 +153,11 @@ class _InstanceKV(KVStore):
     ) -> tuple[bool, list[KeyValue]]:
         compares = list(compares)
         self._before_op("txn")
+        # Hold gates match on the guarded key (the latency draw above
+        # keeps its keyless identity so armed holds don't perturb the
+        # seeded fault schedule of unrelated ops).
+        if compares:
+            self.sim.check_hold(self.owner, "txn", compares[0].key)
         if self._amplify_cas(compares):
             # Spurious conflict: by the CAS contract the caller re-reads
             # and retries; a correct caller converges, a broken one is
@@ -306,6 +312,13 @@ class SimKV:
         self._partitioned: set[str] = set()
         #: guarded-by: _lock
         self._facades: dict[str, _InstanceKV] = {}
+        # Write-hold gates: (owner, key-substring, release event). A
+        # matching write BLOCKS (wall, not virtual) until released —
+        # the deterministic way to model "this async mutation lands
+        # arbitrarily late" (e.g. an eviction's deregister CAS racing
+        # the quiesce). Released wholesale on close().
+        #: guarded-by: _lock
+        self._holds: list[tuple[str, str, threading.Event]] = []
         self._lock = threading.Lock()
 
     def for_instance(self, instance_id: str) -> KVStore:
@@ -340,6 +353,33 @@ class SimKV:
                 f"simulated partition: {instance_id} cannot reach the KV"
             )
 
+    # -- write-hold gates --------------------------------------------------
+
+    def hold_writes(self, instance_id: str, key_substr: str) -> threading.Event:
+        """Arm a gate: ``instance_id``'s writes touching a key containing
+        ``key_substr`` block until the returned event is set."""
+        ev = threading.Event()
+        with self._lock:
+            self._holds.append((instance_id, key_substr, ev))
+        return ev
+
+    def release_holds(self) -> None:
+        with self._lock:
+            holds, self._holds = self._holds, []
+        for _, _, ev in holds:
+            ev.set()
+
+    def check_hold(self, instance_id: str, op: str, key: str) -> None:
+        if not self._holds or not key:
+            return
+        if op not in ("put", "delete", "txn"):
+            return
+        with self._lock:
+            holds = list(self._holds)
+        for owner, sub, ev in holds:
+            if owner == instance_id and sub in key:
+                ev.wait()
+
     # -- session faults ----------------------------------------------------
 
     def expire_instance_session(self, session_key: str) -> bool:
@@ -353,6 +393,7 @@ class SimKV:
         return True
 
     def close(self) -> None:
+        self.release_holds()
         with self._lock:
             facades = list(self._facades.values())
         for facade in facades:

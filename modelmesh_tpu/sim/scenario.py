@@ -16,6 +16,13 @@ are stable.
 
 Event kinds:
   kill <iid>              crash an instance (lease revoked, no migration)
+  drain <iid>             graceful drain (reconfig/drain.py) then kill:
+                          pre-copy to survivors, deregister, die
+  add_instance [version]  join a fresh instance (cluster defaults),
+                          optionally at a new instance_version
+  rolling_upgrade <version> [max_unavailable]
+                          reconfig/rolling.py coordinator: drain waves +
+                          replacements until the fleet is at <version>
   partition <iid>         KV blackout for one instance
   heal <iid>              end the blackout (held watch events flush)
   expire_lease <iid>      revoke the session lease under the instance
@@ -25,6 +32,20 @@ Event kinds:
   transfer_fault <model> <after_chunks> <kill|partition>
                           kill/partition the weight-stream SENDER once
                           it has served that many chunks (mid-stream)
+  squeeze <iid> <units>   shrink the instance's cache capacity (forces
+                          evictions + their async deregisters)
+  hold_kv_writes <iid> <key-substr>
+                          block that instance's matching KV writes until
+                          quiesce (deterministic "async mutation lands
+                          arbitrarily late")
+  register_flat <model>   write a LEGACY flat-layout registry record
+                          (pre-bucketing key shape) straight into the
+                          store — the live-migration scenarios' seed
+  migrate_fence <phase>   advertise the migration epoch (live|done)
+                          without running the sweep — how a scenario
+                          turns on dual-read before its workload starts
+  migrate_live            run the fenced live registry migration
+                          (kv/migrate.py) against the serving cluster
   register/ensure/invoke/unregister <model>   workload
 """
 
@@ -86,6 +107,12 @@ class Scenario:
     # Override the runner's virtual step for timing-sensitive scenarios
     # (observed timestamps quantize onto the step grid).
     step_ms: Optional[int] = None
+    # Quiesce hygiene: release hold gates, drain pending async
+    # deregisters/unloads, and run one inline janitor cycle before the
+    # invariant read (the registry_cache_convergence flake fix). Off
+    # only for the meta-test proving the regression scenario catches
+    # the reverted behavior.
+    quiesce_async: bool = True
 
 
 @dataclasses.dataclass
@@ -158,9 +185,50 @@ class ScenarioRunner:
             # chunk-progress threshold is crossed).
             cluster.arm_transfer_fault(args[0], int(args[1]), args[2])
             return
+        if kind == "hold_kv_writes":
+            cluster.kv.hold_writes(args[0], args[1])
+            return
+        if kind == "squeeze":
+            # Under the eviction lock the listener only SCHEDULES work —
+            # safe inline; the interesting part (the async deregister)
+            # runs on the pod's unload pool.
+            cluster.by_id(args[0]).instance.cache.set_capacity(int(args[1]))
+            return
+        if kind == "migrate_fence":
+            from modelmesh_tpu.kv.migrate import advertise_phase
+
+            advertise_phase(cluster.kv.inner, "mm", args[0])
+            return
+        if kind == "register_flat":
+            # Legacy pre-bucketing key shape, written straight to the
+            # inner store (an old-version fleet's leftover state).
+            from modelmesh_tpu.records import ModelRecord
+
+            mid = args[0]
+            rec = ModelRecord(model_type="sim", model_path=f"mem://{mid}")
+            cluster.kv.inner.put(f"mm/registry/{mid}", rec.to_bytes())
+            return
         if kind == "kill":
             self.dead_since_ms[args[0]] = clock.now_ms()
             target, targs = cluster.kill, (args[0],)
+        elif kind == "drain":
+            # Conservative death stamp at fire time (the actual kill
+            # lands when the drain completes — a clean drain leaves no
+            # placements for the grace to matter).
+            self.dead_since_ms[args[0]] = clock.now_ms()
+            target, targs = cluster.drain, (args[0],)
+        elif kind == "add_instance":
+            target, targs = cluster.spawn, (args[0] if args else "",)
+        elif kind == "rolling_upgrade":
+            mu = int(args[1]) if len(args) > 1 else 1
+            target, targs = cluster.rolling_upgrade, (args[0], mu)
+        elif kind == "migrate_live":
+            from modelmesh_tpu.kv.migrate import migrate_flat_registry_live
+
+            target, targs = (
+                lambda: migrate_flat_registry_live(cluster.kv.inner, "mm"),
+                (),
+            )
         elif kind == "register":
             target, targs = cluster.register, (args[0],)
         elif kind == "unregister":
@@ -174,7 +242,7 @@ class ScenarioRunner:
             raise ValueError(f"unknown scenario event kind: {kind}")
         t = threading.Thread(
             target=target, args=targs,
-            name=f"sim-ev-{kind}-{args[0]}", daemon=True,
+            name=f"sim-ev-{kind}-{args[0] if args else ''}", daemon=True,
         )
         t.start()
         self._workers.append(t)
@@ -242,12 +310,35 @@ class ScenarioRunner:
                 for t in self._workers:
                     t.join(timeout=5.0)
                 cluster.kv.inner.wait_idle(timeout=10.0)
+                if sc.quiesce_async:
+                    # Async-mutation drain (the registry_cache_convergence
+                    # flake fix): release hold gates so deliberately-late
+                    # writes land, wait (clock-pumped, wall-bounded) for
+                    # every pod's cleanup/unload pools to empty, then run
+                    # ONE inline janitor cycle per live pod — a late
+                    # eviction's deregister that landed after the last
+                    # scheduled janitor pass (or gave up its CAS) is
+                    # repaired deterministically before invariants read.
+                    cluster.kv.release_holds()
+                    cluster.quiesce_async_work(clock, self.step_ms)
+                    for pod in cluster.live_pods():
+                        try:
+                            pod.tasks._janitor_tick()
+                        except Exception:  # noqa: BLE001 — repair is
+                            # best-effort; invariants report what remains
+                            log.exception("quiesce janitor cycle failed")
+                    cluster.kv.inner.wait_idle(timeout=5.0)
                 _wall.sleep(0.05)  # drain listener fan-out
                 grace_ms = tc.assume_gone_ms + int(
                     tc.reaper_interval_s * 2000
                 )
+                # Deaths the runner didn't schedule itself (rolling-
+                # upgrade waves kill pods mid-coordinator) are stamped by
+                # the cluster; fire-time stamps win (stricter grace).
+                dead_since = dict(cluster.deaths)
+                dead_since.update(self.dead_since_ms)
                 verdicts = invariants.check_all(
-                    cluster, self.dead_since_ms, clock.now_ms(), grace_ms
+                    cluster, dead_since, clock.now_ms(), grace_ms
                 )
                 for name, fn in (sc.extra_checks or {}).items():
                     verdicts[name] = fn(cluster)

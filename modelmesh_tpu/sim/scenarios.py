@@ -32,9 +32,26 @@ Catalog (race -> origin):
   SENDER crashes (or is partitioned) mid-transfer must fall back to a
   store load on the receiver, with the demanded-model-served invariant
   intact and no phantom registry state at quiescence.
+- rolling_restart_under_zipf_load — the reconfig/ tentpole proof: a
+  full-fleet rolling upgrade (drain waves of MM_UPGRADE_MAX_UNAVAILABLE,
+  reconfig/rolling.py + drain.py) under seeded Zipf probe traffic, with
+  ZERO request failures observed at any virtual instant and every
+  demanded model served throughout.
+- live_registry_migration_under_load — the fenced flat->bucketed
+  registry migration (kv/migrate.py live mode) against a serving
+  cluster: dual-read + move-on-write keep exactly one authoritative key
+  per id, requests never fail, and the migration converges to DONE.
+- late_eviction_deregister_quiesce — the registry_cache_convergence
+  flake regression: a last-instant eviction whose async deregister is
+  deterministically held until quiesce (SimKV write-hold gate) — the
+  quiesce's async-drain + inline janitor cycle must repair the record
+  before invariants read (fails with quiesce_async reverted, see
+  tests/test_sim_scenarios.py meta-test).
 """
 
 from __future__ import annotations
+
+import random
 
 from modelmesh_tpu.records import InstanceRecord
 from modelmesh_tpu.serving.tasks import TaskConfig
@@ -444,6 +461,250 @@ def transfer_sender_partitioned_mid_stream() -> Scenario:
     )
 
 
+# ------------------------------------------------------------------ #
+# 9. full-fleet rolling restart under Zipf load (reconfig/ tentpole)   #
+# ------------------------------------------------------------------ #
+
+_ZIPF_MODELS = [f"m-z{i}" for i in range(6)]
+_TARGET_VERSION = "v2"
+_WAVE_WIDTH = 2
+
+
+def _zipf_invokes(seed: int, start_ms: int, end_ms: int,
+                  every_ms: int) -> list[Event]:
+    """Seeded Zipf-popularity probe traffic: the event schedule derives
+    only from the seed, so the scenario replays bit-for-bit."""
+    rng = random.Random(seed)
+    weights = [1.0 / (i + 1) ** 1.2 for i in range(len(_ZIPF_MODELS))]
+    events = []
+    for t in range(start_ms, end_ms, every_ms):
+        mid = rng.choices(_ZIPF_MODELS, weights)[0]
+        events.append(Event(t, "invoke", (mid,)))
+    return events
+
+
+def _check_no_request_failures(cluster: SimCluster):
+    """The headline reconfiguration property: across the WHOLE run — every
+    wave of the rolling restart included — no probe request failed. The
+    observed request log is the 'at every virtual instant' witness."""
+    failures = [
+        f"@{t}ms {mid}: {err}"
+        for t, mid, ok, err in cluster.request_log if not ok
+    ]
+    if failures:
+        return [
+            f"{len(failures)} request failure(s) during the run: "
+            + "; ".join(failures[:5])
+        ]
+    if not cluster.request_log:
+        return ["no probe requests observed (vacuous run)"]
+    return []
+
+
+def _check_fleet_upgraded(cluster: SimCluster):
+    out = []
+    report = cluster.upgrade_report
+    if report is None:
+        return ["rolling upgrade never ran"]
+    if report.failures:
+        out.append(f"upgrade reported failures: {report.failures}")
+    if any(len(w) > _WAVE_WIDTH for w in report.waves):
+        out.append(
+            f"wave width exceeded max_unavailable={_WAVE_WIDTH}: "
+            f"{report.waves}"
+        )
+    live = cluster.live_pods()
+    stale = [
+        p.iid for p in live
+        if p.instance.config.instance_version != _TARGET_VERSION
+    ]
+    if stale:
+        out.append(f"instances still down-version at quiescence: {stale}")
+    # Non-vacuity: the drained pods really handed copies off (a fleet
+    # that never held the demanded models would pass everything else).
+    migrated = sum(
+        len(r.migrated) for r in cluster.drain_reports.values()
+        if r is not None
+    )
+    if migrated == 0:
+        out.append("no model was migrated by any drain (vacuous upgrade)")
+    return out
+
+
+def rolling_restart_under_zipf_load() -> Scenario:
+    """Every instance of a 4-pod fleet is drained, killed, and replaced
+    at a new version in waves of 2 (MM_UPGRADE_MAX_UNAVAILABLE), while
+    seeded Zipf traffic keeps probing all demanded models. Invariants:
+    zero request failures at any virtual instant, every demanded model
+    served, the whole fleet up-version at quiescence."""
+    events = [
+        Event(0, "register", (mid,)) for mid in _ZIPF_MODELS
+    ]
+    # Two copies of the hottest models, one of the tail — the drain must
+    # handle both sole-copy handoff and already-redundant models.
+    events += [
+        Event(400 + 150 * i, "ensure", (mid, 1 if i < 2 else 0))
+        for i, mid in enumerate(_ZIPF_MODELS)
+    ]
+    events += _zipf_invokes(seed=109, start_ms=2_000, end_ms=56_000,
+                            every_ms=700)
+    # Waves start after the initial loads are settled and run while the
+    # probe traffic keeps flowing.
+    events.append(
+        Event(12_000, "rolling_upgrade", (_TARGET_VERSION, _WAVE_WIDTH))
+    )
+    return Scenario(
+        name="rolling-restart-under-zipf-load",
+        seed=109,
+        n_instances=4,
+        horizon_ms=60_000,
+        task_config=_tasks(),
+        instance_kwargs={"instance_version": "v1"},
+        events=events,
+        extra_checks={
+            "no_request_failures": _check_no_request_failures,
+            "fleet_upgraded": _check_fleet_upgraded,
+        },
+    )
+
+
+# ------------------------------------------------------------------ #
+# 10. live registry migration under load                               #
+# ------------------------------------------------------------------ #
+
+_FLAT_MODELS = [f"m-f{i}" for i in range(4)]
+
+
+def _check_single_authoritative_key(cluster: SimCluster):
+    """No CAS split-brain: at quiescence every model id owns exactly one
+    registry key, and no flat-layout key survives (the migration
+    converged)."""
+    out = []
+    inner = cluster.kv.inner
+    by_id: dict[str, list[str]] = {}
+    for kv in inner.range("mm/registry/"):
+        rest = kv.key[len("mm/registry/"):]
+        id_ = rest.partition("/")[2] or rest
+        by_id.setdefault(id_, []).append(kv.key)
+        if "/" not in rest:
+            out.append(f"flat key survived the migration: {kv.key}")
+    for id_, keys in sorted(by_id.items()):
+        if len(keys) > 1:
+            out.append(f"{id_} has {len(keys)} authoritative keys: {keys}")
+    return out
+
+
+def _check_migration_done(cluster: SimCluster):
+    from modelmesh_tpu.kv import migrate as _migrate
+
+    kv = cluster.kv.inner.get(_migrate.migration_fence_key("mm"))
+    if kv is None:
+        return ["migration fence never advertised"]
+    import json
+
+    phase = json.loads(kv.value.decode()).get("phase")
+    if phase != _migrate.PHASE_DONE:
+        return [f"migration did not reach DONE (phase={phase})"]
+    # Non-vacuity: m-f3 is never demanded, so no writer ever touched it —
+    # only the MIGRATOR can have moved it to its bucketed key.
+    if cluster.kv.inner.get("mm/registry/m-f3") is not None:
+        return ["m-f3 still flat — the migrator's sweep never moved it"]
+    if cluster.first_live().instance.registry.get("m-f3") is None:
+        return ["m-f3 lost during migration (neither flat nor bucketed)"]
+    return []
+
+
+def live_registry_migration_under_load() -> Scenario:
+    """A registry seeded with LEGACY flat-layout keys serves traffic
+    while the fenced live migration runs: the epoch fence turns on
+    dual-read + move-on-write, writers move the records they touch, the
+    migrator sweeps the cold remainder, and the fence advances to DONE —
+    with zero request failures and exactly one authoritative key per id
+    at quiescence. m-f3 is never demanded (the migrator, not a writer,
+    must move it); m-f2 is unregistered mid-migration (both key forms
+    must die)."""
+    events = [
+        Event(0, "register_flat", (mid,)) for mid in _FLAT_MODELS
+    ]
+    # Operator advertises the epoch BEFORE any move; instances' fence
+    # watches flip them to dual-read within watch latency.
+    events.append(Event(300, "migrate_fence", ("live",)))
+    events += [
+        Event(2_000 + 400 * i, "ensure", (mid,))
+        for i, mid in enumerate(_FLAT_MODELS[:3])
+    ]
+    events += [
+        Event(5_000 + 900 * i, "invoke", (_FLAT_MODELS[i % 3],))
+        for i in range(12)
+    ]
+    # A normally-registered model rides along: mixed old/new-layout
+    # traffic through one serving registry.
+    events.append(Event(6_500, "register", ("m-new",)))
+    events.append(Event(7_000, "ensure", ("m-new",)))
+    events.append(Event(16_000, "migrate_live", ()))
+    events.append(Event(18_000, "unregister", ("m-f2",)))
+    events += [
+        Event(24_000 + 900 * i, "invoke", (_FLAT_MODELS[i % 2],))
+        for i in range(6)
+    ]
+    return Scenario(
+        name="live-registry-migration-under-load",
+        seed=110,
+        n_instances=3,
+        horizon_ms=45_000,
+        task_config=_tasks(),
+        events=events,
+        extra_checks={
+            "no_request_failures": _check_no_request_failures,
+            "single_authoritative_key": _check_single_authoritative_key,
+            "migration_done": _check_migration_done,
+        },
+    )
+
+
+# ------------------------------------------------------------------ #
+# 11. late eviction's async deregister vs the quiesce (flake fix)      #
+# ------------------------------------------------------------------ #
+
+
+def _check_evicted(cluster: SimCluster):
+    """Non-vacuity: the squeeze really evicted sim-0's copy (otherwise
+    the convergence check proves nothing)."""
+    ce = cluster.by_id("sim-0").instance.cache.get_quietly("m-ev")
+    if ce is not None:
+        return ["squeeze did not evict m-ev from sim-0 (vacuous run)"]
+    return []
+
+
+def late_eviction_deregister_quiesce() -> Scenario:
+    """Replays the CHANGES.md PR-6 flake deterministically: a capacity
+    squeeze at the last virtual instant evicts a copy whose async
+    deregister is HELD (SimKV write gate) — modeling the CAS landing
+    after the final scheduled janitor cycle. The quiesce must release
+    the gate, drain the pending deregisters, and run one extra janitor
+    pass before invariants read; with that reverted
+    (Scenario.quiesce_async=False) registry_cache_convergence fails."""
+    return Scenario(
+        name="late-eviction-deregister-quiesce",
+        seed=111,
+        n_instances=3,
+        horizon_ms=10_000,
+        task_config=_tasks(),
+        events=[
+            Event(0, "register", ("m-ev",)),
+            # Two copies: the eviction on sim-0 must not leave the model
+            # unserved (that is demanded_models_served's concern; this
+            # scenario isolates the registry-record staleness).
+            Event(300, "ensure", ("m-ev", 1)),
+            # Gate sim-0's registry writes, THEN squeeze its cache to
+            # nothing: the eviction fires, its deregister CAS blocks.
+            Event(8_000, "hold_kv_writes", ("sim-0", "registry/")),
+            Event(9_000, "squeeze", ("sim-0", "1")),
+        ],
+        extra_checks={"evicted": _check_evicted},
+    )
+
+
 ALL = (
     fanout_budget_under_first_load_failure,
     promote_publish_suppression,
@@ -453,6 +714,9 @@ ALL = (
     mass_restart_jitter,
     transfer_sender_killed_mid_stream,
     transfer_sender_partitioned_mid_stream,
+    rolling_restart_under_zipf_load,
+    live_registry_migration_under_load,
+    late_eviction_deregister_quiesce,
 )
 
 

@@ -116,6 +116,22 @@ REGISTRY: dict[str, EnvVar] = {
                "round trip), read by the exporting loader's serializer; "
                "smaller chunks = finer mid-stream fault recovery, larger "
                "= fewer RPCs per transfer", "models/server.py"),
+        EnvVar("MM_DRAIN_ON_SIGTERM", "bool", "1",
+               "graceful drain on SIGTERM (reconfig/drain.py): mark the "
+               "instance DRAINING, pre-copy hot models to survivors over "
+               "the transfer/ peer-stream path (host-tier demote the cold "
+               "ones), wait for survivor copies to be servable, then "
+               "deregister; 0 falls back to the legacy immediate "
+               "shutting_down migration", "serving/instance.py"),
+        EnvVar("MM_DRAIN_TIMEOUT_MS", "int", "30000",
+               "drain deadline: models not yet migrated when it expires "
+               "are deregistered without pre-copy (bounded serving gap "
+               "instead of an unbounded shutdown)",
+               "serving/instance.py"),
+        EnvVar("MM_UPGRADE_MAX_UNAVAILABLE", "int", "1",
+               "rolling-upgrade wave width (reconfig/rolling.py): at most "
+               "this many instances drain concurrently per wave",
+               "reconfig/rolling.py"),
         EnvVar("MM_ROUTE_CACHE", "bool", "1",
                "memoize the per-model serve-route decision on the request "
                "hot path (invalidated by registry version, instances-view "

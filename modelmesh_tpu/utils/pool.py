@@ -35,6 +35,11 @@ class BoundedDaemonPool:
         self._lock = threading.Lock()
         self._workers: list[threading.Thread] = []
         self._closed = False
+        # Queued + running tasks. The deterministic sim's quiesce reads
+        # this to know when async janitorial work (deregisters, unloads)
+        # has actually settled — virtual time alone can't tell, because
+        # these tasks run on wall-scheduled threads.
+        self._pending = 0  #: guarded-by: _lock
 
     def submit(self, fn: Callable, *args) -> bool:
         """Enqueue ``fn(*args)``; returns False if the pool is shut down.
@@ -43,6 +48,7 @@ class BoundedDaemonPool:
         with self._lock:
             if self._closed:
                 return False
+            self._pending += 1
             self._q.put((fn, args))
             # Lazy spawn: one worker per queued task until the cap, so an
             # idle instance holds no threads and a burst gets parallelism.
@@ -66,6 +72,9 @@ class BoundedDaemonPool:
                 fn(*args)
             except Exception:  # noqa: BLE001 — janitorial: log, keep serving
                 log.exception("%s task %r failed", self._name, fn)
+            finally:
+                with self._lock:
+                    self._pending -= 1
 
     def shutdown(self) -> None:
         """Stop accepting work and release idle workers. Running tasks are
@@ -82,3 +91,9 @@ class BoundedDaemonPool:
     def active_workers(self) -> int:
         with self._lock:
             return sum(t.is_alive() for t in self._workers)
+
+    @property
+    def pending(self) -> int:
+        """Tasks queued or running (0 = the pool is idle)."""
+        with self._lock:
+            return self._pending

@@ -22,6 +22,7 @@ class TestBenchLifecycleSmoke:
         out = bench_lifecycle.run(
             load_ms=20.0, size_ms=20.0, n_copies=3, fleet=4,
             mass_models=40, reps=1, crowd_copies=4, crowd_fleet=5,
+            drain_models=8, drain_fleet=3,
         )
 
         fs = out["first_serve"]
@@ -73,3 +74,18 @@ class TestBenchLifecycleSmoke:
         hr = out["host_rewarm"]
         assert hr["rewarm_ms"] < hr["cold_store_ms"]
         assert hr["speedup"] > 1.0
+
+        # Drain (reconfig/): the zero-downtime contract. With peer
+        # pre-copy the drain produces ZERO failed probe requests — the
+        # local copy serves until each survivor copy is servable, and
+        # the handoff streams over the mesh. The store fallback stays
+        # error-free after quiesce but pays serialized store downloads
+        # (bounded, slower drain). Every model must really migrate and
+        # the probe must really probe (non-vacuity).
+        dr = out["drain"]
+        assert dr["peer_precopy"]["failed_requests"] == 0
+        assert dr["peer_precopy"]["migrated"] == 8
+        assert dr["peer_precopy"]["probe_requests"] > 0
+        assert dr["store_fallback"]["migrated"] == 8
+        assert dr["store_fallback"]["failed_requests"] == 0
+        assert dr["store_fallback"]["probe_requests"] > 0

@@ -1,0 +1,448 @@
+"""kv/migrate.py: offline + fenced live registry-layout migration.
+
+The offline path predates these tests (interruption-resume and
+concurrent-writer CAS loss were claimed in its docstring but never
+pinned); the live mode adds epoch fencing, dual-read, and move-on-write
+(BucketedKVTable) plus TableView's per-source-key event fencing.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from modelmesh_tpu.kv import migrate
+from modelmesh_tpu.kv.memory import InMemoryKV
+from modelmesh_tpu.kv.store import CasFailed, Compare, Op
+from modelmesh_tpu.kv.table import BucketedKVTable, TableEvent, TableView
+from modelmesh_tpu.records import ModelRecord
+
+PREFIX = "mm"
+REG = "mm/registry/"
+
+
+def _flat_put(kv, mid: str, **fields) -> None:
+    rec = ModelRecord(model_type="t", model_path=f"mem://{mid}", **fields)
+    kv.put(REG + mid, rec.to_bytes())
+
+
+def _table(kv, fence=None) -> BucketedKVTable:
+    return BucketedKVTable(
+        kv, REG, ModelRecord, migration_fence=fence
+    )
+
+
+def _keys(kv, mid: str) -> list[str]:
+    return [x.key for x in kv.range(REG) if x.key.endswith("/" + mid)
+            or x.key == REG + mid]
+
+
+@pytest.fixture
+def kv():
+    store = InMemoryKV(sweep_interval_s=3600.0)
+    yield store
+    store.close()
+
+
+class TestOffline:
+    def test_moves_every_flat_key_and_is_idempotent(self, kv):
+        for i in range(10):
+            _flat_put(kv, f"m-{i}")
+        assert migrate.migrate_flat_registry(kv, PREFIX) == 10
+        table = _table(kv)
+        for i in range(10):
+            assert table.get(f"m-{i}") is not None
+            assert kv.get(REG + f"m-{i}") is None
+        # Re-run: nothing left to move.
+        assert migrate.migrate_flat_registry(kv, PREFIX) == 0
+
+    def test_interruption_resume(self, kv):
+        """A migration killed partway is re-runnable: the already-moved
+        keys are skipped (their flat form is gone), the remainder moves,
+        and no id is duplicated or lost."""
+        for i in range(8):
+            _flat_put(kv, f"m-{i}")
+        # Simulate the interrupted first run: move only 3 keys by hand
+        # with the migrator's own txn shape.
+        table = _table(kv)
+        moved = 0
+        for item in list(kv.range(REG)):
+            if moved == 3:
+                break
+            rest = item.key[len(REG):]
+            if "/" in rest:
+                continue
+            ok, _ = kv.txn(
+                [Compare(table.raw_key(rest), 0),
+                 Compare(item.key, item.version)],
+                [Op(table.raw_key(rest), item.value), Op(item.key)],
+            )
+            assert ok
+            moved += 1
+        # Resume: exactly the remaining 5 move.
+        assert migrate.migrate_flat_registry(kv, PREFIX) == 5
+        for i in range(8):
+            assert len(_keys(kv, f"m-{i}")) == 1
+            assert table.get(f"m-{i}") is not None
+
+    def test_slash_containing_ids_still_migrate(self, kv):
+        """Model ids are arbitrary strings and may contain slashes; a
+        flat key like <prefix>org/model is NOT bucketed (only a leading
+        2-hex-digit segment is) and must migrate, resolve through
+        dual-read, and round-trip key_to_id."""
+        _flat_put(kv, "org/model-a")
+        table = _table(kv, _StaticFence(True))
+        rec = table.get("org/model-a")
+        assert rec is not None and rec._from_flat
+        assert table.key_to_id(REG + "org/model-a") == "org/model-a"
+        assert migrate.migrate_flat_registry(kv, PREFIX) == 1
+        assert kv.get(REG + "org/model-a") is None
+        moved = table.get("org/model-a")
+        assert moved is not None and not getattr(moved, "_from_flat", False)
+        assert table.key_to_id(table.raw_key("org/model-a")) == "org/model-a"
+
+    def test_concurrent_writer_cas_loss(self, kv):
+        """A writer that bumps the flat key after the migrator's read
+        makes the move txn lose cleanly — nothing is written, the flat
+        key keeps the writer's value, and the re-run moves it."""
+        _flat_put(kv, "m-hot")
+        stale = kv.get(REG + "m-hot")
+        # Concurrent writer commits first (version bumps).
+        _flat_put(kv, "m-hot")
+        ok, _ = kv.txn(
+            [Compare(REG + "00/m-hot", 0), Compare(stale.key, stale.version)],
+            [Op(REG + "00/m-hot", stale.value), Op(stale.key)],
+        )
+        assert not ok
+        assert kv.get(REG + "m-hot") is not None
+        # The sweep picks up the fresh version.
+        assert migrate.migrate_flat_registry(kv, PREFIX) == 1
+        assert len(_keys(kv, "m-hot")) == 1
+
+
+class _StaticFence:
+    def __init__(self, active: bool):
+        self.active = active
+
+
+class TestLiveMode:
+    def test_fence_watches_epoch(self, kv):
+        fence = migrate.MigrationFence(kv, PREFIX)
+        assert not fence.active and fence.phase is None
+        migrate.advertise_phase(kv, PREFIX, migrate.PHASE_LIVE)
+        kv.wait_idle()
+        assert fence.active
+        migrate.advertise_phase(kv, PREFIX, migrate.PHASE_DONE)
+        kv.wait_idle()
+        assert not fence.active and fence.phase == migrate.PHASE_DONE
+        fence.close()
+
+    def test_dual_read_prefers_bucketed(self, kv):
+        table = _table(kv, _StaticFence(True))
+        _flat_put(kv, "m-a", size_units=1)
+        # Flat fallback while only the legacy key exists.
+        rec = table.get("m-a")
+        assert rec is not None and rec._from_flat
+        # Bucketed twin appears: it wins, flat is invisible.
+        table2 = _table(kv)  # no fence: canonical-only writer
+        newer = ModelRecord(model_type="t", size_units=2)
+        table2.put("m-a", newer)
+        rec = table.get("m-a")
+        assert rec.size_units == 2 and not getattr(rec, "_from_flat", False)
+
+    def test_move_on_write_single_cas_winner(self, kv):
+        """A CAS against a flat-read record commits bucketed + deletes
+        flat atomically; a second writer holding the same stale read
+        loses and re-reads the moved record."""
+        table = _table(kv, _StaticFence(True))
+        _flat_put(kv, "m-b")
+        first = table.get("m-b")
+        second = table.get("m-b")
+        first.size_units = 7
+        table.conditional_set("m-b", first)
+        assert len(_keys(kv, "m-b")) == 1
+        assert kv.get(REG + "m-b") is None
+        assert not getattr(first, "_from_flat", False)
+        with pytest.raises(CasFailed):
+            table.conditional_set("m-b", second)
+        rec = table.get("m-b")
+        assert rec.size_units == 7 and rec.version == first.version
+
+    def test_update_or_create_moves_flat_record(self, kv):
+        table = _table(kv, _StaticFence(True))
+        _flat_put(kv, "m-c")
+
+        def mutate(cur):
+            assert cur is not None
+            cur.size_units = 5
+            return cur
+
+        out = table.update_or_create("m-c", mutate)
+        assert out.size_units == 5
+        assert kv.get(REG + "m-c") is None
+        assert len(_keys(kv, "m-c")) == 1
+
+    def test_update_or_create_delete_guards_flat_key(self, kv):
+        table = _table(kv, _StaticFence(True))
+        _flat_put(kv, "m-d")
+        assert table.update_or_create("m-d", lambda cur: None) is None
+        assert _keys(kv, "m-d") == []
+
+    def test_scan_dedupes_bucketed_preferred(self, kv):
+        fence = _StaticFence(True)
+        table = _table(kv, fence)
+        _flat_put(kv, "m-flat")
+        _table(kv).put("m-moved", ModelRecord(model_type="t"))
+        _flat_put(kv, "m-moved")  # stale leftover alias
+        ids = {}
+        for id_, key, rec in table.scan():
+            assert id_ not in ids, f"{id_} yielded twice"
+            ids[id_] = key
+        assert ids["m-flat"] == REG + "m-flat"
+        assert "/" in ids["m-moved"][len(REG):]
+
+    def test_dual_read_closes_move_toctou_window(self, kv):
+        """A move txn landing BETWEEN the bucketed miss and the flat
+        fallback read must not make get() return None — the record
+        exists at one of the two keys at every instant (the move is
+        atomic), and None here means 'unregistered' to callers like the
+        janitor, which would drop a serving copy."""
+        table = _table(kv, _StaticFence(True))
+        _flat_put(kv, "m-race")
+        flat_key = REG + "m-race"
+        target = table.raw_key("m-race")
+        stale = kv.get(flat_key)
+        moved = [False]
+        real_get = kv.get
+
+        def racing_get(key):
+            if key == flat_key and not moved[0]:
+                # The migrator's move commits just before the fallback
+                # read observes the flat key.
+                moved[0] = True
+                ok, _ = kv.txn(
+                    [Compare(target, 0), Compare(flat_key, stale.version)],
+                    [Op(target, stale.value), Op(flat_key)],
+                )
+                assert ok
+            return real_get(key)
+
+        kv.get = racing_get
+        try:
+            rec = table.get("m-race")
+        finally:
+            kv.get = real_get
+        assert moved[0], "race hook never fired (vacuous test)"
+        assert rec is not None, (
+            "get() returned None for a record that existed throughout "
+            "the move"
+        )
+        assert not getattr(rec, "_from_flat", False)
+
+    def test_scan_rereads_canonical_for_moved_flat_entries(self, kv):
+        """A flat entry already BUFFERED by the fence-mode scan whose
+        record is moved before the end-of-stream flush must resolve to
+        the CANONICAL form — never vanish or yield the stale flat copy.
+
+        Interleaving: flat id "0-mid" sorts before every bucket prefix
+        (buckets are 00..7f, ids here start with letters), so the scan
+        buffers it first and then PAUSES at the bucketed yield of m-0 —
+        the move happens while the generator is suspended mid-stream.
+        """
+        table = _table(kv, _StaticFence(True))
+        _flat_put(kv, "0-mid")
+        _table(kv).put("m-0", ModelRecord(model_type="t"))
+        stream = table.scan()
+        first = next(stream)  # "0-mid" buffered; paused at m-0's yield
+        assert first[0] == "m-0"
+        rec = table.get("0-mid")
+        rec.size_units = 4
+        table.conditional_set("0-mid", rec)  # the move
+        out = {id_: (key, r) for id_, key, r in stream}
+        assert "0-mid" in out
+        key, got = out["0-mid"]
+        assert "/" in key[len(REG):], "stale flat form yielded after move"
+        assert got.size_units == 4
+
+    def test_delete_retires_flat_first_so_movers_cannot_resurrect(self, kv):
+        """delete() must remove the FLAT form before the bucketed one:
+        every move txn guards on the flat key's version, so once flat is
+        gone no mover can re-create the bucketed key. A mover racing
+        into the window between the two deletes loses its CAS and the
+        record stays dead."""
+        table = _table(kv, _StaticFence(True))
+        _flat_put(kv, "m-del")
+        flat_key = REG + "m-del"
+        target = table.raw_key("m-del")
+        stale = kv.get(flat_key)
+        order: list[str] = []
+        real_delete = kv.delete
+
+        def spying_delete(key):
+            order.append(key)
+            out = real_delete(key)
+            if key == flat_key:
+                # Adversarial mover fires exactly inside the window
+                # between the two deletes: it must lose.
+                ok, _ = kv.txn(
+                    [Compare(target, 0), Compare(flat_key, stale.version)],
+                    [Op(target, stale.value), Op(flat_key)],
+                )
+                assert not ok, "mover resurrected an unregistered record"
+            return out
+
+        kv.delete = spying_delete
+        try:
+            assert table.delete("m-del")
+        finally:
+            kv.delete = real_delete
+        assert order[0] == flat_key, f"flat was not deleted first: {order}"
+        assert _keys(kv, "m-del") == []
+
+    def test_fence_seed_cannot_pin_stale_phase(self, kv):
+        """An instance booting mid-flip must converge to the store's
+        phase: the seed read may be stale (live) relative to a done-put,
+        but the watch is registered AFTER the seed and replays from rev
+        0 in order — the final applied phase is the store's."""
+        migrate.advertise_phase(kv, PREFIX, migrate.PHASE_LIVE)
+        migrate.advertise_phase(kv, PREFIX, migrate.PHASE_DONE)
+
+        class _StaleGetStore:
+            """First get() of the fence key returns the old LIVE payload
+            (a read raced by the done-put); everything else passes
+            through."""
+
+            def __init__(self, inner):
+                self._inner = inner
+                self._stale_served = False
+
+            def get(self, key):
+                out = self._inner.get(key)
+                if (
+                    key == migrate.migration_fence_key(PREFIX)
+                    and not self._stale_served
+                ):
+                    self._stale_served = True
+                    import dataclasses as _dc
+                    import json as _json
+
+                    return _dc.replace(
+                        out,
+                        value=_json.dumps(
+                            {"phase": migrate.PHASE_LIVE, "ts_ms": 0}
+                        ).encode(),
+                    )
+                return out
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        fence = migrate.MigrationFence(_StaleGetStore(kv), PREFIX)
+        kv.wait_idle()
+        assert fence.phase == migrate.PHASE_DONE, (
+            "stale seed pinned the fence in a phase the store left"
+        )
+        fence.close()
+
+    def test_migrate_live_converges_and_advertises_done(self, kv):
+        for i in range(6):
+            _flat_put(kv, f"m-{i}")
+        moved = migrate.migrate_flat_registry_live(
+            kv, PREFIX, settle_s=0.0
+        )
+        assert moved == 6
+        fence = migrate.MigrationFence(kv, PREFIX)
+        assert fence.phase == migrate.PHASE_DONE
+        fence.close()
+        assert all(len(_keys(kv, f"m-{i}")) == 1 for i in range(6))
+
+
+class TestViewFencing:
+    def test_mixed_epoch_reader_sees_one_value_per_id(self, kv):
+        """A TableView over the migrating table holds exactly one record
+        per id through the move: the flat record is visible before the
+        move, the bucketed one after, and the move txn's DELETE of the
+        flat alias never evicts the freshly-applied bucketed record."""
+        fence = _StaticFence(True)
+        table = _table(kv, fence)
+        _flat_put(kv, "m-x")
+        view = TableView(table)
+        kv.wait_idle()
+        assert view.get("m-x") is not None
+        deletions: list[str] = []
+        view.add_listener(
+            lambda ev, id_, rec: deletions.append(id_)
+            if ev is TableEvent.DELETED else None
+        )
+        # The move (writer or migrator — same txn shape).
+        rec = table.get("m-x")
+        rec.size_units = 9
+        table.conditional_set("m-x", rec)
+        kv.wait_idle()
+        got = view.get("m-x")
+        assert got is not None and got.size_units == 9
+        assert deletions == [], (
+            "the flat alias's tombstone evicted the bucketed record"
+        )
+        # A real deletion still propagates.
+        table.delete("m-x")
+        kv.wait_idle()
+        assert view.get("m-x") is None
+        assert deletions == ["m-x"]
+        view.close()
+
+    def test_stale_flat_put_fenced_off_after_move(self, kv):
+        """A delayed legacy-key PUT replay arriving after the move must
+        not clobber the canonical record (cross-key versions are not
+        comparable; canonical wins)."""
+        fence = _StaticFence(True)
+        table = _table(kv, fence)
+        _flat_put(kv, "m-y")
+        view = TableView(table)
+        kv.wait_idle()
+        rec = table.get("m-y")
+        rec.size_units = 3
+        table.conditional_set("m-y", rec)
+        kv.wait_idle()
+        # Stale flat write lands late (e.g. an old-epoch writer's last
+        # gasp): the view must keep the canonical record.
+        _flat_put(kv, "m-y", size_units=1)
+        kv.wait_idle()
+        assert view.get("m-y").size_units == 3
+        view.close()
+
+    def test_concurrent_view_during_bulk_migration(self, kv):
+        """Fuzz the fencing: a view watches while 40 keys move; at the
+        end every id resolves to exactly its (single) bucketed record."""
+        for i in range(40):
+            _flat_put(kv, f"m-{i:02d}")
+        fence = _StaticFence(True)
+        table = _table(kv, fence)
+        view = TableView(table)
+
+        def migrate_half(start):
+            for i in range(start, 40, 2):
+                try:
+                    table.update_or_create(
+                        f"m-{i:02d}",
+                        lambda cur: cur,
+                    )
+                except CasFailed:
+                    pass
+
+        threads = [
+            threading.Thread(target=migrate_half, args=(s,))
+            for s in (0, 1)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        kv.wait_idle()
+        assert len(view) == 40
+        for i in range(40):
+            mid = f"m-{i:02d}"
+            assert view.get(mid) is not None
+            assert len(_keys(kv, mid)) == 1
+            assert kv.get(REG + mid) is None

@@ -1,0 +1,395 @@
+"""reconfig/: drain controller, rolling wave planner, version-aware
+placement. In-process fleets over InMemoryKV with direct-call transports
+(the bench/sim idiom) — the wire tier is covered by cluster tests."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from modelmesh_tpu.kv.memory import InMemoryKV
+from modelmesh_tpu.records import InstanceRecord, ModelRecord
+from modelmesh_tpu.reconfig.drain import DrainController
+from modelmesh_tpu.reconfig.rolling import (
+    RollingUpgradeCoordinator,
+    plan_waves,
+    rollout_active,
+    upversion_shortlist,
+    version_key,
+)
+from modelmesh_tpu.runtime.spi import (
+    LoadedModel,
+    LocalInstanceParams,
+    ModelInfo,
+    ModelLoader,
+)
+from modelmesh_tpu.serving.instance import InstanceConfig, ModelMeshInstance
+
+INFO = ModelInfo(model_type="t", model_path="mem://m")
+MODEL_BYTES = 64 * 1024
+
+
+class _Loader(ModelLoader):
+    """Streaming-capable loader; counts store vs stream loads."""
+
+    CHUNKS = 4
+
+    def __init__(self, load_ms: float = 0.0):
+        self.load_ms = load_ms
+        self.store_loads = 0
+        self.stream_loads = 0
+        self.loaded: set[str] = set()
+
+    def startup(self) -> LocalInstanceParams:
+        return LocalInstanceParams(
+            capacity_bytes=1 << 30, load_timeout_ms=30_000,
+            default_model_size_bytes=MODEL_BYTES,
+        )
+
+    def load(self, model_id, info):
+        if self.load_ms:
+            time.sleep(self.load_ms / 1e3)
+        self.store_loads += 1
+        self.loaded.add(model_id)
+        return LoadedModel(handle=model_id, size_bytes=MODEL_BYTES)
+
+    def predict_size(self, model_id, info):
+        return MODEL_BYTES
+
+    def unload(self, model_id):
+        self.loaded.discard(model_id)
+
+    @property
+    def requires_unload(self):
+        return False
+
+    @property
+    def supports_weight_streaming(self):
+        return True
+
+    def export_weights(self, model_id, handle):
+        from modelmesh_tpu.runtime.spi import WeightChunk
+
+        if model_id not in self.loaded:
+            return None
+        payload = b"w" * (MODEL_BYTES // self.CHUNKS)
+        return iter([
+            WeightChunk(seq=i, payload=payload, layer=i,
+                        last=i == self.CHUNKS - 1)
+            for i in range(self.CHUNKS)
+        ])
+
+    def load_from_stream(self, model_id, info, chunks, partial_ready=None):
+        n = sum(1 for _ in chunks)
+        if n == 0:
+            raise RuntimeError("empty stream")
+        self.stream_loads += 1
+        self.loaded.add(model_id)
+        return LoadedModel(handle=model_id, size_bytes=MODEL_BYTES)
+
+
+def _fleet(n, kv, peer_fetch=True, versions=None, load_ms=0.0):
+    by_endpoint = {}
+
+    def peer_call(endpoint, model_id, method, payload, headers, ctx):
+        return by_endpoint[endpoint].invoke_model(
+            model_id, method, payload, headers, ctx, sync=True
+        )
+
+    def fetch(endpoint, model_id, chunk_index, fingerprint):
+        return by_endpoint[endpoint].handle_weight_fetch(
+            model_id, chunk_index, fingerprint
+        )
+
+    insts, loaders = [], []
+    for i in range(n):
+        loader = _Loader(load_ms)
+        inst = ModelMeshInstance(
+            kv,
+            loader,
+            InstanceConfig(
+                instance_id=f"i-{i:02d}", endpoint=f"ep-{i:02d}",
+                load_timeout_s=30, min_churn_age_ms=0,
+                publish_coalesce_ms=0, peer_fetch=peer_fetch,
+                instance_version=(versions[i] if versions else ""),
+            ),
+            peer_call=peer_call,
+            peer_fetch=fetch if peer_fetch else None,
+            runtime_call=(
+                lambda ce, method, payload, headers, cancel_event=None:
+                payload
+            ),
+        )
+        by_endpoint[inst.config.endpoint] = inst
+        insts.append(inst)
+        loaders.append(loader)
+    for inst in insts:
+        inst.instances_view.wait_for(lambda v: len(v) >= n, timeout=30)
+    return insts, loaders
+
+
+@pytest.fixture
+def kv():
+    store = InMemoryKV(sweep_interval_s=3600.0)
+    yield store
+    store.close()
+
+
+class TestVersionOrdering:
+    def test_version_key_orders_numerically(self):
+        assert version_key("1.9") < version_key("1.10")
+        assert version_key("v1") < version_key("v2")
+        assert version_key("") < version_key("v0")
+        # Mixed labeling conventions name ONE version — a tool change
+        # from "1.2" to "v1.2" must not read as a permanent rollout.
+        assert version_key("v1.2") == version_key("1.2")
+        assert version_key("v2") == version_key("2")
+        # Mixed numeric/text never raises.
+        assert version_key("abc") != version_key("1")
+
+    def test_plan_waves_rejects_zero_unavailability(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            plan_waves([], "v2", max_unavailable=0)
+
+    def test_plan_waves_oldest_first_and_bounded(self):
+        fleet = [
+            ("d", InstanceRecord(instance_version="v2")),
+            ("a", InstanceRecord(instance_version="v1")),
+            ("b", InstanceRecord(instance_version="")),
+            ("c", InstanceRecord(instance_version="v1")),
+        ]
+        waves = plan_waves(fleet, "v2", max_unavailable=2)
+        # "" is oldest; at-target d is untouched; ids break ties.
+        assert waves == [["b", "a"], ["c"]]
+
+    def test_upversion_shortlist(self):
+        pairs = [
+            ("a", InstanceRecord(instance_version="v1")),
+            ("b", InstanceRecord(instance_version="v2")),
+            ("c", InstanceRecord(instance_version="v2")),
+        ]
+        assert [i for i, _ in upversion_shortlist(pairs)] == ["b", "c"]
+        same = pairs[1:]
+        assert upversion_shortlist(same) == same  # no rollout: identity
+        assert rollout_active(pairs) and not rollout_active(same)
+
+
+class TestDrainController:
+    def test_drain_migrates_then_deregisters(self, kv):
+        insts, loaders = _fleet(3, kv)
+        src = insts[0]
+        for i in range(4):
+            src.register_model(f"m-{i}", INFO)
+            src.ensure_loaded(f"m-{i}", sync=True)
+            assert src.cache.get_quietly(f"m-{i}") is not None
+        report = DrainController(src, deadline_s=20).drain()
+        assert sorted(report.migrated) == [f"m-{i}" for i in range(4)]
+        assert report.clean
+        assert src.draining and src.shutting_down
+        assert len(src.cache) == 0
+        for i in range(4):
+            mr = src.registry.get(f"m-{i}")
+            assert src.instance_id not in mr.all_placements
+            survivors = set(mr.instance_ids)
+            assert survivors and all(s != src.instance_id for s in survivors)
+        # The pre-copies streamed from the draining holder, not the store
+        # (each model paid ONE store load, on the original).
+        assert sum(ld.store_loads for ld in loaders) == 4
+        assert sum(ld.stream_loads for ld in loaders) == 4
+        for inst in insts:
+            inst.shutdown()
+
+    def test_drain_zero_serving_gap(self, kv):
+        """Requests issued continuously through a peer during the drain
+        never fail: the local copy serves until the survivor is up."""
+        insts, _ = _fleet(3, kv, load_ms=5.0)
+        src, probe_via = insts[0], insts[1]
+        for i in range(6):
+            src.register_model(f"m-{i}", INFO)
+            src.ensure_loaded(f"m-{i}", sync=True)
+        failures: list[str] = []
+        stop = threading.Event()
+
+        def probe():
+            i = 0
+            while not stop.is_set():
+                mid = f"m-{i % 6}"
+                try:
+                    probe_via.invoke_model(mid, "p", b"x", [])
+                except Exception as e:  # noqa: BLE001
+                    failures.append(f"{mid}: {e}")
+                i += 1
+                time.sleep(0.001)
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        try:
+            report = DrainController(src, deadline_s=30).drain()
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert report.clean, report
+        assert failures == [], failures[:5]
+        for inst in insts:
+            inst.shutdown()
+
+    def test_cold_models_demote_instead_of_migrating(self, kv):
+        insts, _ = _fleet(2, kv)
+        src = insts[0]
+        src.register_model("m-cold", INFO)
+        src.ensure_loaded("m-cold", sync=True)
+        # Negative window: every copy is colder than the cutoff (the
+        # just-used entry's last_used equals "now", so 0 would tie hot).
+        report = DrainController(
+            src, deadline_s=10, hot_window_ms=-1
+        ).drain()
+        assert report.migrated == []
+        assert report.demoted == ["m-cold"]
+        assert src.host_tier.peek("m-cold") is not None
+        mr = src.registry.get("m-cold")
+        assert src.instance_id not in mr.all_placements
+        assert src.instance_id in mr.host_instances
+        for inst in insts:
+            inst.shutdown()
+
+    def test_cold_drop_not_reported_demoted_when_tier_disabled(self, kv):
+        """report.demoted means a host snapshot really survives; with
+        the host tier disabled the cold copy is dropped, not demoted."""
+        insts, _ = _fleet(2, kv)
+        src = insts[0]
+        src.host_tier._capacity = 0  # tier disabled (as MM_HOST_TIER_BYTES=0)
+        src.register_model("m-nt", INFO)
+        src.ensure_loaded("m-nt", sync=True)
+        report = DrainController(
+            src, deadline_s=10, hot_window_ms=-1
+        ).drain()
+        assert report.demoted == []
+        assert "m-nt" in report.dropped
+        for inst in insts:
+            inst.shutdown()
+
+    def test_draining_excluded_from_new_placements(self, kv):
+        insts, _ = _fleet(2, kv)
+        a, b = insts
+        a.draining = True
+        a.publish_instance_record(force=True)
+        b.instances_view.wait_for(
+            lambda v: (r := v.get(a.instance_id)) is not None and r.draining,
+            timeout=10,
+        )
+        b.register_model("m-p", INFO)
+        b.ensure_loaded("m-p", sync=True)
+        mr = b.registry.get("m-p")
+        assert a.instance_id not in mr.all_placements
+        assert b.instance_id in mr.instance_ids
+        for inst in insts:
+            inst.shutdown()
+
+    def test_pre_shutdown_delegates_to_drain(self, kv):
+        insts, _ = _fleet(2, kv)
+        src = insts[0]
+        src.register_model("m-s", INFO)
+        src.ensure_loaded("m-s", sync=True)
+        assert src.config.drain_on_sigterm  # env default on
+        src.pre_shutdown(deadline_s=10)
+        assert src.draining and src.shutting_down
+        mr = src.registry.get("m-s")
+        assert src.instance_id not in mr.all_placements
+        assert insts[1].instance_id in mr.instance_ids
+        for inst in insts:
+            inst.shutdown()
+
+    def test_store_fallback_when_transfer_disabled(self, kv):
+        """With peer streaming off the drain still migrates (store
+        loads), just without the cheap pre-copy path."""
+        insts, loaders = _fleet(2, kv, peer_fetch=False)
+        src = insts[0]
+        src.register_model("m-sf", INFO)
+        src.ensure_loaded("m-sf", sync=True)
+        report = DrainController(src, deadline_s=20).drain()
+        assert report.migrated == ["m-sf"]
+        assert sum(ld.stream_loads for ld in loaders) == 0
+        assert sum(ld.store_loads for ld in loaders) == 2
+        for inst in insts:
+            inst.shutdown()
+
+
+class TestUpversionPlacement:
+    def test_load_placement_prefers_upversion_during_rollout(self, kv):
+        insts, _ = _fleet(3, kv, versions=["v1", "v2", "v1"])
+        old = insts[0]
+        old.register_model("m-v", INFO)
+        # Place from the old-version instance but exclude it: among the
+        # two remaining candidates the v2 one must win every time.
+        for attempt in range(3):
+            mid = f"m-v{attempt}"
+            old.register_model(mid, INFO)
+            old.ensure_loaded(mid, sync=True, exclude={old.instance_id})
+            mr = old.registry.get(mid)
+            assert set(mr.instance_ids) == {insts[1].instance_id}, mid
+        for inst in insts:
+            inst.shutdown()
+
+
+class TestRollingCoordinator:
+    def test_waves_drain_and_replace(self):
+        fleet = {
+            f"i-{i}": InstanceRecord(instance_version="v1")
+            for i in range(4)
+        }
+        drained, replaced = [], []
+        counter = [0]
+
+        def list_instances():
+            return list(fleet.items())
+
+        def drain(iid):
+            drained.append(iid)
+            del fleet[iid]
+
+        def replace(iid, version):
+            counter[0] += 1
+            new = f"r-{counter[0]}"
+            fleet[new] = InstanceRecord(instance_version=version)
+            replaced.append(new)
+            return new
+
+        report = RollingUpgradeCoordinator(
+            "v2",
+            list_instances=list_instances,
+            drain_instance=drain,
+            replace_instance=replace,
+            wait_ready=lambda n: None,
+            max_unavailable=2,
+        ).run()
+        assert report.complete
+        assert [len(w) for w in report.waves] == [2, 2]
+        assert len(drained) == 4 and len(replaced) == 4
+        assert all(
+            rec.instance_version == "v2" for rec in fleet.values()
+        )
+
+    def test_failed_drain_reported_not_fatal(self):
+        fleet = {"i-0": InstanceRecord(instance_version="v1")}
+
+        def drain(iid):
+            raise RuntimeError("pod wedged")
+
+        def replace(iid, version):
+            fleet[iid] = InstanceRecord(instance_version=version)
+            return iid
+
+        report = RollingUpgradeCoordinator(
+            "v2",
+            list_instances=lambda: list(fleet.items()),
+            drain_instance=drain,
+            replace_instance=replace,
+            max_unavailable=1,
+        ).run()
+        assert not report.complete
+        assert any("pod wedged" in f for f in report.failures)
+        assert fleet["i-0"].instance_version == "v2"  # still replaced

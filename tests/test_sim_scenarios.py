@@ -34,3 +34,28 @@ def test_jitter_check_catches_reverted_fix():
     assert result.verdicts["jitter_spread"], (
         "jitter_spread passed with jitter disabled — the check is vacuous"
     )
+
+
+def test_rolling_restart_replays_bit_for_bit():
+    """The tentpole acceptance property: the full-fleet rolling restart
+    under Zipf load replays identically from its seed — same trace,
+    same (passing) verdicts."""
+    first = run_scenario(scenarios.rolling_restart_under_zipf_load())
+    second = run_scenario(scenarios.rolling_restart_under_zipf_load())
+    assert first.ok, first.render()
+    assert first.trace_lines() == second.trace_lines()
+
+
+def test_late_eviction_quiesce_catches_reverted_fix():
+    """With the quiesce's async-deregister drain reverted
+    (quiesce_async=False — the pre-fix runner behavior), the held
+    deregister is still in flight when invariants read and
+    registry_cache_convergence must fail with the flake's exact
+    signature; at HEAD the scenario passes (parametrized run above)."""
+    sc = scenarios.late_eviction_deregister_quiesce()
+    sc.quiesce_async = False
+    result = run_scenario(sc)
+    assert result.verdicts["registry_cache_convergence"], (
+        "registry_cache_convergence passed with the quiesce drain "
+        "reverted — the regression scenario is vacuous"
+    )

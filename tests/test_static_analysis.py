@@ -789,8 +789,10 @@ class TestFixedFindingRegressions:
         entered = threading.Event()
 
         class _StaleListingTable(KVTable):
-            def items(self, page_size=1000):
-                stale = list(super().items(page_size))  # pre-update state
+            # scan() is the seeding entry point (TableView needs the
+            # source keys for per-key event fencing).
+            def scan(self, page_size=1000):
+                stale = list(super().scan(page_size))  # pre-update state
                 entered.set()
                 assert gate.wait(10)
                 return iter(stale)

@@ -1,5 +1,21 @@
-"""Observability: metrics facade + payload processors."""
+"""Observability: metrics facade, payload processors, clock-aware
+tracing, SLO attainment, and the flight recorder (docs/observability.md)."""
 
+from modelmesh_tpu.observability.flightrec import (
+    FLIGHTREC_DUMP_ID,
+    FlightRecorder,
+)
+from modelmesh_tpu.observability.slo import (
+    SloObjectives,
+    SloTracker,
+    parse_slo_spec,
+)
+from modelmesh_tpu.observability.tracing import (
+    TRACE_DUMP_ID,
+    Tracer,
+    incoming_trace_id,
+    outgoing_headers,
+)
 from modelmesh_tpu.observability.metrics import (
     Metric,
     Metrics,
@@ -19,6 +35,15 @@ from modelmesh_tpu.observability.payloads import (
 )
 
 __all__ = [
+    "FLIGHTREC_DUMP_ID",
+    "FlightRecorder",
+    "SloObjectives",
+    "SloTracker",
+    "TRACE_DUMP_ID",
+    "Tracer",
+    "incoming_trace_id",
+    "outgoing_headers",
+    "parse_slo_spec",
     "Metric",
     "Metrics",
     "NoopMetrics",

@@ -65,6 +65,19 @@ class Metric(enum.Enum):
                            "copies that began serving mid-transfer (PARTIAL)")
     # histograms (ms)
     API_REQUEST_TIME = ("mm_api_request_time_ms", "histogram", "request latency")
+    # Per-stage latency decomposition: closed tracing spans export here
+    # (observability/tracing.py) so p99 can be attributed to a stage
+    # instead of eyeballed from totals.
+    STAGE_ROUTE_SELECT = ("mm_stage_route_select_ms", "histogram",
+                          "serve/load target selection time (traced requests)")
+    STAGE_LOAD_WAIT = ("mm_stage_load_wait_ms", "histogram",
+                       "cache-miss wait for a local load (traced requests)")
+    STAGE_PEER_STREAM = ("mm_stage_peer_stream_ms", "histogram",
+                         "peer weight-stream duration (traced loads)")
+    STAGE_RUNTIME_INVOKE = ("mm_stage_runtime_invoke_ms", "histogram",
+                            "runtime inference call time (traced requests)")
+    STAGE_FORWARD_HOP = ("mm_stage_forward_hop_ms", "histogram",
+                         "internal forward hop round trip (traced requests)")
     LOAD_TIME = ("mm_load_time_ms", "histogram", "model load time")
     QUEUE_DELAY = ("mm_queue_delay_ms", "histogram", "load queue delay")
     CACHE_MISS_DELAY = ("mm_cache_miss_delay_ms", "histogram", "wait for load on miss")
@@ -93,6 +106,12 @@ class Metric(enum.Enum):
     CLUSTER_COPIES = ("mm_cluster_copies", "gauge", "total model copies (leader)")
     CLUSTER_CAPACITY_UNITS = ("mm_cluster_capacity_units", "gauge", "fleet cache capacity (leader)")
     CLUSTER_USED_UNITS = ("mm_cluster_used_units", "gauge", "fleet cache usage (leader)")
+    # SLO attainment engine (observability/slo.py): windowed per-model-
+    # class gauges, labeled slo_class="...".
+    SLO_ATTAINMENT = ("mm_slo_attainment", "gauge",
+                      "fraction of windowed requests meeting the class SLO")
+    SLO_BURN_RATE = ("mm_slo_burn_rate", "gauge",
+                     "error-budget burn rate (1 = burning exactly at budget)")
 
     def __init__(self, metric_name: str, kind: str, help_: str):
         self.metric_name = metric_name
@@ -114,7 +133,10 @@ class Metrics:
     def observe(self, metric: Metric, value_ms: float, model_id: str = "") -> None:
         pass
 
-    def set_gauge(self, metric: Metric, value: float) -> None:
+    def set_gauge(self, metric: Metric, value: float, label: str = "") -> None:
+        """``label`` is an optional pre-formatted extra label pair
+        (e.g. 'slo_class="default"') for gauges that carry one series
+        per key; empty keeps the classic unlabeled gauge."""
         pass
 
     def close(self) -> None:
@@ -184,7 +206,8 @@ class PrometheusMetrics(Metrics):
     ):
         self._lock = mm_lock("PrometheusMetrics._lock")  # gauges (rare)
         self._stripes = [_MetricStripe() for _ in range(_N_STRIPES)]
-        self._gauges: dict[str, float] = {}  #: guarded-by: _lock
+        # (metric name, extra label pair or "") -> value
+        self._gauges: dict[tuple[str, str], float] = {}  #: guarded-by: _lock
         self.per_model = per_model
         self.instance_id = instance_id
         self.port = 0
@@ -212,9 +235,9 @@ class PrometheusMetrics(Metrics):
                 hist = stripe.hists[key] = _Histogram(DEFAULT_BUCKETS_MS)
             hist.observe(value_ms)
 
-    def set_gauge(self, metric: Metric, value: float) -> None:
+    def set_gauge(self, metric: Metric, value: float, label: str = "") -> None:
         with self._lock:
-            self._gauges[metric.metric_name] = value
+            self._gauges[(metric.metric_name, label)] = value
 
     # -- exposition ----------------------------------------------------------
 
@@ -317,9 +340,9 @@ class PrometheusMetrics(Metrics):
             meta(name, "counter")
             extra = f'model_id="{model}"' if model else ""
             lines.append(f"{name}{labels(extra)} {v}")
-        for name, v in sorted(gauges.items()):
+        for (name, extra), v in sorted(gauges.items()):
             meta(name, "gauge")
-            lines.append(f"{name}{labels()} {v}")
+            lines.append(f"{name}{labels(extra)} {v}")
         for (name, model), (buckets, counts, total, count) in sorted(
             hists.items()
         ):
@@ -393,8 +416,17 @@ class StatsDMetrics(Metrics):
     def observe(self, metric: Metric, value_ms: float, model_id: str = "") -> None:
         self._send(f"{self._prefix}.{metric.metric_name}:{value_ms}|ms")
 
-    def set_gauge(self, metric: Metric, value: float) -> None:
-        self._send(f"{self._prefix}.{metric.metric_name}:{value}|g")
+    def set_gauge(self, metric: Metric, value: float, label: str = "") -> None:
+        # StatsD has no label concept: a labeled gauge (per-SLO-class
+        # series) maps onto a name suffix — 'slo_class="llm"' becomes
+        # mm.mm_slo_attainment.llm — so classes never collapse into one
+        # flapping series.
+        name = metric.metric_name
+        if label:
+            suffix = label.split("=", 1)[-1].strip('"').replace(".", "_")
+            if suffix:
+                name = f"{name}.{suffix}"
+        self._send(f"{self._prefix}.{name}:{value}|g")
 
     def close(self) -> None:
         self._sock.close()

@@ -90,6 +90,7 @@ class DrainController:
         # Phase 1: advertise DRAINING. The publish bumps the instances
         # view epoch on every peer, so memoized serve routes recompute
         # and new placements exclude us from here on.
+        inst.flightrec.record("drain", phase="advertise")
         inst.draining = True
         inst.publish_instance_record(force=True)
         deadline = clock.monotonic() + self.deadline_s
@@ -108,6 +109,8 @@ class DrainController:
                 # off; the final sweep deregisters it.
                 continue
             if last_used >= recent_cutoff and not skip_migration:
+                inst.flightrec.record("drain", phase="pre-copy",
+                                      model=model_id)
                 err = self._migrate(model_id, last_used)
                 if err is None:
                     report.migrated.append(model_id)
@@ -138,12 +141,19 @@ class DrainController:
         # Phase 3: final sweep — deregister everything left (pre-copy
         # failures, loading entries, post-deadline tail), then advertise
         # shutting_down so peers drop us from their live views.
+        inst.flightrec.record("drain", phase="final-sweep",
+                              deadline_hit=report.deadline_hit)
         inst.shutting_down = True
         for model_id, _ce, _lu in list(inst.cache.descending_items()):
             if inst._remove_local(model_id):
                 report.dropped.append(model_id)
         inst.publish_instance_record(force=True)
         report.finished_ms = now_ms()
+        inst.flightrec.record(
+            "drain", phase="done", migrated=len(report.migrated),
+            demoted=len(report.demoted), dropped=len(report.dropped),
+            failed=len(report.failed),
+        )
         log.info(
             "drain of %s complete in %dms: %d migrated, %d demoted, "
             "%d dropped, %d failed%s",
@@ -156,13 +166,17 @@ class DrainController:
 
     def _migrate(self, model_id: str, last_used: int) -> Optional[str]:
         """Place a servable copy on a survivor; returns an error string
-        (None = a survivor copy is ACTIVE/PARTIAL and registered)."""
+        (None = a survivor copy is ACTIVE/PARTIAL and registered). Each
+        pre-copy runs under its own trace: the placement forwards over
+        the normal internal hop, so the survivor's load (and its peer
+        stream back from us) assembles into one drain-visible tree."""
         inst = self.instance
         try:
-            status = inst.ensure_loaded(
-                model_id, last_used_ms=last_used, sync=True,
-                exclude={inst.instance_id},
-            )
+            with inst.tracer.trace("", model_id, "drain-precopy"):
+                status = inst.ensure_loaded(
+                    model_id, last_used_ms=last_used, sync=True,
+                    exclude={inst.instance_id},
+                )
         except Exception as e:  # noqa: BLE001 — per-model, drain continues
             return f"{type(e).__name__}: {e}"
         # sync=True blocks until the survivor copy is ACTIVE (a PARTIAL

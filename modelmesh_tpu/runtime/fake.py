@@ -123,6 +123,7 @@ class FakeRuntimeServicer:
 
     def predict(self, method: str, request: bytes, context) -> bytes:
         md = dict(context.invocation_metadata())
+        self.last_predict_metadata = md  # test hook: header propagation
         mid = md.get(grpc_defs.MODEL_ID_HEADER, "")
         if not mid:
             context.abort(

@@ -19,6 +19,7 @@ from typing import Optional
 
 import grpc
 
+from modelmesh_tpu.observability.tracing import outgoing_headers
 from modelmesh_tpu.utils.grpcopts import message_size_options
 from modelmesh_tpu.proto import mesh_runtime_pb2 as rpb
 from modelmesh_tpu.runtime import grpc_defs
@@ -222,8 +223,13 @@ class SidecarRuntime(ModelLoader[str]):
         cancel_event=None,
     ) -> bytes:
         """Invoke an arbitrary method on the runtime with the model id header
-        (reference ExternalModel.callModel, SidecarModelMesh.java:337-510)."""
-        md = [(grpc_defs.MODEL_ID_HEADER, model_id)] + (headers or [])
+        (reference ExternalModel.callModel, SidecarModelMesh.java:337-510).
+        The trace context rides this hop too (outgoing_headers attaches
+        the live trace id + span once), so runtime-side tooling can join
+        mesh traces — previously the runtime-SPI hop silently dropped it."""
+        md = outgoing_headers(
+            [(grpc_defs.MODEL_ID_HEADER, model_id)] + (headers or [])
+        )
         call = grpc_defs.raw_method(self._channel, full_method)
         if cancel_event is None:
             return call(payload, metadata=md, timeout=timeout_s)

@@ -29,7 +29,10 @@ from modelmesh_tpu.utils.grpcopts import message_size_options
 from modelmesh_tpu.observability.metrics import Metric as MX
 from modelmesh_tpu.observability.payloads import Payload
 from modelmesh_tpu.observability.tracing import (
+    SPAN_HEADER,
     TRACE_HEADER,
+    Tracer,
+    incoming_parent_span,
     incoming_trace_id,
 )
 
@@ -169,6 +172,7 @@ class MeshApiServicer:
                 model_id=STATE_DUMP_ID,
                 errors=[_json.dumps(debug_dump(self.instance))],
             )
+        from modelmesh_tpu.observability.flightrec import FLIGHTREC_DUMP_ID
         from modelmesh_tpu.observability.tracing import TRACE_DUMP_ID
 
         if request.model_id == TRACE_DUMP_ID:
@@ -180,17 +184,33 @@ class MeshApiServicer:
                 model_id=TRACE_DUMP_ID,
                 errors=[_json.dumps(tracer.recent(tracer.capacity))],
             )
+        if request.model_id == FLIGHTREC_DUMP_ID:
+            import json as _json
+
+            return apb.ModelStatusInfo(
+                status=apb.UNKNOWN,
+                model_id=FLIGHTREC_DUMP_ID,
+                errors=[_json.dumps(self.instance.flightrec.dump())],
+            )
         self._require_id(request.model_id, context)
         return self._status_info(request.model_id)
 
     def EnsureLoaded(self, request, context):
         self._require_id(request.model_id, context)
+        # Internal ensure ops ride invocation metadata for trace context
+        # (the request proto carries no headers): an upstream-traced
+        # ensure keeps its tree; untraced ones sample like any root.
+        md = list(context.invocation_metadata())
         try:
-            self.instance.ensure_loaded(
-                request.model_id,
-                last_used_ms=request.last_used_ms,
-                sync=request.sync,
-            )
+            with self.instance.tracer.trace(
+                incoming_trace_id(md), request.model_id, "EnsureLoaded",
+                parent_span=incoming_parent_span(md),
+            ):
+                self.instance.ensure_loaded(
+                    request.model_id,
+                    last_used_ms=request.last_used_ms,
+                    sync=request.sync,
+                )
         except ModelNotFoundError:
             return apb.ModelStatusInfo(
                 status=apb.NOT_FOUND, model_id=request.model_id
@@ -235,9 +255,20 @@ class MeshInternalServicer:
         context.add_callback(ctx.cancel_event.set)
         headers = list(request.headers.items())
         incoming_tid = incoming_trace_id(headers)
+        incoming_parent = incoming_parent_span(headers)
+        if incoming_tid:
+            # Trace context never rides the opaque header list downstream:
+            # outgoing_headers re-attaches it fresh (with THIS hop's span
+            # as the parent) on every outbound hop — a second forward of
+            # this request must not inherit hop-1's parent link.
+            headers = [
+                (k, v) for k, v in headers
+                if k != TRACE_HEADER and k != SPAN_HEADER
+            ]
         try:
             with self.instance.tracer.trace(
-                incoming_tid, request.model_id, request.method_name
+                incoming_tid, request.model_id, request.method_name,
+                parent_span=incoming_parent,
             ):
                 result = self.instance.invoke_model(
                     request.model_id,
@@ -284,10 +315,29 @@ class MeshInternalServicer:
         """Weight-transfer fetch (live scale-up): one chunk of this
         instance's snapshot of the model. Stateless per call; failures
         the receiver should treat as 'try another source' come back as a
-        NOT_AVAILABLE status rather than an RPC error."""
-        reply = self.instance.handle_weight_fetch(
-            request.model_id, request.chunk_index, request.fingerprint
-        )
+        NOT_AVAILABLE status rather than an RPC error. Trace context
+        rides invocation metadata (the fetch client attaches it), so a
+        traced receiver's stream shows the sender's chunk serving in the
+        same tree — recorded ONCE per transfer (chunk 0): a
+        record-per-chunk would evict the sender's whole trace ring on a
+        single multi-GB stream."""
+        md = list(context.invocation_metadata())
+        tid = incoming_trace_id(md) if request.chunk_index == 0 else ""
+        if tid:
+            with self.instance.tracer.trace(
+                tid, request.model_id, "FetchWeights",
+                parent_span=incoming_parent_span(md),
+            ), self.instance.tracer.span(
+                "serve-chunk", chunk=request.chunk_index,
+            ):
+                reply = self.instance.handle_weight_fetch(
+                    request.model_id, request.chunk_index,
+                    request.fingerprint,
+                )
+        else:
+            reply = self.instance.handle_weight_fetch(
+                request.model_id, request.chunk_index, request.fingerprint
+            )
         return tpb.FetchWeightsResponse(
             status=reply.status,
             payload=reply.payload,
@@ -384,13 +434,21 @@ class InferenceFallback:
         # identical one in the multi-model path plus separate md lookups).
         headers = []
         trace_id = ""
+        parent_span = ""
         for k, v in md.items():
             if k.startswith("grpc-") or not isinstance(v, str):
                 continue
             if k == grpc_defs.MODEL_ID_HEADER or k == grpc_defs.VMODEL_ID_HEADER:
                 continue
             if k == TRACE_HEADER:
+                # Captured, NOT forwarded in the opaque list: every
+                # outbound hop re-attaches the live trace context with
+                # its own span as the parent (outgoing_headers).
                 trace_id = v
+                continue
+            if k == SPAN_HEADER:
+                parent_span = v
+                continue
             headers.append((k, v))
         if "," in model_id:
             return self._multi_model(
@@ -417,7 +475,7 @@ class InferenceFallback:
         metrics.observe(MX.REQUEST_BYTES, len(request), model_id)
         try:
             with self.log_headers.bind(md.items()), self.instance.tracer.trace(
-                trace_id, model_id, method
+                trace_id, model_id, method, parent_span=parent_span,
             ):
                 result = self.instance.invoke_model(
                     model_id, method, request, headers,
@@ -491,14 +549,21 @@ class InferenceFallback:
         cancel_event = threading.Event()
         context.add_callback(cancel_event.set)
         t0 = _time.perf_counter()
-        import uuid as _uuid
-
-        trace_id = trace_id or _uuid.uuid4().hex[:16]
+        # Adopted ids always trace; a fan-out without one is sampled like
+        # any minted root (maybe_mint, not uuid4: no per-request entropy
+        # I/O, and sampled-out fan-outs skip tracing entirely instead of
+        # letting each member mint a fragment).
+        trace_id = trace_id or self.instance.tracer.maybe_mint()
 
         def run_member(mid):
             # Pool threads don't inherit the handler's trace contextvar:
             # each member records under the SHARED trace id so the fan-out
             # appears as one trace across instances.
+            if not trace_id:
+                return self.instance.invoke_model(
+                    mid, method, request, headers,
+                    RoutingContext(cancel_event=cancel_event),
+                )
             with self.instance.tracer.trace(trace_id, mid, method):
                 return self.instance.invoke_model(
                     mid, method, request, headers,
@@ -780,8 +845,15 @@ def make_grpc_peer_fetch(channels: Optional[PeerChannels] = None,
             model_id=model_id, chunk_index=chunk_index,
             fingerprint=fingerprint,
         )
+        # Propagate the fetching load's trace context so the sender's
+        # chunk-serving records join the receiver's trace tree.
+        tid = Tracer.current_trace_id()
+        md = (
+            ((TRACE_HEADER, tid), (SPAN_HEADER, Tracer.current_span_id()))
+            if tid else None
+        )
         try:
-            resp = stub.FetchWeights(req, timeout=timeout_s)
+            resp = stub.FetchWeights(req, timeout=timeout_s, metadata=md)
         except grpc.RpcError as e:
             # Transport-level failure (peer death, deadline): surfaced as
             # the mesh's unavailable error so the transfer manager's

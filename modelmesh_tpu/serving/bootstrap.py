@@ -164,6 +164,22 @@ def debug_dump(instance: ModelMeshInstance) -> dict:
         },
         "cluster": instances,
         "registry": registry,
+        # Windowed SLO attainment per tracked class (observability/slo.py)
+        # — the operator's "are we meeting objectives RIGHT NOW" read.
+        "slo": {
+            cls: {
+                "requests": s.requests,
+                "availability": round(s.availability, 5),
+                "p99Ms": round(s.p99_ms, 1),
+                "attained": s.attained,
+                "burnRate": round(s.burn_rate, 3),
+                "violations": s.violations,
+            }
+            for cls, s in (
+                (c, instance.slo.attainment(c))
+                for c in instance.slo.classes()
+            )
+        },
         # Shadow-mode evaluation report, when the strategy runs one
         # (placement/shadow.py): agreement rates + recent divergences.
         **(

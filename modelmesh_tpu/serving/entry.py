@@ -108,6 +108,13 @@ class CacheEntry:
         # True once begin_partial installed a provisional runtime copy
         # (sticky — survives later state transitions; see _load_failed).
         self.partial_started = False
+        # Observability linkage, attached by the owning instance at
+        # insert time: every state transition is recorded into the
+        # flight recorder, and a load inherits the initiating request's
+        # trace context (observability/tracing.py).
+        self.recorder = None  # FlightRecorder | None
+        self.trace_id = ""
+        self.trace_parent = ""
         self.max_concurrency = 0
         self.inflight = 0  #: guarded-by: _lock
         self.total_invocations = 0  #: guarded-by: _lock
@@ -144,10 +151,18 @@ class CacheEntry:
     # -- state ------------------------------------------------------------
 
     def _transition_locked(self, new: EntryState) -> None:
+        prev = self.state
         self.state = new
         if new.is_terminal:
             self._done.set()
         self._state_cv.notify_all()
+        # Flight-recorder hook (the single funnel every transition takes):
+        # the stripe lock nests INSIDE CacheEntry._lock and the recorder
+        # never takes entry locks, so the edge is acyclic.
+        rec = self.recorder
+        if rec is not None:
+            rec.record("state", model=self.model_id, frm=prev.value,
+                       to=new.value)
 
     def try_transition(self, new: EntryState) -> bool:
         """Advance to a non-terminal loading state unless already terminal
@@ -156,8 +171,7 @@ class CacheEntry:
         with self._lock:
             if self.state.is_terminal:
                 return False
-            self.state = new
-            self._state_cv.notify_all()
+            self._transition_locked(new)
             return True
 
     def claim_chain_fire(self) -> bool:

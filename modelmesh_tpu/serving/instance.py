@@ -68,7 +68,7 @@ from modelmesh_tpu.serving.errors import (
     ServiceUnavailableError,
 )
 from modelmesh_tpu.observability.metrics import Metric as MX
-from modelmesh_tpu.observability.tracing import outgoing_headers
+from modelmesh_tpu.observability.tracing import Tracer, outgoing_headers
 from modelmesh_tpu.serving.rate import RateTracker
 from modelmesh_tpu.serving.route_cache import RouteCache
 from modelmesh_tpu.utils.clock import get_clock
@@ -170,6 +170,8 @@ class InstanceConfig:
         host_tier_bytes: Optional[int] = None,
         drain_on_sigterm: Optional[bool] = None,
         drain_timeout_ms: Optional[int] = None,
+        trace_sample: Optional[int] = None,
+        slo_spec: Optional[str] = None,
     ):
         self.instance_id = instance_id or f"i-{uuid.uuid4().hex[:8]}"
         self.kv_prefix = kv_prefix.rstrip("/")
@@ -236,6 +238,17 @@ class InstanceConfig:
         if drain_timeout_ms is None:
             drain_timeout_ms = _envs.get_int("MM_DRAIN_TIMEOUT_MS")
         self.drain_timeout_ms = drain_timeout_ms
+        # Observability substrate: head-sampling for minted trace roots
+        # (MM_TRACE_SAMPLE; 1 = trace every request — the sim pins this
+        # so scenario assertions are deterministic) and the declarative
+        # per-model-class SLO spec (MM_SLO_SPEC grammar,
+        # observability/slo.py).
+        if trace_sample is None:
+            trace_sample = _envs.get_int("MM_TRACE_SAMPLE")
+        self.trace_sample = trace_sample
+        if slo_spec is None:
+            slo_spec = _envs.get("MM_SLO_SPEC")
+        self.slo_spec = slo_spec
 
 
 class ModelMeshInstance:
@@ -318,10 +331,23 @@ class ModelMeshInstance:
             else params.load_timeout_ms / 1000.0
         )
 
-        from modelmesh_tpu.observability.tracing import Tracer
+        from modelmesh_tpu.observability.flightrec import FlightRecorder
+        from modelmesh_tpu.observability.slo import SloTracker
         from modelmesh_tpu.serving.timestats import TimeStats
 
-        self.tracer = Tracer(self.instance_id)
+        # A Noop backend gets no sink at all: the SLO tracker's amortized
+        # gauge export sorts its window, and the tracer's stage lookup is
+        # a dict probe per span — neither belongs on the hot path when
+        # nothing renders the result.
+        from modelmesh_tpu.observability.metrics import NoopMetrics as _Noop
+
+        sink = None if isinstance(self.metrics, _Noop) else self.metrics
+        self.tracer = Tracer(
+            self.instance_id, metrics=sink,
+            sample_n=self.config.trace_sample,
+        )
+        self.flightrec = FlightRecorder(instance_id=self.instance_id)
+        self.slo = SloTracker(spec=self.config.slo_spec, metrics=sink)
         self.time_stats = TimeStats()
         # Strategies that accept per-type load-time stats (greedy's warming
         # penalty and wait-vs-reroute bound) get this instance's tracker.
@@ -829,11 +855,35 @@ class ModelMeshInstance:
         )
         _thread.name = f"invoke-{hop_name}-{model_id}"
         try:
-            return self._invoke_model_inner(
-                model_id, method, payload, headers, ctx, sync
-            )
+            if ctx.hop != RoutingContext.EXTERNAL:
+                return self._invoke_model_inner(
+                    model_id, method, payload, headers, ctx, sync
+                )
+            # External completion feeds the SLO attainment window (one
+            # sample per request, never per hop). Latency through the
+            # injectable clock so the sim's windows carry virtual time.
+            clock = get_clock()
+            t0 = clock.monotonic()
+            ok = False
+            try:
+                result = self._invoke_model_inner(
+                    model_id, method, payload, headers, ctx, sync
+                )
+                ok = True
+                return result
+            finally:
+                self.slo.record(
+                    self._model_class(model_id),
+                    (clock.monotonic() - t0) * 1e3, ok,
+                )
         finally:
             _thread.name = _prev_name
+
+    def _model_class(self, model_id: str) -> str:
+        """SLO class of a model = its model_type (watch-fed view read;
+        unknown models fall to the spec's default class)."""
+        mr = self.registry_view.get(model_id)
+        return mr.model_type if mr is not None else ""
 
     def _invoke_model_inner(
         self,
@@ -924,7 +974,9 @@ class ModelMeshInstance:
                 )
 
             # 2. cache-hit loop: forward to a loaded copy
-            target = self._choose_serve_target(model_id, mr, ctx)
+            with self.tracer.span("route-select", model=model_id) as _sp:
+                target = self._choose_serve_target(model_id, mr, ctx)
+                _sp["target"] = target or ""
             if target is not None:
                 try:
                     return self._forward(
@@ -983,6 +1035,10 @@ class ModelMeshInstance:
                 last_used_ms=ctx.last_used_ms or now_ms(),
             )
             target = self.strategy.choose_load_target(req, self.cluster_view())
+            self.flightrec.record(
+                "placement", model=model_id, target=target or "",
+                hop=ctx.hop,
+            )
             if target in (LOAD_HERE, self.instance_id):
                 ce = self._load_local(model_id, mr, ctx)
                 if ce is not None:
@@ -1077,7 +1133,8 @@ class ModelMeshInstance:
             # streamed copy is already servable — no miss recorded.
             self.metrics.inc(MX.CACHE_MISS_COUNT, model_id=ce.model_id)
             t_wait = _time.perf_counter()
-            ok = self._wait_entry_active(ce, cancel_event=cancel_event)
+            with self.tracer.span("load-wait", model=ce.model_id):
+                ok = self._wait_entry_active(ce, cancel_event=cancel_event)
             self.metrics.observe(
                 MX.CACHE_MISS_DELAY,
                 (_time.perf_counter() - t_wait) * 1e3, ce.model_id,
@@ -1355,6 +1412,13 @@ class ModelMeshInstance:
         last_used = ctx.last_used_ms or now_ms()
         ce = CacheEntry(model_id, info, weight_units=units, last_used=last_used)
         ce.chain_load_count = ctx.chain_load_count
+        # Observability linkage: state transitions flow into the flight
+        # recorder, and the load (which runs on a pool thread with no
+        # request context) inherits the initiating request's trace id +
+        # open span so the load's trace record joins the same tree.
+        ce.recorder = self.flightrec
+        ce.trace_id = Tracer.current_trace_id()
+        ce.trace_parent = Tracer.current_span_id()
         prev = self.cache.put_if_absent(model_id, ce, units, last_used=last_used)
         if prev is not None:
             return prev
@@ -1374,6 +1438,8 @@ class ModelMeshInstance:
                 ce.remove()
                 raise ModelNotFoundError(model_id)
         except CasFailed:
+            self.flightrec.record("cas-failed", op="claim-loading",
+                                  model=model_id)
             self.cache.remove_if_value(model_id, ce)
             ce.remove()
             raise
@@ -1421,6 +1487,18 @@ class ModelMeshInstance:
         # worker pickup — otherwise the metric reads ~0 exactly when the
         # loading pool is saturated.
         queued_ms = getattr(ce, "queued_ms", None) or now_ms()
+        # The load runs on a pool thread: re-open the initiating request's
+        # trace (ce.trace_id, parented under its open span) so cache-miss
+        # wait, peer stream, and activation appear in ONE tree. An
+        # untraced origin mints (sampled) its own load trace.
+        with self.tracer.trace(
+            getattr(ce, "trace_id", ""), model_id, "load",
+            parent_span=getattr(ce, "trace_parent", ""),
+        ):
+            self._run_load_traced(ce, queued_ms)
+
+    def _run_load_traced(self, ce: CacheEntry, queued_ms: int) -> None:
+        model_id = ce.model_id
         try:
             if self.loader.requires_unload:
                 if not ce.try_transition(EntryState.WAITING):
@@ -1616,6 +1694,8 @@ class ModelMeshInstance:
             # The record mutation gave up AND the piggybacked publish
             # never committed — let the caller's coalesced publish carry
             # the advertisement on its own.
+            self.flightrec.record("cas-failed", op="promote-txn",
+                                  model=model_id)
             log.warning("promote-loaded CAS gave up for %s", model_id)
             return False
         except _NoPublishLease:
@@ -2059,6 +2139,8 @@ class ModelMeshInstance:
         try:
             self.registry.update_or_create(model_id, mutate)
         except CasFailed:
+            self.flightrec.record("cas-failed", op="deregister",
+                                  model=model_id)
             log.warning("deregister CAS gave up for %s", model_id)
 
     # ------------------------------------------------------------------ #

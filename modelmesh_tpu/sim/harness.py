@@ -14,10 +14,18 @@ direct-tick tests in tests/test_sim_cluster.py.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 from typing import Optional
 
+from modelmesh_tpu.observability.tracing import (
+    SPAN_HEADER,
+    TRACE_HEADER,
+    Tracer,
+    incoming_parent_span,
+    incoming_trace_id,
+)
 from modelmesh_tpu.runtime.spi import (
     LoadedModel,
     LocalInstanceParams,
@@ -236,11 +244,14 @@ class SimCluster:
         # Instances this scenario demanded copies of (feeds the
         # availability invariant).
         self.demanded: set[str] = set()
-        # Per-request outcome log: (virtual_ms, model_id, ok, error).
-        # The reconfiguration scenarios' no-failure-spike check reads
-        # this — "no demanded model unserved at any virtual instant" is
-        # asserted over the observed probe traffic, not just quiescence.
-        self.request_log: list[tuple[int, str, bool, str]] = []
+        # Per-request outcome log:
+        # (virtual_ms, model_id, ok, error, virtual_latency_ms).
+        # The reconfiguration scenarios' no-failure-spike check and the
+        # SLO invariant read this — "no demanded model unserved at any
+        # virtual instant" and "p99 within objective at every
+        # checkpoint" are asserted over the observed probe traffic, not
+        # just quiescence.
+        self.request_log: list[tuple[int, str, bool, str, int]] = []
         # instance_id -> virtual ms it died (kill or post-drain); the
         # runner merges this into the dead-placement grace bookkeeping
         # for deaths IT didn't schedule (e.g. rolling-upgrade waves).
@@ -297,6 +308,9 @@ class SimCluster:
         loader = SimLoader(
             capacity_bytes=capacity_bytes, load_delay_ms=load_delay_ms
         )
+        # trace_sample=1 unless the scenario overrides: scenario trace
+        # assertions must be deterministic, not a sampling coin flip.
+        config_kwargs.setdefault("trace_sample", 1)
         inst = ModelMeshInstance(
             self.kv.for_instance(iid),
             loader,
@@ -334,9 +348,26 @@ class SimCluster:
         pod = self._find(endpoint)
         if pod is None or not pod.alive:
             raise ServiceUnavailableError(endpoint)
-        return pod.instance.invoke_model(
-            model_id, method, payload, list(headers), ctx, sync=True
-        )
+        # Emulate the wire's trace handoff (MeshInternalServicer.Forward):
+        # the receiving pod re-opens the propagated trace in ITS tracer,
+        # parented under the sender's forward span — even though the call
+        # runs on the sender's thread here.
+        headers = list(headers)
+        tid = incoming_trace_id(headers)
+        parent = incoming_parent_span(headers)
+        if tid:
+            # Like the wire servicer: the context is re-attached fresh on
+            # any further outbound hop, never replayed from this list.
+            headers = [
+                (k, v) for k, v in headers
+                if k not in (TRACE_HEADER, SPAN_HEADER)
+            ]
+        with pod.instance.tracer.trace(
+            tid, model_id, method or "", parent_span=parent,
+        ) if tid else contextlib.nullcontext():
+            return pod.instance.invoke_model(
+                model_id, method, payload, headers, ctx, sync=True
+            )
 
     def _peer_fetch(self, endpoint: str, model_id: str, chunk_index: int,
                     fingerprint: str):
@@ -353,9 +384,22 @@ class SimCluster:
             # A KV partition models a full network partition for the
             # instance: the transfer channel is unreachable too.
             raise ServiceUnavailableError(endpoint)
-        return pod.instance.handle_weight_fetch(
-            model_id, chunk_index, fingerprint
-        )
+        # Trace handoff, as on the gRPC FetchWeights surface: the
+        # fetching load's trace context (live on this thread) re-opens
+        # in the SENDER pod's tracer so its chunk serving joins the
+        # tree — once per transfer (chunk 0), like the wire servicer.
+        tid = Tracer.current_trace_id() if chunk_index == 0 else ""
+        if not tid:
+            return pod.instance.handle_weight_fetch(
+                model_id, chunk_index, fingerprint
+            )
+        with pod.instance.tracer.trace(
+            tid, model_id, "FetchWeights",
+            parent_span=Tracer.current_span_id(),
+        ), pod.instance.tracer.span("serve-chunk", chunk=chunk_index):
+            return pod.instance.handle_weight_fetch(
+                model_id, chunk_index, fingerprint
+            )
 
     def add_transfer_hook(self, hook) -> None:
         self._transfer_hooks.append(hook)
@@ -541,18 +585,27 @@ class SimCluster:
             log.debug("sim ensure(%s) raced a fault: %s", model_id, e)
 
     def invoke(self, model_id: str, via: Optional[str] = None) -> None:
+        """One probe request, entered at ``via`` (default: first live
+        pod), traced end-to-end (sim pods trace every root), and logged
+        as (virtual_ms, model, ok, error, virtual_latency_ms) — the SLO
+        invariant's observed-traffic witness."""
         self.demanded.add(model_id)
-        now = _clock.get_clock().now_ms()
+        clock = _clock.get_clock()
+        now = clock.now_ms()
         try:
             pod = self.by_id(via) if via else self.first_live()
-            pod.instance.invoke_model(model_id, "/sim/Predict", b"x", [])
+            with pod.instance.tracer.trace("", model_id, "/sim/Predict"):
+                pod.instance.invoke_model(model_id, "/sim/Predict", b"x", [])
         except Exception as e:  # noqa: BLE001 — demand may race faults
             self.request_log.append(
-                (now, model_id, False, f"{type(e).__name__}: {e}")
+                (now, model_id, False, f"{type(e).__name__}: {e}",
+                 clock.now_ms() - now)
             )
             log.debug("sim invoke(%s) raced a fault: %s", model_id, e)
         else:
-            self.request_log.append((now, model_id, True, ""))
+            self.request_log.append(
+                (now, model_id, True, "", clock.now_ms() - now)
+            )
 
     def unregister(self, model_id: str) -> None:
         try:

@@ -30,6 +30,13 @@ Catalog (see docs/testing.md for the rationale of each):
   placements as some other checker's problem (it is neither a dead
   placement — the pod is alive — nor a cache mismatch once the local
   copy is gone).
+
+``slo_attained(spec)`` is a FACTORY, not part of the standard suite:
+scenarios attach it via ``extra_checks`` with their own objective spec.
+Unlike the quiescent checkers it judges the OBSERVED probe traffic —
+windowed p99/availability over the whole run's virtual timeline, a
+violation string per failing virtual checkpoint — so a mid-run latency
+spike fails the scenario even if the cluster later converges.
 """
 
 from __future__ import annotations
@@ -239,6 +246,72 @@ def draining_deregistered(cluster: "SimCluster") -> list[str]:
                     "draining (deregistration lost?)"
                 )
     return out
+
+
+def slo_attained(spec: str, window_ms: int = 10_000, min_requests: int = 1):
+    """Machine-checked SLO attainment over the scenario's observed probe
+    traffic (``SimCluster.request_log``: virtual ts, model, ok, error,
+    virtual latency). The run's virtual timeline is cut into
+    ``window_ms`` checkpoints; every checkpoint with at least
+    ``min_requests`` completions must meet the spec's objectives
+    (observability/slo.py grammar — the 'default' class judges all sim
+    traffic). Returns the standard checker shape: one violation string
+    per failing checkpoint; a run with NO evaluated checkpoint fails as
+    vacuous."""
+    from modelmesh_tpu.observability.slo import (
+        _percentile,
+        parse_slo_spec,
+    )
+
+    objectives = parse_slo_spec(spec)
+    obj = objectives.get("default") or next(iter(objectives.values()))
+
+    def check(cluster: "SimCluster") -> list[str]:
+        log_ = list(cluster.request_log)
+        if not log_:
+            return ["no probe requests observed (vacuous SLO run)"]
+        out: list[str] = []
+        base = min(t for t, *_ in log_)
+        windows: dict[int, list[tuple[float, bool]]] = {}
+        for t, _mid, ok, _err, latency_ms in log_:
+            windows.setdefault((t - base) // window_ms, []).append(
+                (latency_ms, ok)
+            )
+        evaluated = 0
+        for idx in sorted(windows):
+            samples = windows[idx]
+            if len(samples) < min_requests:
+                continue
+            evaluated += 1
+            at = f"checkpoint @{base + idx * window_ms}ms"
+            lat = sorted(v for v, _ in samples)
+            n = len(samples)
+            avail = sum(1 for _, ok in samples if ok) / n
+            for name, q, want in (
+                ("p50", 0.50, obj.p50_ms), ("p95", 0.95, obj.p95_ms),
+                ("p99", 0.99, obj.p99_ms),
+            ):
+                if want is None:
+                    continue
+                got = _percentile(lat, q)
+                if got > want:
+                    out.append(
+                        f"{at}: {name}={got:.0f}ms > {want:g}ms "
+                        f"(n={n}, spec {spec!r})"
+                    )
+            if obj.availability is not None and avail < obj.availability:
+                out.append(
+                    f"{at}: availability={avail:.4f} < "
+                    f"{obj.availability:g} (n={n})"
+                )
+        if not evaluated:
+            out.append(
+                f"no checkpoint reached {min_requests} requests "
+                "(vacuous SLO run)"
+            )
+        return out
+
+    return check
 
 
 def check_all(

@@ -41,6 +41,10 @@ Event kinds:
   register_flat <model>   write a LEGACY flat-layout registry record
                           (pre-bucketing key shape) straight into the
                           store — the live-migration scenarios' seed
+  invoke <model> [via]    probe request, optionally entered at a named
+                          pod (forces a forward when the pod holds no
+                          copy); traced end-to-end, outcome + virtual
+                          latency logged for the SLO invariant
   migrate_fence <phase>   advertise the migration epoch (live|done)
                           without running the sweep — how a scenario
                           turns on dual-read before its workload starts
@@ -122,13 +126,20 @@ class ScenarioResult:
     trace: list[str]
     verdicts: dict[str, list[str]]
     wall_s: float
+    # Flight-recorder tail per pod (observability/flightrec.py), captured
+    # automatically when ANY invariant fails — the postmortem that turns
+    # "replay the seed and stare" into "read the events before the
+    # violation". None on passing runs (nothing to explain).
+    flight_records: Optional[dict[str, list[dict]]] = None
 
     @property
     def ok(self) -> bool:
         return not any(self.verdicts.values())
 
     def trace_lines(self) -> list[str]:
-        """The replay-comparable artifact: events + verdicts, no wall."""
+        """The replay-comparable artifact: events + verdicts, no wall
+        (and no flight events — their interleaving is thread-schedule-
+        dependent, unlike the verdicts)."""
         lines = list(self.trace)
         for name, violations in self.verdicts.items():
             lines.append(
@@ -137,8 +148,22 @@ class ScenarioResult:
             )
         return lines
 
-    def render(self) -> str:
-        return "\n".join(self.trace_lines())
+    def render(self, flight_tail: int = 40) -> str:
+        lines = self.trace_lines()
+        if self.flight_records:
+            lines.append("--- flight recorder (per-pod tail) ---")
+            for iid in sorted(self.flight_records):
+                events = self.flight_records[iid]
+                lines.append(f"[{iid}] {len(events)} events recorded")
+                for ev in events[-flight_tail:]:
+                    fields = " ".join(
+                        f"{k}={v}" for k, v in ev.items()
+                        if k not in ("seq", "ts_ms", "kind", "instance")
+                    )
+                    lines.append(
+                        f"  @{ev['ts_ms']}ms {ev['kind']} {fields}".rstrip()
+                    )
+        return "\n".join(lines)
 
 
 class ScenarioRunner:
@@ -237,7 +262,11 @@ class ScenarioRunner:
             chain = int(args[1]) if len(args) > 1 else 0
             target, targs = cluster.ensure, (args[0], chain)
         elif kind == "invoke":
-            target, targs = cluster.invoke, (args[0],)
+            # Optional second arg: the entry pod ("invoke via sim-2") —
+            # how scenarios guarantee a forward hop instead of relying
+            # on placement to keep models off the default entry pod.
+            via = args[1] if len(args) > 1 else None
+            target, targs = cluster.invoke, (args[0], via)
         else:
             raise ValueError(f"unknown scenario event kind: {kind}")
         t = threading.Thread(
@@ -342,12 +371,23 @@ class ScenarioRunner:
                 )
                 for name, fn in (sc.extra_checks or {}).items():
                     verdicts[name] = fn(cluster)
+                # Invariant failure => automatic flight-recorder dump:
+                # every pod's structured-event tail (state transitions,
+                # placements, CAS outcomes, transfer faults, drain
+                # phases) rides the result for the postmortem.
+                flight = None
+                if any(verdicts.values()):
+                    flight = {
+                        p.iid: p.instance.flightrec.dump()
+                        for p in cluster.pods
+                    }
                 return ScenarioResult(
                     name=sc.name,
                     seed=sc.seed,
                     trace=self.trace,
                     verdicts=verdicts,
                     wall_s=_wall.perf_counter() - t_wall,
+                    flight_records=flight,
                 )
             finally:
                 if cluster is not None:

@@ -47,6 +47,15 @@ Catalog (race -> origin):
   quiesce's async-drain + inline janitor cycle must repair the record
   before invariants read (fails with quiesce_async reverted, see
   tests/test_sim_scenarios.py meta-test).
+- slo_under_flash_crowd — the observability tentpole proof: seeded Zipf
+  probes (entered via rotating pods, forcing forward hops) with a
+  flash-crowd overlay on a slow-loading cold model, judged by the
+  machine-checked ``slo_attained`` invariant at every 10 s virtual
+  checkpoint — PLUS assembled multi-instance trace-tree checks
+  (route-select/forward/load-wait/peer-stream spans with virtual
+  timestamps, cross-instance parent links). The parametrized spec makes
+  the meta-test's violated variant fail the invariant and emit the
+  flight-recorder dump (non-vacuity both ways).
 """
 
 from __future__ import annotations
@@ -489,7 +498,7 @@ def _check_no_request_failures(cluster: SimCluster):
     observed request log is the 'at every virtual instant' witness."""
     failures = [
         f"@{t}ms {mid}: {err}"
-        for t, mid, ok, err in cluster.request_log if not ok
+        for t, mid, ok, err, _lat in cluster.request_log if not ok
     ]
     if failures:
         return [
@@ -705,6 +714,163 @@ def late_eviction_deregister_quiesce() -> Scenario:
     )
 
 
+# ------------------------------------------------------------------ #
+# 12. SLO attainment + assembled trace trees under Zipf + flash crowd  #
+# ------------------------------------------------------------------ #
+
+_SLO_MODELS = [f"m-s{i}" for i in range(6)]
+# SLOW_LOAD_PREFIX forces a >=2s virtual load on every pod — the flash
+# crowd rides ONE load and its virtual latency is deterministic-ish.
+_FLASH_MODEL = "slow-load-flash"
+
+
+def _slo_zipf_invokes(seed: int, start_ms: int, end_ms: int,
+                      every_ms: int, n_pods: int) -> list[Event]:
+    """Seeded Zipf probes entered via a ROTATING pod: with fewer copies
+    than pods, some entries are guaranteed non-holders, so forward hops
+    (and their trace handoffs) happen deterministically."""
+    rng = random.Random(seed)
+    weights = [1.0 / (i + 1) ** 1.2 for i in range(len(_SLO_MODELS))]
+    events = []
+    for k, t in enumerate(range(start_ms, end_ms, every_ms)):
+        mid = rng.choices(_SLO_MODELS, weights)[0]
+        events.append(Event(t, "invoke", (mid, f"sim-{k % n_pods}")))
+    return events
+
+
+def _check_trace_trees(cluster: SimCluster):
+    """The tentpole's observable: assembled MULTI-INSTANCE trace trees.
+
+    (a) some trace crosses instances through a forward hop and ends in a
+        runtime call, with the forwarded hop's record parented under the
+        sender's forward span (the cross-instance tree edge);
+    (b) the flash crowd leaves one trace showing route-select +
+        load-wait riding the shared load;
+    (c) some trace shows a peer weight stream with the SENDER's
+        serve-chunk records joined in (receiver + sender instances);
+    (d) every span timestamp is VIRTUAL (>= the virtual epoch) — the
+        satellite clock fix made observable.
+    """
+    from modelmesh_tpu.sim.tracing import TraceCollector
+    from modelmesh_tpu.utils.clock import VIRTUAL_EPOCH_MS
+
+    col = TraceCollector(cluster)
+    traces = col.collect()
+    out: list[str] = []
+    if not traces:
+        return ["no traces collected (vacuous run)"]
+
+    def names(recs):
+        got = set()
+        for r in recs:
+            for s in r["spans"]:
+                got.add(s["name"])
+        return got
+
+    def insts(recs):
+        return {r["instance"] for r in recs}
+
+    fwd_trace = None
+    for tid, recs in traces.items():
+        if len(insts(recs)) >= 2 and {"forward", "runtime-call"} <= names(recs):
+            fwd_trace = tid
+            break
+    if fwd_trace is None:
+        out.append("no multi-instance trace with forward + runtime-call")
+    else:
+        # The cross-instance edge: a record whose parent is the sending
+        # side's forward span.
+        recs = traces[fwd_trace]
+        span_ids = {
+            s["span_id"] for r in recs for s in r["spans"]
+            if s["name"] == "forward"
+        }
+        if not any(r["parent_id"] in span_ids for r in recs):
+            out.append(
+                f"trace {fwd_trace}: forwarded record not parented "
+                "under the sender's forward span"
+            )
+        elif col.depth(fwd_trace) < 3:
+            out.append(
+                f"trace {fwd_trace}: assembled tree depth "
+                f"{col.depth(fwd_trace)} < 3"
+            )
+    if not any(
+        {"route-select", "load-wait"} <= names(recs)
+        for recs in traces.values()
+    ):
+        out.append("no trace shows route-select + load-wait (flash crowd)")
+    if not any(
+        "peer-stream" in names(recs) and len(insts(recs)) >= 2
+        and "serve-chunk" in names(recs)
+        for recs in traces.values()
+    ):
+        out.append(
+            "no multi-instance peer-stream trace with sender serve-chunk"
+        )
+    for tid, recs in traces.items():
+        for r in recs:
+            stamps = [r["start_ms"]] + [s["start_ms"] for s in r["spans"]]
+            if any(ts < VIRTUAL_EPOCH_MS for ts in stamps):
+                out.append(
+                    f"trace {tid}: wall-clock timestamp leaked into a "
+                    "virtual-time trace"
+                )
+                break
+    return out
+
+
+def slo_under_flash_crowd(p99_ms: float = 8_000.0) -> Scenario:
+    """Seeded Zipf load with a flash-crowd overlay, judged by the
+    machine-checked SLO invariant at every 10 s virtual checkpoint, plus
+    the assembled-trace-tree checks. ``p99_ms`` parametrizes the spec so
+    the meta-test can prove non-vacuity: a deliberately violated spec
+    (e.g. p99<100ms against a flash crowd riding a 2 s load) must FAIL
+    the invariant and emit a flight-recorder dump."""
+    from modelmesh_tpu.sim import invariants
+
+    n_pods = 4
+    events = [Event(0, "register", (mid,)) for mid in _SLO_MODELS]
+    events.append(Event(0, "register", (_FLASH_MODEL,)))
+    # Two copies of the two hottest, singles for the tail: 8 copies over
+    # 4 pods leaves every pod a non-holder of SOMETHING hot.
+    events += [
+        Event(400 + 150 * i, "ensure", (mid, 1 if i < 2 else 0))
+        for i, mid in enumerate(_SLO_MODELS)
+    ]
+    events += _slo_zipf_invokes(
+        seed=112, start_ms=4_000, end_ms=54_000, every_ms=600,
+        n_pods=n_pods,
+    )
+    # Flash crowd: a cold model with a forced >=2s load, hammered from
+    # every pod — one store load, everyone else rides it (load-wait) or
+    # forwards to the loading copy.
+    events += [
+        Event(20_000 + 300 * k, "invoke", (_FLASH_MODEL, f"sim-{k % n_pods}"))
+        for k in range(10)
+    ]
+    # Scale-up after the crowd: the second copy streams weights from the
+    # first over the mesh transfer channel (peer-stream + serve-chunk).
+    events.append(Event(30_000, "ensure", (_FLASH_MODEL, 1)))
+    spec = f"default:p99<{p99_ms:g}ms,availability>0.999"
+    return Scenario(
+        name="slo-under-flash-crowd",
+        seed=112,
+        n_instances=n_pods,
+        horizon_ms=60_000,
+        task_config=_tasks(),
+        events=events,
+        step_ms=500,
+        extra_checks={
+            "slo_attained": invariants.slo_attained(
+                spec, window_ms=10_000, min_requests=3
+            ),
+            "no_request_failures": _check_no_request_failures,
+            "trace_trees": _check_trace_trees,
+        },
+    )
+
+
 ALL = (
     fanout_budget_under_first_load_failure,
     promote_publish_suppression,
@@ -717,6 +883,7 @@ ALL = (
     rolling_restart_under_zipf_load,
     live_registry_migration_under_load,
     late_eviction_deregister_quiesce,
+    slo_under_flash_crowd,
 )
 
 

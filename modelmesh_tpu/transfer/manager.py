@@ -175,7 +175,11 @@ class WeightTransferManager:
                 attempts += 1
                 try:
                     return self._stream_from(endpoint, iid, ce, fp, partial_cb)
-                except TransferUnavailable:
+                except TransferUnavailable as e:
+                    inst.flightrec.record(
+                        "transfer-fault", model=model_id, sender=iid,
+                        fatal=False, error=str(e)[:120],
+                    )
                     log.info(
                         "peer %s cannot serve weights for %s; trying the "
                         "next source", iid, model_id,
@@ -184,6 +188,10 @@ class WeightTransferManager:
                 except Exception as e:  # noqa: BLE001 — peer death etc.
                     self.metrics.inc(
                         MX.TRANSFER_FALLBACK_COUNT, model_id=model_id
+                    )
+                    inst.flightrec.record(
+                        "transfer-fault", model=model_id, sender=iid,
+                        fatal=True, error=str(e)[:120],
                     )
                     log.warning(
                         "peer weight stream of %s from %s failed "
@@ -218,33 +226,42 @@ class WeightTransferManager:
         inst = self.instance
         model_id, info = ce.model_id, ce.info
         fetch = inst.peer_fetch_transport
-        first = fetch(endpoint, model_id, 0, fp)
-        if not first.ok:
-            raise TransferUnavailable(sender_iid)
-        total = first.total_chunks
-        rx = {"bytes": len(first.payload)}
-        t0 = _time.perf_counter()
+        # The whole chunked transfer is one "peer-stream" span in the
+        # load's trace (stage histogram: mm_stage_peer_stream_ms); chunk
+        # and byte counts land as attrs when the stream finishes.
+        with inst.tracer.span(
+            "peer-stream", model=model_id, sender=sender_iid,
+        ) as sp:
+            first = fetch(endpoint, model_id, 0, fp)
+            if not first.ok:
+                raise TransferUnavailable(sender_iid)
+            total = first.total_chunks
+            rx = {"bytes": len(first.payload)}
+            t0 = _time.perf_counter()
 
-        def chunks():
-            yield first.to_chunk()
-            for i in range(1, total):
-                r = fetch(endpoint, model_id, i, fp)
-                if not r.ok:
-                    raise TransferUnavailable(
-                        f"{sender_iid} lost the snapshot at chunk {i}/{total}"
-                    )
-                if r.fingerprint != first.fingerprint or (
-                    r.total_chunks != total
-                ):
-                    raise TransferUnavailable(
-                        f"{sender_iid} restarted the snapshot mid-stream"
-                    )
-                rx["bytes"] += len(r.payload)
-                yield r.to_chunk()
+            def chunks():
+                yield first.to_chunk()
+                for i in range(1, total):
+                    r = fetch(endpoint, model_id, i, fp)
+                    if not r.ok:
+                        raise TransferUnavailable(
+                            f"{sender_iid} lost the snapshot at chunk "
+                            f"{i}/{total}"
+                        )
+                    if r.fingerprint != first.fingerprint or (
+                        r.total_chunks != total
+                    ):
+                        raise TransferUnavailable(
+                            f"{sender_iid} restarted the snapshot mid-stream"
+                        )
+                    rx["bytes"] += len(r.payload)
+                    yield r.to_chunk()
 
-        loaded = inst.loader.load_from_stream(
-            model_id, info, chunks(), partial_ready=partial_cb,
-        )
+            loaded = inst.loader.load_from_stream(
+                model_id, info, chunks(), partial_ready=partial_cb,
+            )
+            sp["chunks"] = total
+            sp["bytes"] = rx["bytes"]
         self._record_transfer(
             model_id, MX.LOAD_FROM_PEER_COUNT, rx["bytes"],
             _time.perf_counter() - t0,

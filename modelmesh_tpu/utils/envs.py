@@ -210,6 +210,33 @@ REGISTRY: dict[str, EnvVar] = {
                "movement (price units) and best-overflow improvement "
                "(fraction of demand); 0/unset = fixed budget",
                "placement/jax_engine.py"),
+        EnvVar("MM_TRACE_CAPACITY", "int", "256",
+               "bounded ring of finished traces kept per instance "
+               "(retrievable via the ***TRACES*** diagnostic id)",
+               "observability/tracing.py"),
+        EnvVar("MM_TRACE_SAMPLE", "int", "32",
+               "head-sampling for MINTED trace roots: 1-in-N external "
+               "requests open a trace (1 = trace everything); adopted "
+               "mm-trace-id headers always record, so a sampled request "
+               "is traced end-to-end across every hop",
+               "serving/instance.py"),
+        EnvVar("MM_SLO_SPEC", "str",
+               "default:p99<250ms,availability>0.999",
+               "declarative per-model-class SLOs, ';'-separated classes: "
+               "class:obj,obj where obj is p50<Nms / p95<Nms / p99<Nms / "
+               "availability>F; class = model_type, 'default' catches "
+               "the rest (observability/slo.py grammar)",
+               "serving/instance.py"),
+        EnvVar("MM_SLO_WINDOW_MS", "int", "60000",
+               "sliding window over which SLO attainment / burn rate are "
+               "computed from request completions",
+               "observability/slo.py"),
+        EnvVar("MM_FLIGHTREC_EVENTS", "int", "4096",
+               "flight-recorder ring capacity (structured events: state "
+               "transitions, placement decisions, CAS outcomes, transfer "
+               "faults, drain phases); 0 disables recording; dump via "
+               "the ***FLIGHTREC*** diagnostic id",
+               "observability/flightrec.py"),
     ]
 }
 

@@ -47,3 +47,32 @@ class TestBenchServeSmoke:
         assert multi["select_legacy_copy_us"] > 0
         assert multi["select_speedup"] is not None
         assert multi["forwards_observed"] > 0
+
+        tr = out["tracing_overhead"]
+        assert tr["sample_n"] >= 1
+        assert tr["local_invoke_off_us"] > 0 and tr["local_invoke_on_us"] > 0
+        assert tr["route_forward_off_us"] > 0
+
+
+class TestTracingOverheadGate:
+    def test_hot_path_overhead_under_10_pct(self):
+        """The PR-2 hot-path numbers can't silently regress under
+        tracing: with the default head-sampled config, tracing ON costs
+        < 10% on both the local-invoke and route-select/forward paths.
+        Best-of-batches timing absorbs scheduler noise; one retry keeps
+        a loaded shared core from faking a regression (two independent
+        clean measurements can't both lie in the same direction)."""
+        import bench_serve
+
+        worst = None
+        for attempt in range(3):
+            o = bench_serve.tracing_overhead(
+                reps=2500 + 2500 * attempt, batches=5
+            )
+            worst = max(o["local_overhead_pct"], o["route_overhead_pct"])
+            if worst < 10.0:
+                break
+        assert worst < 10.0, (
+            f"tracing overhead {worst}% >= 10% with sampling "
+            f"1/{o['sample_n']}: {o}"
+        )

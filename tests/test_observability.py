@@ -172,6 +172,111 @@ class TestPrometheusMetrics:
         s.set_gauge(Metric.MODELS_LOADED, 1)
         s.close()
 
+    def test_stage_histograms_render(self):
+        """Per-stage latency decomposition: closed tracing spans export
+        into the stage histograms, which render as full Prometheus
+        histogram families (buckets/sum/count with HELP/TYPE)."""
+        from modelmesh_tpu.observability.tracing import Tracer
+
+        m = PrometheusMetrics(instance_id="iS", start_server=False)
+        tr = Tracer("iS", metrics=m, sample_n=1)
+        with tr.trace(model_id="m1"):
+            for name in ("route-select", "load-wait", "peer-stream",
+                         "runtime-call", "forward"):
+                with tr.span(name):
+                    pass
+        text = m.render()
+        for metric in ("mm_stage_route_select_ms", "mm_stage_load_wait_ms",
+                       "mm_stage_peer_stream_ms",
+                       "mm_stage_runtime_invoke_ms",
+                       "mm_stage_forward_hop_ms"):
+            assert f"# TYPE {metric} histogram" in text, metric
+            assert f'{metric}_count{{instance="iS"}} 1' in text, metric
+            assert f"{metric}_bucket" in text, metric
+
+    def test_stage_histograms_skip_untraced_spans(self):
+        from modelmesh_tpu.observability.tracing import Tracer
+
+        m = PrometheusMetrics(start_server=False)
+        tr = Tracer("iU", metrics=m, sample_n=1)
+        with tr.span("runtime-call"):  # no open trace: no-op
+            pass
+        assert "mm_stage_runtime_invoke_ms" not in m.render()
+
+    def test_labeled_gauges_render(self):
+        m = PrometheusMetrics(instance_id="iG", start_server=False)
+        m.set_gauge(Metric.SLO_ATTAINMENT, 0.995, label='slo_class="default"')
+        m.set_gauge(Metric.SLO_ATTAINMENT, 0.5, label='slo_class="llm"')
+        m.set_gauge(Metric.MODELS_LOADED, 3)
+        text = m.render()
+        assert ('mm_slo_attainment{instance="iG",slo_class="default"} '
+                "0.995") in text
+        assert 'mm_slo_attainment{instance="iG",slo_class="llm"} 0.5' in text
+        assert 'mm_models_loaded{instance="iG"} 3' in text
+        assert text.count("# TYPE mm_slo_attainment gauge") == 1
+
+
+class TestStatsDWireFormat:
+    """Format of emitted statsd lines, captured on a real UDP socket —
+    the backend previously had zero coverage."""
+
+    def _capture(self):
+        import socket
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        sock.settimeout(5.0)
+        return sock
+
+    def test_counter_gauge_and_histogram_as_timer(self):
+        sock = self._capture()
+        try:
+            s = StatsDMetrics(host="127.0.0.1", port=sock.getsockname()[1])
+            s.inc(Metric.LOAD_COUNT)
+            s.inc(Metric.API_REQUEST_COUNT, 2.0)
+            # Histograms map onto statsd TIMERS (|ms) — statsd has no
+            # native histogram type.
+            s.observe(Metric.LOAD_TIME, 12.5)
+            s.set_gauge(Metric.MODELS_LOADED, 7)
+            lines = [sock.recv(1024).decode() for _ in range(4)]
+            s.close()
+        finally:
+            sock.close()
+        assert lines[0] == "mm.mm_load_count:1.0|c"
+        assert lines[1] == "mm.mm_api_request_count:2.0|c"
+        assert lines[2] == "mm.mm_load_time_ms:12.5|ms"
+        assert lines[3] == "mm.mm_models_loaded:7|g"
+
+    def test_prefix_applied(self):
+        sock = self._capture()
+        try:
+            s = StatsDMetrics(host="127.0.0.1",
+                              port=sock.getsockname()[1], prefix="fleet")
+            s.inc(Metric.EVICT_COUNT)
+            line = sock.recv(1024).decode()
+            s.close()
+        finally:
+            sock.close()
+        assert line == "fleet.mm_evict_count:1.0|c"
+
+    def test_labeled_gauge_maps_to_name_suffix(self):
+        """StatsD has no labels: per-class SLO gauges become name
+        suffixes so classes never collapse into one flapping series."""
+        sock = self._capture()
+        try:
+            s = StatsDMetrics(host="127.0.0.1", port=sock.getsockname()[1])
+            s.set_gauge(Metric.SLO_ATTAINMENT, 0.99,
+                        label='slo_class="default"')
+            s.set_gauge(Metric.SLO_ATTAINMENT, 0.5, label='slo_class="llm"')
+            s.set_gauge(Metric.SLO_BURN_RATE, 2.0)
+            lines = [sock.recv(1024).decode() for _ in range(3)]
+            s.close()
+        finally:
+            sock.close()
+        assert lines[0] == "mm.mm_slo_attainment.default:0.99|g"
+        assert lines[1] == "mm.mm_slo_attainment.llm:0.5|g"
+        assert lines[2] == "mm.mm_slo_burn_rate:2.0|g"
+
 
 class TestPayloadProcessors:
     def _payload(self, model="m1", method="/p/Predict", kind="request"):
@@ -253,6 +358,16 @@ class TestMeshMetricsEndToEnd:
             assert 'mm_api_request_count{instance="i-obs"} 2.0' in body
             assert "mm_load_count" in body
             assert "mm_api_request_time_ms_count" in body
+            # The first external request mints a sampled trace; its
+            # runtime-call span feeds the stage decomposition, and the
+            # SLO tracker's windowed gauges export per class.
+            assert "mm_stage_runtime_invoke_ms_count" in body
+            inst.slo.export()
+            body2 = urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics.port}/metrics", timeout=5
+            ).read().decode()
+            assert 'mm_slo_attainment{instance="i-obs",slo_class=' in body2
+            assert 'mm_slo_burn_rate{instance="i-obs",slo_class=' in body2
             # request + response observed per call
             kinds = [p.kind for p in cap.seen]
             assert kinds.count("request") == 2 and kinds.count("response") == 2

@@ -46,6 +46,40 @@ def test_rolling_restart_replays_bit_for_bit():
     assert first.trace_lines() == second.trace_lines()
 
 
+def test_violated_slo_spec_fails_and_dumps_flight_recorder():
+    """Non-vacuity of the SLO invariant, both ways: the flash-crowd
+    scenario passes with its real spec (parametrized run above), and a
+    deliberately violated spec (p99<100ms against a crowd riding a 2 s
+    load) must FAIL the slo_attained invariant AND automatically attach
+    every pod's flight-recorder dump — including the state transitions
+    of the load the crowd rode — to the result."""
+    result = run_scenario(scenarios.slo_under_flash_crowd(p99_ms=100))
+    assert not result.ok
+    assert result.verdicts["slo_attained"], "tight spec passed — vacuous"
+    assert any("p99" in v for v in result.verdicts["slo_attained"])
+    assert result.flight_records, "invariant failure did not dump flightrec"
+    events = [e for evs in result.flight_records.values() for e in evs]
+    assert any(
+        e["kind"] == "state" and e.get("model") == scenarios._FLASH_MODEL
+        for e in events
+    ), "flight dump missing the flash model's lifecycle transitions"
+    rendered = result.render()
+    assert "flight recorder" in rendered
+
+
+def test_passing_scenario_attaches_no_flight_dump():
+    result = run_scenario(scenarios.slo_under_flash_crowd())
+    assert result.ok, result.render()
+    assert result.flight_records is None
+
+
+def test_slo_flash_crowd_replays_bit_for_bit():
+    first = run_scenario(scenarios.slo_under_flash_crowd())
+    second = run_scenario(scenarios.slo_under_flash_crowd())
+    assert first.ok, first.render()
+    assert first.trace_lines() == second.trace_lines()
+
+
 def test_late_eviction_quiesce_catches_reverted_fix():
     """With the quiesce's async-deregister drain reverted
     (quiesce_async=False — the pre-fix runner behavior), the held

@@ -1,0 +1,208 @@
+"""SLO attainment engine (observability/slo.py), flight recorder
+(observability/flightrec.py), and the sim TraceCollector — the three new
+pieces of the fleet-wide observability substrate."""
+
+import pytest
+
+from modelmesh_tpu.observability.flightrec import FlightRecorder
+from modelmesh_tpu.observability.slo import (
+    SloTracker,
+    parse_slo_spec,
+)
+
+
+class TestSloSpecGrammar:
+    def test_full_grammar(self):
+        spec = parse_slo_spec(
+            "default:p99<250ms,availability>0.999;"
+            "llm:p50<500ms,p95<1500ms,p99<4000ms;batch:availability>0.9"
+        )
+        assert set(spec) == {"default", "llm", "batch"}
+        assert spec["default"].p99_ms == 250.0
+        assert spec["default"].availability == 0.999
+        assert spec["llm"].p50_ms == 500.0 and spec["llm"].p95_ms == 1500.0
+        assert spec["llm"].availability is None
+        assert spec["batch"].p99_ms is None
+
+    def test_latency_bound_prefers_tightest_tail(self):
+        spec = parse_slo_spec("a:p50<100ms,p99<900ms")
+        assert spec["a"].latency_bound_ms == 900.0
+        assert spec["a"].good_target == pytest.approx(0.99)
+
+    @pytest.mark.parametrize("bad", [
+        "", "default", "default:", "default:p99<250", "default:p42<1ms",
+        "default:availability>2.5", "a:p99<1ms;a:p99<2ms", "a:junk",
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_slo_spec(bad)
+
+
+class TestSloTracker:
+    def _tracker(self, spec="default:p99<250ms,availability>0.999;slow:p99<5000ms"):
+        return SloTracker(spec=spec, window_ms=60_000)
+
+    def test_attained_within_objectives(self):
+        t = self._tracker()
+        for _ in range(100):
+            t.record("anything", 50.0, True)
+        snap = t.attainment()
+        assert snap.model_class == "default"
+        assert snap.requests == 100
+        assert snap.attained and not snap.violations
+        assert snap.good_fraction == 1.0
+        assert snap.burn_rate == 0.0
+
+    def test_p99_breach_detected(self):
+        t = self._tracker()
+        for i in range(100):
+            # 5 of 100 over the bound: empirical p99 (nearest-rank) lands
+            # on a slow sample -> breach.
+            t.record("m", 1000.0 if i % 20 == 0 else 10.0, True)
+        snap = t.attainment()
+        assert not snap.attained
+        assert any("p99" in v for v in snap.violations)
+        assert snap.burn_rate > 1.0
+
+    def test_availability_breach_detected(self):
+        t = self._tracker()
+        for i in range(200):
+            t.record("m", 10.0, ok=i % 50 != 0)  # 98% availability
+        snap = t.attainment()
+        assert any("availability" in v for v in snap.violations)
+
+    def test_class_resolution(self):
+        t = self._tracker()
+        t.record("slow", 3000.0, True)
+        t.record("other", 10.0, True)
+        assert t.attainment("slow").attained          # judged by slow spec
+        assert t.attainment("slow").requests == 1
+        assert t.attainment("other").model_class == "default"
+
+    def test_window_prunes_by_virtual_time(self):
+        from modelmesh_tpu.utils import clock as _clock
+
+        vc = _clock.VirtualClock()
+        with _clock.installed(vc):
+            t = self._tracker()
+            t.record("m", 9999.0, False)   # a terrible sample...
+            vc.advance(120_000)            # ...two windows ago
+            t.record("m", 10.0, True)
+            snap = t.attainment()
+        assert snap.requests == 1
+        assert snap.attained
+
+    def test_gauges_exported_per_class(self):
+        from modelmesh_tpu.observability.metrics import PrometheusMetrics
+
+        m = PrometheusMetrics(start_server=False)
+        t = SloTracker(
+            spec="default:p99<250ms;slow:p99<5000ms", metrics=m,
+        )
+        t.record("default", 10.0, True)
+        t.record("slow", 400.0, True)
+        t.export()
+        text = m.render()
+        assert 'mm_slo_attainment{slo_class="default"} 1.0' in text
+        assert 'mm_slo_attainment{slo_class="slow"} 1.0' in text
+        assert 'mm_slo_burn_rate{slo_class="default"} 0.0' in text
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_and_ordered(self):
+        fr = FlightRecorder(capacity=64, instance_id="i-f")
+        for i in range(500):
+            fr.record("tick", n=i)
+        events = fr.dump()
+        assert len(events) <= 64
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs)
+        assert events[-1]["n"] == 499
+        assert all(e["instance"] == "i-f" for e in events)
+
+    def test_dump_tail_is_most_recent(self):
+        fr = FlightRecorder(capacity=1024)
+        for i in range(100):
+            fr.record("ev", n=i)
+        tail = fr.dump(10)
+        assert [e["n"] for e in tail] == list(range(90, 100))
+
+    def test_zero_capacity_disables(self):
+        fr = FlightRecorder(capacity=0)
+        fr.record("ev", n=1)
+        assert not fr.enabled
+        assert fr.dump() == []
+
+    def test_virtual_timestamps(self):
+        from modelmesh_tpu.utils import clock as _clock
+
+        vc = _clock.VirtualClock()
+        with _clock.installed(vc):
+            fr = FlightRecorder(capacity=8)
+            fr.record("ev")
+            vc.advance(5_000)
+            fr.record("ev")
+            a, b = fr.dump()
+        assert b["ts_ms"] - a["ts_ms"] == 5_000
+        assert a["ts_ms"] >= _clock.VIRTUAL_EPOCH_MS
+
+    def test_entry_transitions_recorded(self):
+        """The CacheEntry funnel: every guarded transition lands a
+        structured 'state' event when a recorder is attached."""
+        from modelmesh_tpu.runtime.spi import LoadedModel, ModelInfo
+        from modelmesh_tpu.serving.entry import CacheEntry, EntryState
+
+        fr = FlightRecorder(capacity=32)
+        ce = CacheEntry("m-x", ModelInfo(model_type="t"))
+        ce.recorder = fr
+        ce.try_transition(EntryState.QUEUED)
+        ce.try_transition(EntryState.LOADING)
+        ce.complete_load(LoadedModel(handle="h", size_bytes=8))
+        ce.remove()
+        kinds = [(e["frm"], e["to"]) for e in fr.dump()]
+        assert kinds == [
+            ("new", "queued"), ("queued", "loading"),
+            ("loading", "active"), ("active", "removed"),
+        ]
+
+
+class TestTraceCollector:
+    def test_cross_instance_tree_assembly(self):
+        """Two tracers (as two pods), one trace id, hop linked by
+        parent span — the collector assembles a single tree."""
+        from modelmesh_tpu.observability.tracing import Tracer
+        from modelmesh_tpu.sim.tracing import TraceCollector
+
+        class _Pod:
+            def __init__(self, iid):
+                self.instance = type("I", (), {})()
+                self.instance.tracer = Tracer(iid, sample_n=1)
+
+        class _Cluster:
+            def __init__(self):
+                self.pods = [_Pod("sim-0"), _Pod("sim-1")]
+
+        cluster = _Cluster()
+        a = cluster.pods[0].instance.tracer
+        b = cluster.pods[1].instance.tracer
+        with a.trace("t-1", model_id="m", method="req"):
+            with a.span("route-select"):
+                pass
+            with a.span("forward"):
+                fwd_parent = Tracer.current_span_id()
+                with b.trace("t-1", model_id="m", method="req",
+                             parent_span=fwd_parent):
+                    with b.span("runtime-call"):
+                        pass
+        col = TraceCollector(cluster)
+        assert col.instances("t-1") == {"sim-0", "sim-1"}
+        assert {"route-select", "forward", "runtime-call"} <= col.span_names("t-1")
+        root = col.tree("t-1")
+        assert root is not None and root.instance == "sim-0"
+        names = [n.name for n in root.walk()]
+        assert "runtime-call" in names
+        # the forwarded record hangs under sim-0's forward span
+        fwd = next(n for n in root.walk() if n.name == "forward")
+        assert any(c.instance == "sim-1" for c in fwd.children)
+        assert col.depth("t-1") >= 4
+        assert col.tree("unknown") is None

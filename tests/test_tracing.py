@@ -9,7 +9,15 @@ import json
 
 import grpc
 
-from modelmesh_tpu.observability.tracing import TRACE_DUMP_ID, Tracer
+from modelmesh_tpu.observability.tracing import (
+    SPAN_HEADER,
+    TRACE_DUMP_ID,
+    TRACE_HEADER,
+    Tracer,
+    incoming_parent_span,
+    incoming_trace_id,
+    outgoing_headers,
+)
 from modelmesh_tpu.runtime import ModelInfo
 from modelmesh_tpu.runtime.fake import PREDICT_METHOD
 
@@ -48,6 +56,102 @@ class TestTracerUnit:
         with tr.trace("abc123") as tid:
             assert tid == "abc123"
         assert tr.recent()[0]["trace_id"] == "abc123"
+
+    def test_span_tree_ids_and_instance_attr(self):
+        """Spans carry span_id/parent_id/instance: nested spans chain to
+        the root record's span id — the tree the TraceCollector walks."""
+        tr = Tracer("i-tree")
+        with tr.trace(model_id="m"):
+            with tr.span("outer"):
+                with tr.span("inner"):
+                    pass
+        rec = tr.recent()[0]
+        assert rec["instance"] == "i-tree" and rec["span_id"]
+        by_name = {s["name"]: s for s in rec["spans"]}
+        # inner closed first but parents under outer; outer under root.
+        assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+        assert by_name["outer"]["parent_id"] == rec["span_id"]
+        assert all(s["instance"] == "i-tree" for s in rec["spans"])
+
+    def test_remote_parent_span_recorded(self):
+        tr = Tracer("i-b")
+        with tr.trace("tid-1", parent_span="i-a.xx.5"):
+            pass
+        assert tr.recent()[0]["parent_id"] == "i-a.xx.5"
+
+    def test_minted_roots_sampled_adopted_always_recorded(self):
+        tr = Tracer("i-s", sample_n=4)
+        for _ in range(8):
+            with tr.trace(model_id="m"):
+                pass
+        assert len(tr.recent(100)) == 2  # 1-in-4 minted roots
+        for k in range(3):
+            with tr.trace(f"adopted-{k}"):
+                pass
+        assert len(tr.recent(100)) == 5  # adopted ids never sampled out
+
+    def test_disabled_tracer_records_nothing(self):
+        tr = Tracer("i-d")
+        tr.enabled = False
+        with tr.trace("forced-id", model_id="m") as tid:
+            assert tid == "forced-id"
+            with tr.span("stage"):
+                pass
+        assert tr.recent() == []
+
+
+class TestClockAwareTracing:
+    def test_virtual_clock_durations_and_timestamps(self):
+        """The satellite fix made observable: under the sim's
+        VirtualClock a trace's span durations/timestamps are VIRTUAL —
+        advancing the clock 2.5 s (in microseconds of wall time) shows
+        as a 2500 ms span. The old time.time/perf_counter tracer would
+        report ~0 ms here."""
+        from modelmesh_tpu.utils import clock as _clock
+
+        vc = _clock.VirtualClock()
+        with _clock.installed(vc):
+            tr = Tracer("i-v")
+            with tr.trace(model_id="m"):
+                with tr.span("virtual-stage"):
+                    vc.advance(2_500)
+            rec = tr.recent()[0]
+        span = rec["spans"][0]
+        assert span["duration_ms"] == 2500.0
+        assert rec["duration_ms"] == 2500.0
+        assert rec["start_ms"] >= _clock.VIRTUAL_EPOCH_MS
+        assert span["start_ms"] >= _clock.VIRTUAL_EPOCH_MS
+
+
+class TestHeaderHelpers:
+    def test_incoming_helpers(self):
+        headers = [("x", "1"), (TRACE_HEADER, "t-9"), (SPAN_HEADER, "s-3")]
+        assert incoming_trace_id(headers) == "t-9"
+        assert incoming_parent_span(headers) == "s-3"
+        assert incoming_trace_id([("x", "1")]) == ""
+        assert incoming_parent_span([]) == ""
+
+    def test_outgoing_noop_without_open_trace(self):
+        h = [("a", "b")]
+        assert outgoing_headers(h) is h
+
+    def test_outgoing_attaches_trace_and_current_span(self):
+        tr = Tracer("i-o")
+        with tr.trace("t-out") as tid:
+            with tr.span("hop"):
+                out = outgoing_headers([("a", "b")])
+                assert (TRACE_HEADER, tid) in out
+                assert incoming_parent_span(out) == Tracer.current_span_id()
+
+    def test_outgoing_dedup_never_doubles_the_trace_header(self):
+        """A header list that already carries a trace id (e.g. replayed
+        forward headers) is returned untouched — no duplicate keys."""
+        tr = Tracer("i-o2")
+        with tr.trace("t-dup"):
+            h = [(TRACE_HEADER, "already-there")]
+            out = outgoing_headers(h)
+            assert out is h
+            assert sum(1 for k, _ in out if k == TRACE_HEADER) == 1
 
 
 class TestCrossInstancePropagation:
@@ -114,6 +218,32 @@ class TestCrossInstancePropagation:
             ch.close()
         finally:
             c.close()
+
+
+class TestRuntimeSpiPropagation:
+    def test_trace_id_rides_the_runtime_hop(self):
+        """The runtime-SPI hop (SidecarRuntime.call_model) attaches the
+        live trace context like every other mesh hop — previously the
+        sidecar call silently dropped it."""
+        from modelmesh_tpu.runtime.fake import start_fake_runtime
+        from modelmesh_tpu.runtime.sidecar import SidecarRuntime
+
+        server, port, servicer = start_fake_runtime()
+        sidecar = SidecarRuntime(f"127.0.0.1:{port}", startup_timeout_s=10)
+        try:
+            sidecar.load("rt-m", ModelInfo(model_type="example"))
+            tr = Tracer("i-rt")
+            with tr.trace("rt-trace-1"):
+                sidecar.call_model("rt-m", PREDICT_METHOD, b"x")
+            md = servicer.last_predict_metadata
+            assert md.get(TRACE_HEADER) == "rt-trace-1"
+            assert md.get(SPAN_HEADER)
+            # Untraced calls attach nothing.
+            sidecar.call_model("rt-m", PREDICT_METHOD, b"x")
+            assert TRACE_HEADER not in servicer.last_predict_metadata
+        finally:
+            sidecar.close()
+            server.stop(0)
 
 
 class TestLoadTimeoutStacks:

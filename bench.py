@@ -30,6 +30,13 @@ pipelined load fast path (serve-before-sizing, concurrent chained
 fan-out, batched promote+publish txn, coalesced publishes) vs the serial
 per-load baseline.
 
+MM_BENCH_SOLVER=1 measures the per-backend solver breakdown: dense vs
+sparse top-K device solve (pinned via MM_SOLVER_SPARSE so the auto rule
+cannot blur the comparison) and the incremental dirty-row re-solve vs a
+full warm solve under model-only churn, each with quality fields
+(overflow as a fraction of demand, Sinkhorn row_err) under the "solver"
+key — the BENCH_r*.json sparse-vs-dense trajectory.
+
 MM_BENCH_STEADY=1 measures the steady-state refresh fast path: one cold
 refresh, then a churn loop (~1% of models touched per cycle) driven
 through the pipelined refresher — delta snapshots (dirty tracking),
@@ -147,6 +154,42 @@ def _measure_e2e_refresh(n: int, m: int) -> dict:
 STEADY_UTILIZATION = 0.85
 
 
+def _steady_solve_config():
+    """Steady-mode gate defaults unless the operator pinned the knobs
+    via MM_SOLVER_* — including an explicit =0 pin, which means
+    "measure WITHOUT gates" and must not be confused with unset.
+    Empty-string matches the parser's unset semantics, so `VAR= cmd`
+    still gets the gate defaults; only a real value (incl. "0") pins.
+    Shared by the steady-refresh and solver-path benches so the pin
+    rule cannot fork between them."""
+    from modelmesh_tpu.placement.jax_engine import solve_config_from_env
+
+    cfg = solve_config_from_env()
+    if not os.environ.get("MM_SOLVER_SINKHORN_TOL"):
+        cfg = cfg._replace(sinkhorn_tol=0.02)
+    if not os.environ.get("MM_SOLVER_AUCTION_STALL_TOL"):
+        cfg = cfg._replace(auction_stall_tol=1e-3)
+    return cfg
+
+
+def _steady_fleet(n: int, m: int):
+    """Synthetic fleet at STEADY_UTILIZATION + seeded rpm — shared by
+    the steady-refresh and solver-path benches so their device_solve_ms
+    numbers stay comparable. Returns (models, instances, rpm, rng)."""
+    import numpy as np
+
+    from modelmesh_tpu.placement.synthetic import synthetic_records
+
+    models, instances = synthetic_records(n, m)
+    demand = sum(mr.size_units for _, mr in models)
+    cap = max(1, round(demand / (STEADY_UTILIZATION * m)))
+    for _, rec in instances:
+        rec.capacity_units = cap
+    rng = np.random.default_rng(0)
+    rpm = {f"m{i}": int(v) for i, v in enumerate(rng.integers(0, 50, n))}
+    return models, instances, rpm, rng
+
+
 def _measure_steady_refresh(n: int, m: int, cycles: int = 5) -> dict:
     """Cold-vs-warm e2e refresh under continuous small churn.
 
@@ -164,33 +207,12 @@ def _measure_steady_refresh(n: int, m: int, cycles: int = 5) -> dict:
 
     from modelmesh_tpu.cache.lru import now_ms
     from modelmesh_tpu.kv import InMemoryKV
-    from modelmesh_tpu.placement.jax_engine import (
-        JaxPlacementStrategy,
-        solve_config_from_env,
-    )
+    from modelmesh_tpu.placement.jax_engine import JaxPlacementStrategy
     from modelmesh_tpu.placement.plan_sync import PlanFollower, publish_plan
     from modelmesh_tpu.placement.refresh_loop import PipelinedRefresher
-    from modelmesh_tpu.placement.synthetic import synthetic_records
 
-    # Steady-mode defaults: enable the convergence gates unless the
-    # operator pinned them via MM_SOLVER_* (solve_config_from_env) —
-    # including an explicit =0 pin, which means "measure the steady loop
-    # WITHOUT gates" and must not be confused with unset.
-    cfg = solve_config_from_env()
-    # Empty-string matches the parser's unset semantics, so `VAR= cmd`
-    # still gets the gate defaults; only a real value (incl. "0") pins.
-    if not os.environ.get("MM_SOLVER_SINKHORN_TOL"):
-        cfg = cfg._replace(sinkhorn_tol=0.02)
-    if not os.environ.get("MM_SOLVER_AUCTION_STALL_TOL"):
-        cfg = cfg._replace(auction_stall_tol=1e-3)
-
-    models, instances = synthetic_records(n, m)
-    demand = sum(mr.size_units for _, mr in models)
-    cap = max(1, round(demand / (STEADY_UTILIZATION * m)))
-    for _, rec in instances:
-        rec.capacity_units = cap
-    rng = np.random.default_rng(0)
-    rpm = {f"m{i}": int(v) for i, v in enumerate(rng.integers(0, 50, n))}
+    cfg = _steady_solve_config()
+    models, instances, rpm, rng = _steady_fleet(n, m)
 
     # Compile warmup out of band (throwaway strategy, same shapes/config).
     # Two pipelined submits + drain: the second chains a device carry, so
@@ -285,6 +307,148 @@ def _measure_steady_refresh(n: int, m: int, cycles: int = 5) -> dict:
     finally:
         pf.close()
         kv.close()
+
+
+def _measure_solver_paths(n: int, m: int, cycles: int = 5) -> dict:
+    """Per-backend solve breakdown (MM_BENCH_SOLVER=1): dense vs sparse
+    device solve at the tier, and the incremental dirty-row re-solve vs
+    a full warm solve under model-only churn.
+
+    Each backend is measured through the SAME ``JaxPlacementStrategy``
+    refresh path the production leader runs (snapshot -> dispatch ->
+    finalize), pinned via MM_SOLVER_SPARSE so the auto rule cannot blur
+    the comparison. ``device_solve_ms`` is the refresh's solve stage
+    (``plan.stats['solve_ms']``) — the same number BENCH_r*.json has
+    always tracked — warm-median over ``cycles`` churn refreshes after
+    one cold compile refresh. Quality fields (overflow as a fraction of
+    demand, Sinkhorn row_err) ride along so the sparse-vs-dense
+    trajectory is auditable, not just its speed.
+    """
+    import numpy as np
+
+    from modelmesh_tpu.cache.lru import now_ms
+    from modelmesh_tpu.placement.jax_engine import JaxPlacementStrategy
+
+    models, instances, rpm, rng = _steady_fleet(n, m)
+    demand_units = float(sum(
+        (mr.size_units or 128) * min(max(mr.copy_count, 1), 8)
+        for _, mr in models
+    ))
+
+    def churn() -> list:
+        """Touch ~1% of models (model-ONLY: instance churn always takes
+        the full path by design — frozen column state)."""
+        k = max(1, n // 100)
+        dirty = []
+        now = now_ms()
+        for i in rng.integers(0, n, k):
+            mid, mr = models[int(i)]
+            mr.last_used = now
+            rpm[mid] = int(rng.integers(0, 50))
+            dirty.append(mid)
+        return dirty
+
+    def run_path(sparse_pin: str, incremental_frac: float):
+        """-> (median_warm_solve_ms, cold_solve_ms, last_stats, n_cycles)
+        for refreshes under the given MM_SOLVER_SPARSE pin; when
+        ``incremental_frac`` > 0 only cycles that actually took the
+        incremental path count."""
+        prev = os.environ.get("MM_SOLVER_SPARSE")
+        os.environ["MM_SOLVER_SPARSE"] = sparse_pin
+        try:
+            # Throwaway strategy absorbs the XLA compile; the measured
+            # strategy's cold refresh is then compiled-but-cold-carries —
+            # the number BENCH_r*.json device_solve_ms has always meant.
+            # One refresh suffices: the blocking refresh materializes
+            # carry arrays cold and warm alike, so both hit the same jit
+            # entry (verified: no compile spike in the first warm cycle).
+            # The incremental executable compiles lazily; its run drops
+            # the first counted cycle as compile (`primed`).
+            JaxPlacementStrategy(solve_config=_steady_solve_config()).refresh(
+                models, instances, rpm
+            )
+            strat = JaxPlacementStrategy(solve_config=_steady_solve_config())
+            strat.incr_max_dirty_frac = incremental_frac
+            cold = strat.refresh(models, instances, rpm)
+            want = "incremental" if incremental_frac > 0 else None
+            times, stats = [], dict(cold.stats)
+            primed = want is None  # the first incremental cycle compiles
+            # A quality-gate fallback cycle (overflow drift past the
+            # budget -> full re-solve re-freezes the base) is legitimate
+            # and contributes no sample; budget extra attempts so a
+            # sporadic breach cannot starve the measurement, and count
+            # the fallbacks so a persistent breach reads as the quality
+            # signal it is instead of a missing number.
+            budget = cycles if primed else 3 * cycles + 2
+            attempts = fell_back = 0
+            while attempts < budget and len(times) < cycles:
+                attempts += 1
+                strat.mark_dirty(churn(), [])
+                plan = strat.refresh(models, instances, rpm,
+                                     incremental=True)
+                if want is not None and plan.stats["solver_path"] != want:
+                    fell_back += 1
+                    continue
+                if not primed:
+                    primed = True  # drop the jit-compile cycle
+                    continue
+                times.append(plan.stats["solve_ms"])
+                stats = dict(plan.stats)
+            med = float(np.median(times)) if times else None
+            return med, cold.stats["solve_ms"], stats, len(times), fell_back
+        finally:
+            if prev is None:
+                os.environ.pop("MM_SOLVER_SPARSE", None)
+            else:
+                os.environ["MM_SOLVER_SPARSE"] = prev
+
+    def entry(med, cold_ms, stats, n_cycles, fallback_cycles=0):
+        out = {
+            "solver_path": stats.get("solver_path"),
+            "device_solve_ms": round(med, 2) if med is not None else None,
+            "cold_solve_ms": round(cold_ms, 1),
+            "cycles": n_cycles,
+            "topk": stats.get("topk", 0),
+            "overflow_frac": round(
+                stats.get("overflow", 0.0) / max(demand_units, 1e-9), 5
+            ),
+            "row_err": round(stats.get("row_err", 0.0), 5),
+        }
+        if "dirty_rows" in stats:
+            out["dirty_rows"] = stats["dirty_rows"]
+        if fallback_cycles:
+            out["fallback_cycles"] = fallback_cycles
+        return out
+
+    dense = entry(*run_path("0", 0.0))
+    sparse = entry(*run_path("1", 0.0))
+    # Incremental vs full-warm, both on the sparse-pinned strategy (the
+    # production shape: sparse full solves, incremental deltas between).
+    # The sparse entry above IS a full warm sparse solve — reuse it
+    # instead of paying the compile refresh + churn cycles twice.
+    full_warm = dict(sparse)
+    incr = entry(*run_path("1", 0.05))
+    result = {
+        "tier": f"{n}x{m}",
+        "paths": {
+            "dense": dense,
+            "sparse": sparse,
+            "full_warm": full_warm,
+            "incremental": incr,
+        },
+    }
+    if dense["device_solve_ms"] and sparse["device_solve_ms"]:
+        result["sparse_speedup"] = round(
+            dense["device_solve_ms"] / sparse["device_solve_ms"], 2
+        )
+        result["sparse_cold_speedup"] = round(
+            dense["cold_solve_ms"] / sparse["cold_solve_ms"], 2
+        )
+    if incr["device_solve_ms"] and full_warm["device_solve_ms"]:
+        result["incremental_speedup"] = round(
+            full_warm["device_solve_ms"] / incr["device_solve_ms"], 2
+        )
+    return result
 
 
 def main() -> None:
@@ -418,6 +582,23 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             print(
                 f"bench: lifecycle measurement failed: {e}", file=sys.stderr
+            )
+    # Per-backend solver breakdown (MM_BENCH_SOLVER=1): dense vs sparse
+    # device solve + incremental dirty-row vs full warm re-solve, with
+    # quality fields (overflow fraction, row_err) so BENCH_r*.json can
+    # track the sparse-vs-dense trajectory. Failure must not lose the
+    # kernel line.
+    if envs.get_int("MM_BENCH_SOLVER"):
+        if dev.platform == "cpu":
+            sv_n, sv_m = min(NUM_MODELS, 20_000), min(NUM_INSTANCES, 256)
+        else:
+            sv_n, sv_m = NUM_MODELS, NUM_INSTANCES
+        try:
+            result["solver"] = _measure_solver_paths(sv_n, sv_m)
+        except Exception as e:  # noqa: BLE001
+            print(
+                f"bench: solver path measurement failed: {e}",
+                file=sys.stderr,
             )
     # Steady-state refresh fast path: cold vs warm (pipelined + delta +
     # early exit) under churn. Failure must not lose the kernel line.

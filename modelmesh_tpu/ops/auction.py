@@ -77,13 +77,21 @@ def _finalize_topk(vals, idx, copies):
     return idx, valid
 
 
-def select_from_candidates(cand_vals, cand_idx, copies, price):
-    """Top-MAX_COPIES within a row's candidate shortlist at ``price``.
+def select_from_candidates(cand_vals, cand_idx, copies, price,
+                           sel_k: int = MAX_COPIES):
+    """Top-``sel_k`` within a row's candidate shortlist at ``price``,
+    padded to the MAX_COPIES output slots (``_finalize_topk``).
 
     ``cand_vals`` holds RAW scores (no price baked in) so the selection is
-    exact for any later price vector. Shared by both solvers."""
+    exact for any later price vector. Shared by the dense narrow rounds
+    AND the sparse top-K auction — the parity-critical epilogue must not
+    fork. ``sel_k`` < MAX_COPIES narrows the per-iteration top-k when the
+    problem's real max copy count allows it (the sparse dispatch layer
+    derives it from the snapshot; callers must keep ``sel_k >=
+    max(copies)`` or high-copy rows silently lose slots)."""
     eff = cand_vals - price[cand_idx]                    # [N, kc]
-    vals, pos = jax.lax.top_k(eff, min(MAX_COPIES, eff.shape[1]))
+    k = min(min(sel_k, MAX_COPIES), eff.shape[1])
+    vals, pos = jax.lax.top_k(eff, k)
     return _finalize_topk(
         vals, jnp.take_along_axis(cand_idx, pos, axis=1), copies
     )
@@ -219,27 +227,29 @@ def final_candidate(scores_minus_price, copies, final_select: str):
     return _select(scores_minus_price, copies)
 
 
-def warm_probe(scores_f32, p_init, copies, cap, final_select: str,
+def warm_probe(select_fn, p_init, cap,
                load_fn, eta_eff, stall_tol: float, total_demand):
-    """Single-step warm probe shared by ``auction`` and
-    ``parallel/sharded_solver._sharded_auction`` (parameterized by the
-    load reducer so the gate arithmetic — selection mode, overflow noise
-    floor, price-stall condition — cannot drift between the two).
+    """Single-step warm probe shared by ``auction``,
+    ``parallel/sharded_solver._sharded_auction`` and the sparse top-k
+    solver (parameterized by the selection and load callbacks so the gate
+    arithmetic — overflow noise floor, price-stall condition — cannot
+    drift between them).
 
-    One full-width selection (in the configured ``final_select`` mode,
-    so "approx" tiers never pay the exact top-k it exists to avoid) at
-    the carried prices, one price step. ``probe_ok`` certifies the
-    carry: the step stalled, or the overflow is already below the stall
-    noise floor (``stall_tol`` of total demand — the same threshold the
-    round loop treats as a non-improvement). ``load_fn`` is the plain
-    implied-load histogram on a single device and the psum'd one on a
-    mesh — with psum'd load/demand every probe scalar is replicated, so
-    all devices take the same cond branch. Returns
+    ``select_fn(price)`` is one epilogue-grade selection at that price:
+    full-width ``final_candidate`` for the dense solvers (in the
+    configured ``final_select`` mode, so "approx" tiers never pay the
+    exact top-k it exists to avoid), candidate-limited for the sparse
+    path. One selection at the carried prices, one price step.
+    ``probe_ok`` certifies the carry: the step stalled, or the overflow
+    is already below the stall noise floor (``stall_tol`` of total
+    demand — the same threshold the round loop treats as a
+    non-improvement). ``load_fn`` is the plain implied-load histogram on
+    a single device and the psum'd one on a mesh — with psum'd
+    load/demand every probe scalar is replicated, so all devices take
+    the same cond branch. Returns
     (idx_p, valid_p, load_p, of_p, p_probe, probe_ok)."""
     of_tol = stall_tol * jnp.maximum(total_demand, 1e-30)
-    idx_p, valid_p = final_candidate(
-        scores_f32 - p_init[None, :], copies, final_select
-    )
+    idx_p, valid_p = select_fn(p_init)
     load_p = load_fn(idx_p, valid_p)
     of_p = jnp.sum(jnp.maximum(load_p - cap, 0.0))
     p_probe = price_step(load_p, cap, p_init, eta_eff)
@@ -248,23 +258,20 @@ def warm_probe(scores_f32, p_init, copies, cap, final_select: str,
     return idx_p, valid_p, load_p, of_p, p_probe, probe_ok
 
 
-def hash_gumbel(
-    shape: tuple[int, int],
-    seed: jax.Array,
-    row_offset: jax.Array | int = 0,
+def hash_gumbel_at(
+    rows: jax.Array, cols: jax.Array, seed: jax.Array
 ) -> jax.Array:
-    """Counter-based Gumbel(0, 1) noise: murmur3-finalizer mixing of the
-    (global row, col, seed) counter, bitcast to uniform, double-log map.
+    """Gumbel(0, 1) at EXPLICIT (row, col) counter positions.
 
-    Statistically ample for de-herding top-k draws (the only consumer),
-    and much cheaper than threefry on a 1e8-element matrix. ``row_offset``
-    makes a sharded block's noise equal the corresponding rows of the
-    full-matrix draw — single-device and sharded solves see IDENTICAL
-    noise for the same seed, which threefry's fold_in cannot offer."""
-    rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0) + jnp.asarray(
-        row_offset, jnp.uint32
-    )
-    cols = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    The value is a pure function of (row, col, seed), so a gathered
+    evaluation at scattered column ids — the sparse top-k path, the
+    incremental dirty-row re-solve — reproduces ``hash_gumbel(shape)[i, j]``
+    bit-for-bit at every (i, j) it touches. That identity is what lets the
+    sparse/incremental solvers keep the dense path's frozen noise epoch:
+    re-selecting a row under the same seed sees the same draw regardless
+    of which solver evaluates it."""
+    rows = rows.astype(jnp.uint32)
+    cols = cols.astype(jnp.uint32)
 
     def fmix32(v):
         # murmur3 finalizer: full avalanche, pure VPU integer ops.
@@ -287,6 +294,26 @@ def hash_gumbel(
     u = (x >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
     u = jnp.maximum(u, 1e-7)
     return -jnp.log(-jnp.log(u))
+
+
+def hash_gumbel(
+    shape: tuple[int, int],
+    seed: jax.Array,
+    row_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Counter-based Gumbel(0, 1) noise: murmur3-finalizer mixing of the
+    (global row, col, seed) counter, bitcast to uniform, double-log map.
+
+    Statistically ample for de-herding top-k draws (the only consumer),
+    and much cheaper than threefry on a 1e8-element matrix. ``row_offset``
+    makes a sharded block's noise equal the corresponding rows of the
+    full-matrix draw — single-device and sharded solves see IDENTICAL
+    noise for the same seed, which threefry's fold_in cannot offer."""
+    rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0) + jnp.asarray(
+        row_offset, jnp.uint32
+    )
+    cols = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    return hash_gumbel_at(rows, cols, seed)
 
 
 def gumbel_perturb(
@@ -556,7 +583,10 @@ def auction(
     # (replacing the inf sentinel — the first round's improvement test
     # becomes real).
     idx_p, valid_p, load_p, of_p, p_probe, probe_ok = warm_probe(
-        scores_f32, p_init, copies, cap, final_select,
+        lambda p: final_candidate(
+            scores_f32 - p[None, :], copies, final_select
+        ),
+        p_init, cap,
         lambda i, v: _implied_load(i, v, sizes, num_instances, load_impl),
         eta * price_scale, stall_tol, total_demand,
     )

@@ -117,7 +117,11 @@ def assemble_cost(
     loaded managed models, i.e. actual instance fullness.
     """
     w = weights
-    loaded_mass = problem.loaded.astype(jnp.float32).T @ problem.sizes  # [M]
+    loaded_f = problem.loaded.astype(jnp.float32)
+    # sizes @ loaded: the same column sums as loaded.T @ sizes but as a
+    # row-streaming vec-mat product — the explicit transpose walked the
+    # [N, M] buffer column-major and cost ~35 ms alone at 20k x 256.
+    loaded_mass = problem.sizes @ loaded_f  # [M]
     used_frac = jnp.clip(
         (problem.reserved + loaded_mass) / jnp.maximum(problem.capacity, 1.0),
         0.0,
@@ -133,18 +137,80 @@ def assemble_cost(
     zone_onehot = jax.nn.one_hot(
         problem.zone, w.num_zones, dtype=jnp.float32
     )  # [M, Z]; out-of-range ids one-hot to all-zeros (no spread term)
-    copies_per_zone = problem.loaded.astype(jnp.float32) @ zone_onehot    # [N, Z]
+    copies_per_zone = loaded_f @ zone_onehot    # [N, Z]
     denom = jnp.maximum(jnp.sum(copies_per_zone, axis=1, keepdims=True), 1.0)
-    crowding = (copies_per_zone / denom) @ zone_onehot.T                  # [N, M]
+    # Gather the instance's zone column instead of a second one-hot
+    # matmul: each row of the matmul had exactly one non-zero term, so
+    # the gather is bit-identical and one [N, Z] x [Z, M] product cheaper.
+    # Out-of-range zone ids one-hot to all-zero columns above, so their
+    # (clamped) gather must be forced back to the matmul's 0.
+    crowding = jnp.where(
+        (problem.zone >= 0) & (problem.zone < w.num_zones),
+        (copies_per_zone / denom)[:, problem.zone],
+        0.0,
+    )  # [N, M]
 
     per_instance = w.utilization * used_frac - w.lru_age * age  # [M]
     cost = (
-        w.move * (1.0 - problem.loaded.astype(jnp.float32))
+        w.move * (1.0 - loaded_f)
         + per_instance[None, :]
         + w.balance * rate[:, None] * busy[None, :]
         + w.zone_spread * crowding
         + w.preference * (1.0 - problem.preferred.astype(jnp.float32))
         + INFEASIBLE * (1.0 - problem.feasible.astype(jnp.float32))
+    )
+    return cost.astype(dtype)
+
+
+def assemble_cost_rows(
+    problem: PlacementProblem,
+    rows: jax.Array,
+    weights: CostWeights = CostWeights(),
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> jax.Array:
+    """Cost matrix for a ROW SUBSET: ``assemble_cost(...)[rows]`` without
+    materializing the full [N, M] result — the incremental dirty-row
+    re-solve's assembly stage (ops/sparse.py).
+
+    Every normalization statistic (rate min/max, busyness/age norms, the
+    per-column loaded mass) is computed over the FULL problem, exactly as
+    the dense assembly does — normalizing over the subset would make a
+    dirty row's cost depend on which OTHER rows happen to be dirty, and
+    the re-solved rows must price against the same cost surface the base
+    solve used. Pinned against ``assemble_cost`` by the parity test.
+    ``rows`` must be in-range; callers clamp padded sentinels first.
+    """
+    w = weights
+    loaded_mass = problem.sizes @ problem.loaded.astype(jnp.float32)  # [M]
+    used_frac = jnp.clip(
+        (problem.reserved + loaded_mass) / jnp.maximum(problem.capacity, 1.0),
+        0.0,
+        1.5,
+    )
+    busy = _minmax_norm(problem.busyness)
+    age = _minmax_norm(problem.lru_age)
+    rate = _minmax_norm(problem.rates)[rows]                      # [D]
+
+    loaded_d = problem.loaded[rows].astype(jnp.float32)           # [D, M]
+    zone_onehot = jax.nn.one_hot(
+        problem.zone, w.num_zones, dtype=jnp.float32
+    )
+    copies_per_zone = loaded_d @ zone_onehot                      # [D, Z]
+    denom = jnp.maximum(jnp.sum(copies_per_zone, axis=1, keepdims=True), 1.0)
+    crowding = jnp.where(
+        (problem.zone >= 0) & (problem.zone < w.num_zones),
+        (copies_per_zone / denom)[:, problem.zone],
+        0.0,
+    )  # [D, M]
+
+    per_instance = w.utilization * used_frac - w.lru_age * age
+    cost = (
+        w.move * (1.0 - loaded_d)
+        + per_instance[None, :]
+        + w.balance * rate[:, None] * busy[None, :]
+        + w.zone_spread * crowding
+        + w.preference * (1.0 - problem.preferred[rows].astype(jnp.float32))
+        + INFEASIBLE * (1.0 - problem.feasible[rows].astype(jnp.float32))
     )
     return cost.astype(dtype)
 

@@ -63,7 +63,35 @@ class SolveConfig(NamedTuple):
     # Epilogue competitor to the best price iterate: "exact" full top-k,
     # "approx" approx_max_k, "none" best-iterate only.
     final_select: str = "exact"
+    # Sparse top-K candidate width: 0 solves dense; > 0 routes through
+    # ops/sparse.py (one cost pass + top-k gather, then K-wide Sinkhorn
+    # rows and a fixed-candidate auction). Exact whenever every row has
+    # <= topk feasible instances; otherwise an approximation of terms
+    # that underflow to ~0 anyway. Requires noise_impl="hash" when
+    # tau > 0 (the positional draw is what keeps sparse/dense noise
+    # identical). The dispatch layer (placement/jax_engine.dispatch_solve)
+    # sets this from problem shape + MM_SOLVER_SPARSE / MM_SOLVER_TOPK.
+    topk: int = 0
+    # Sparse-path per-iteration selection width: 0 = MAX_COPIES. The
+    # dispatch layer narrows it to the snapshot's real max copy count
+    # (bucketed to a power of two so the jit-entry set stays tiny) —
+    # top-8-of-K every price iteration is the single biggest line in the
+    # sparse profile, and a fleet whose hottest model wants 3 copies
+    # never needs more than a top-4. MUST be >= the problem's max copy
+    # count or high-copy rows silently lose slots; ignored by the dense
+    # path (whose narrow rounds are already K_CAND-bounded).
+    sel_width: int = 0
     dtype: jnp.dtype = jnp.bfloat16
+    # When the dispatch layer routes a solve sparse, knobs the operator
+    # left at their dense defaults (auction_iters, auction_stall_tol,
+    # sinkhorn_tol — judged by value + the MM_SOLVER_* env registry) are
+    # swapped for the sparse-tier defaults. A programmatic caller whose
+    # explicitly-constructed config deliberately wants those exact dense
+    # values (e.g. auction_stall_tol=0.0 for a fixed, reproducible
+    # iteration budget) sets tier_defaults=False to forbid the rewrite —
+    # value-equality alone cannot distinguish "chose the default" from
+    # "left unset".
+    tier_defaults: bool = True
 
 
 class Placement(NamedTuple):
@@ -105,6 +133,14 @@ def _solve_placement_impl(
     seed: jax.Array | int,
     init: SolveInit | None,
 ) -> Placement:
+    if config.topk > 0 and config.topk < problem.num_instances:
+        # Sparse top-K pipeline (ops/sparse.py): same Placement pytree,
+        # same warm carries, same convergence gates — config is static,
+        # so each width compiles its own executable exactly like any
+        # other config change.
+        from modelmesh_tpu.ops.sparse import solve_sparse
+
+        return solve_sparse(problem, config, seed, init)
     C = costs_mod.assemble_cost(problem, weights=config.weights, dtype=config.dtype)
     # Clamp copies to what rounding can actually place, BEFORE building the
     # transport marginals — otherwise the prior reserves phantom capacity.
@@ -177,3 +213,29 @@ solve_placement_donated = partial(
     static_argnames=("config",),
     donate_argnames=("init",),
 )(_solve_placement_impl)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def solve_placement_incremental(
+    problem: costs_mod.PlacementProblem,
+    config: SolveConfig,
+    seed: jax.Array | int,
+    dirty_rows: jax.Array,      # i32[D] row ids, padded with >= N sentinel
+    base_indices: jax.Array,    # i32[N, MAX_COPIES] previous assignment
+    base_valid: jax.Array,      # bool[N, MAX_COPIES]
+    g0: jax.Array,              # f32[M] frozen column potentials
+    price0: jax.Array,          # f32[M] frozen congestion prices
+    base_row_err: jax.Array,    # f32[] frozen Sinkhorn diagnostic
+) -> Placement:
+    """Incremental dirty-row re-solve (ops/sparse.py): only the rows in
+    ``dirty_rows`` are re-selected, against the FROZEN column potentials
+    and prices of the base solve, and merged into the base assignment.
+    ``seed`` must be the base solve's (frozen-epoch) seed so the
+    positional noise draw matches — the dispatch layer enforces that,
+    plus the dirty-fraction and overflow fallback gates."""
+    from modelmesh_tpu.ops.sparse import resolve_dirty_rows
+
+    return resolve_dirty_rows(
+        problem, config, seed, dirty_rows, base_indices, base_valid,
+        g0, price0, base_row_err,
+    )

@@ -1,0 +1,543 @@
+"""Sparse top-K placement solve + incremental dirty-row re-solve.
+
+The dense pipeline (ops/solve.py) touches the full [N, M] cost matrix ~20
+times per solve (Sinkhorn row/col LSEs per iteration, plan logits, the
+auction's full-width shortlists and epilogue). Most models can only
+plausibly land on a few dozen instances — feasibility masks, zone
+affinity, capacity — so almost all of that width is spent summing terms
+that underflow to exactly 0. This module exploits that (AutoShard,
+PAPERS.md, is the cost-model-guided-sparsification precedent):
+
+1. **Candidate shortlist** (``topk_candidates``): ONE pass over the
+   assembled cost matrix gathers the top-K cheapest instances per model
+   into ``[N, K]`` cost/index/feasibility columns (K = SolveConfig.topk,
+   env-tunable via MM_SOLVER_TOPK). The selection key is the cost plus a
+   dedicated Gumbel draw at candidate-selection scale (``GATHER_TAU``):
+   without it near-identical rows all shortlist the SAME cheap columns
+   and the un-gathered majority of the fleet becomes unreachable —
+   measured 35% rounding overflow at 20k x 256 vs 0.007% with the noise.
+   Infeasible pairs carry the additive INFEASIBLE penalty, which drowns
+   the noise, so feasible candidates always sort first and the gather
+   contains EVERY feasible instance whenever a row has <= K of them —
+   the regime where the sparse solve is exact.
+2. **Sparse Sinkhorn** (``sparse_sinkhorn``): iterations run in the
+   scaled-kernel form over a masked kernel matrix
+   ``P = exp((rowmin - C) / eps) * mask`` precomputed ONCE — each
+   iteration is two exp-free matvecs (``P @ v`` and ``u @ P``) instead
+   of two full log-sum-exp passes, and the column "scatter-add back to
+   [M]" is the ``u @ P`` product (scatter-free: XLA CPU/TPU scatter-adds
+   with duplicate indices serialize — the same reason the auction's
+   implied-load has a fused path). Row shifts (``rowmin``) keep the
+   kernel in f32 range, and the f/g updates are algebraically identical
+   to ops/sinkhorn.py's log-domain ones, so potentials match the dense
+   solver to float rounding. Entries outside the mask are treated as
+   infeasible, which is exact whenever K covers every feasible instance
+   of a row and an approximation (of terms that were ~0 anyway)
+   otherwise.
+3. **Sparse auction** (``sparse_auction``): the gathered columns ARE the
+   candidate shortlist, held fixed across price rounds (the dense
+   narrow-round machinery re-shortlists from full width; here raw scores
+   are already gathered so selection is exact at any price within the
+   candidates). ``sel_k`` optionally narrows the per-iteration top-k to
+   the problem's real max copy count (the dispatch layer derives it from
+   the snapshot — top-8-of-K every price iteration is the single biggest
+   line in the sparse profile). Convergence gates, best-iterate tracking
+   and the warm probe are the shared ops.auction helpers.
+4. **Incremental re-solve** (``resolve_dirty_rows``): re-selects ONLY
+   the dirty rows the delta-snapshot path already tracks, against the
+   frozen column potentials and prices of the last full solve, then
+   merges them into the previous assignment and recomputes the exact
+   load/overflow. O(D·M) instead of O(iters·N·M).
+
+Rounding noise is the positional ``hash_gumbel_at`` draw — a pure
+function of (row, col, seed) — so gathered, sharded, incremental and
+dense evaluations of the same (row, col) see the SAME draw and
+``Placement`` stays bit-compatible with the dense path when K covers the
+feasible set (pinned by tests/test_sparse_solver.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from modelmesh_tpu.ops import costs as costs_mod
+from modelmesh_tpu.ops.auction import (
+    MAX_COPIES,
+    RESHORTLIST_EVERY,
+    _NEG_INF,
+    _implied_load,
+    _stall_gated_rounds,
+    check_rounding_config,
+    final_candidate,
+    hash_gumbel_at,
+    price_step,
+    resolve_load_impl,
+    select_from_candidates,
+    warm_probe,
+)
+from modelmesh_tpu.ops.sinkhorn import SinkhornResult, gated_sinkhorn_loop
+
+# Gumbel scale for the candidate-selection draw (cost units; the cost
+# terms are O(1)-scaled, so 0.5 spreads near-tied rows across the fleet
+# without letting a genuinely-cheaper instance lose its slot). Distinct
+# salt so the draw is independent of the rounding noise at the same
+# (row, col, seed) counter.
+GATHER_TAU: float = 0.5
+_GATHER_SALT = 0x9E3779B9
+
+# Numerical floor shared by the scaled-kernel iterations (matches the
+# log-domain solver's log clamp).
+_TINY = 1e-30
+
+
+def topk_candidates(
+    C: jax.Array,
+    feasible: jax.Array,
+    k: int,
+    seed: jax.Array | None = None,
+    gather_tau: float = GATHER_TAU,
+    row_offset: jax.Array | int = 0,
+):
+    """Gather each row's K cheapest instances from the assembled cost.
+
+    Returns ``(cost_k, idx_k, feas_k, mask)``: costs in C's dtype (the
+    sparse Sinkhorn upcasts exactly like the dense one), i32 column ids,
+    the gathered feasibility mask, and a full-width ``bool[N, M]`` mask of
+    every entry at-or-under the row's K-th selection key (the kernel mask
+    ``sparse_sinkhorn`` consumes — a tie-inclusive superset of the
+    gathered columns, computable without a scatter).
+
+    Selection is by noisy cost (``gather_tau`` Gumbel at a salted
+    counter; ``seed=None`` or ``gather_tau=0`` disables) so near-tied
+    rows de-herd across the fleet. The INFEASIBLE penalty in C drowns the
+    noise, so feasible candidates always outrank infeasible ones and
+    whenever a row has <= K feasible instances the gather contains ALL of
+    them — the sparse solve is exact for that row. ``row_offset`` shifts
+    the noise counter for a model-axis shard so a sharded gather equals
+    the corresponding rows of the single-device one.
+    """
+    k = min(k, C.shape[1])
+    key = C.astype(jnp.float32)
+    if seed is not None and gather_tau > 0:
+        rows = jax.lax.broadcasted_iota(
+            jnp.uint32, C.shape, 0
+        ) + jnp.asarray(row_offset, jnp.uint32)
+        cols = jax.lax.broadcasted_iota(jnp.uint32, C.shape, 1)
+        salted = jnp.asarray(seed, jnp.uint32) ^ jnp.uint32(_GATHER_SALT)
+        key = key - gather_tau * hash_gumbel_at(rows, cols, salted)
+    neg_vals, idx = jax.lax.top_k(-key, k)
+    idx = idx.astype(jnp.int32)
+    # K-th selection key via min(), NOT neg_vals[:, -1:]: slicing a
+    # top_k output defeats XLA CPU's sort->TopK custom-call rewrite (the
+    # extra slice merges into the sort's k-window slice and the pattern
+    # no longer matches), silently falling back to a full O(M log M)
+    # variadic sort — measured 1.3 s vs 150 ms for this exact gather at
+    # 20k x 256. min() over the (descending) values is bit-identical.
+    mask = key <= -jnp.min(neg_vals, axis=1, keepdims=True)
+    return (
+        jnp.take_along_axis(C, idx, axis=1),
+        idx,
+        jnp.take_along_axis(feasible, idx, axis=1),
+        mask,
+    )
+
+
+def sparse_sinkhorn(
+    C: jax.Array,            # [N, M] assembled cost (bf16 ok)
+    mask: jax.Array,         # bool[N, M] candidate mask (topk_candidates)
+    row_mass: jax.Array,     # f32[N]
+    col_mass: jax.Array,     # f32[M] FULL-width capacity caps
+    *,
+    eps: float,
+    iters: int,
+    g0: jax.Array | None = None,
+    tol: float = 0.0,
+    chunk: int = 4,
+    col_psum=None,
+    dg_reduce=None,
+) -> SinkhornResult:
+    """Semi-unbalanced Sinkhorn over the masked candidate set (rows
+    equalities, columns CAPS via g <= 0 — must match ops/sinkhorn.py; the
+    sparse parity test compares potentials).
+
+    Scaled-kernel iterations: ``P = exp((rowmin - C) / eps) * mask`` is
+    built once (row-shifted into f32 range; masked-out entries are exact
+    zeros, i.e. treated as infeasible), then each iteration is
+
+        v = exp(g / eps);  r = P @ v
+        f = eps * (log a - log r) + rowmin          # row update
+        u = a / r                                   # == exp((f-rowmin)/eps)
+        g = min(0, eps * (log b - log(u @ P)))      # column update
+
+    — algebraically the log-domain updates with the exp factored out of
+    the inner loops, and ``u @ P`` standing in for the column scatter-add
+    (exact, scatter-free). ``col_psum`` sums the per-shard column
+    products (and the marginal-error sums) across a model-axis mesh —
+    None on a single device; ``dg_reduce`` replicates the warm-probe
+    scalar as in ``gated_sinkhorn_loop``. Columns nobody gathered get the
+    empty-sum floor, which lands their potential at the g = 0 cap —
+    exactly where a zero-demand column sits in the dense solve.
+    """
+    row_mass = row_mass.astype(jnp.float32)
+    col_mass = col_mass.astype(jnp.float32)
+    log_a = jnp.log(jnp.maximum(row_mass, _TINY))
+    log_b = jnp.log(jnp.maximum(col_mass, _TINY))
+    Cf = C.astype(jnp.float32)
+    rowmin = jnp.min(jnp.where(mask, Cf, jnp.inf), axis=1)  # finite: >=K masked
+    P = jnp.where(mask, jnp.exp((rowmin[:, None] - Cf) / eps), 0.0)
+
+    def row_terms(g):
+        v = jnp.exp(g / eps)
+        r = jnp.maximum(P @ v, _TINY)
+        return r
+
+    def body(carry, _):
+        _f, g = carry
+        r = row_terms(g)
+        f = eps * (log_a - jnp.log(r)) + rowmin
+        u = row_mass / r                       # exp((f - rowmin) / eps)
+        c = u @ P
+        if col_psum is not None:
+            c = col_psum(c)
+        g = jnp.minimum(0.0, eps * (log_b - jnp.log(jnp.maximum(c, _TINY))))
+        return (f, g), None
+
+    def run_iters(f, g, length):
+        (f, g), _ = jax.lax.scan(body, (f, g), None, length=length)
+        return f, g
+
+    def marginal_err(f, g):
+        # sum/sum == the dense path's mean/mean relative-L1 diagnostic;
+        # written as sums so the sharded combine is a plain psum pair.
+        row_sum = jnp.exp((f - rowmin) / eps) * row_terms(g)
+        num = jnp.sum(jnp.abs(row_sum - row_mass))
+        den = jnp.sum(row_mass)
+        if col_psum is not None:
+            # Row-mass sums live on the model axis; reuse the column
+            # combiner (it is the same psum over the model axis).
+            num, den = col_psum(num), col_psum(den)
+        return num / jnp.maximum(den, _TINY)
+
+    f_init = jnp.zeros_like(log_a)
+    g_init = (
+        jnp.minimum(0.0, g0.astype(jnp.float32))  # g <= 0 invariant
+        if g0 is not None else jnp.zeros_like(log_b)
+    )
+    if tol <= 0.0 or chunk <= 0 or iters <= 0:
+        f, g = run_iters(f_init, g_init, iters)
+        return SinkhornResult(
+            f=f, g=g, row_err=marginal_err(f, g),
+            iters_run=jnp.asarray(iters, jnp.int32),
+        )
+    f, g, row_err, iters_run = gated_sinkhorn_loop(
+        run_iters, marginal_err, f_init, g_init,
+        eps=eps, iters=iters, tol=tol, chunk=chunk, dg_reduce=dg_reduce,
+    )
+    return SinkhornResult(f=f, g=g, row_err=row_err, iters_run=iters_run)
+
+
+def sparse_auction(
+    scores_k: jax.Array,    # f32[N, K] noised+masked plan logits (gathered)
+    idx_k: jax.Array,       # i32[N, K]
+    sizes: jax.Array,       # f32[N]
+    copies: jax.Array,      # i32[N]
+    capacity: jax.Array,    # f32[M] full-width caps
+    *,
+    iters: int,
+    eta: float,
+    load_impl: str = "auto",
+    final_select: str = "exact",
+    stall_tol: float = 0.0,
+    price0: jax.Array | None = None,
+    sel_k: int = MAX_COPIES,
+    axis_psum=None,
+):
+    """Price repair over a FIXED candidate set — the dense auction's
+    narrow rounds minus the re-shortlisting (the top-K gather already
+    holds raw scores, so selection is exact at any price within the
+    candidates; spill outside them is what the overflow diagnostic and
+    the dispatch-layer quality gates watch). Gates, best-iterate
+    tracking and the warm probe are the shared ops.auction helpers so
+    the convergence semantics cannot fork from the dense solvers.
+
+    ``axis_psum`` sums per-shard load/demand across a model-axis mesh
+    (None on a single device) — with it every gate scalar is replicated
+    and all devices branch identically. Returns the
+    ``(idx, valid, load, prices, overflow, iters_run)`` tuple shared
+    with ``parallel/sharded_solver._sharded_auction``.
+    """
+    num_instances = capacity.shape[0]
+    cap = jnp.maximum(capacity.astype(jnp.float32), 1e-6)
+    copies = jnp.minimum(copies, MAX_COPIES)
+    load_impl = resolve_load_impl(load_impl)
+    n = scores_k.shape[0]
+
+    nsel = min(sel_k, MAX_COPIES)
+
+    def implied_load(idx, valid):
+        # Slots past sel_k are _finalize_topk padding (never valid):
+        # skip them so the per-iteration histogram scatters sel_k
+        # entries per row, not MAX_COPIES.
+        local = _implied_load(
+            idx[:, :nsel], valid[:, :nsel], sizes, num_instances, load_impl
+        )
+        return axis_psum(local) if axis_psum is not None else local
+
+    def select(price):
+        # The gathered columns ARE the candidate shortlist: the dense
+        # narrow rounds' selection epilogue applies verbatim.
+        return select_from_candidates(scores_k, idx_k, copies, price, nsel)
+
+    def narrow_round(carry, length):
+        def body(carry, _):
+            price, bp, bi, bv, bl, bo = carry
+            idx, valid = select(price)
+            load = implied_load(idx, valid)
+            of = jnp.sum(jnp.maximum(load - cap, 0.0))
+            better = of < bo
+            # Best-iterate SELECTION prices — the warm-start carry, same
+            # as ops.auction (last-iterate prices are mid-cobweb).
+            bp = jnp.where(better, price, bp)
+            bi = jnp.where(better, idx, bi)
+            bv = jnp.where(better, valid, bv)
+            bl = jnp.where(better, load, bl)
+            bo = jnp.minimum(of, bo)
+            return (
+                price_step(load, cap, price, eta), bp, bi, bv, bl, bo,
+            ), None
+
+        carry, _ = jax.lax.scan(body, carry, None, length=length)
+        return carry
+
+    p_init = (
+        jnp.maximum(price0.astype(jnp.float32), 0.0)  # price >= 0 invariant
+        if price0 is not None
+        else jnp.zeros((num_instances,), jnp.float32)
+    )
+
+    def epilogue(carry, iters_run):
+        price, best_price, best_idx, best_valid, best_load, best_of = carry
+        if final_select == "none":
+            return (best_idx, best_valid, best_load, best_price, best_of,
+                    iters_run)
+        idx_l, valid_l = select(price)
+        load_l = implied_load(idx_l, valid_l)
+        of_l = jnp.sum(jnp.maximum(load_l - cap, 0.0))
+        use_last = of_l <= best_of
+        idx = jnp.where(use_last, idx_l, best_idx)
+        valid = jnp.where(use_last, valid_l, best_valid)
+        load = jnp.where(use_last, load_l, best_load)
+        overflow = jnp.minimum(of_l, best_of)
+        return (idx, valid, load, jnp.where(use_last, price, best_price),
+                overflow, iters_run)
+
+    carry = (
+        p_init,
+        p_init,
+        jnp.zeros((n, MAX_COPIES), jnp.int32),
+        jnp.zeros((n, MAX_COPIES), bool),
+        jnp.zeros((num_instances,), jnp.float32),
+        jnp.asarray(jnp.inf, jnp.float32),
+    )
+    if stall_tol <= 0.0:
+        for length in [RESHORTLIST_EVERY] * (iters // RESHORTLIST_EVERY) + (
+            [iters % RESHORTLIST_EVERY] if iters % RESHORTLIST_EVERY else []
+        ):
+            carry = narrow_round(carry, length)
+        return epilogue(carry, jnp.asarray(iters, jnp.int32))
+
+    total_demand = jnp.sum(sizes * copies.astype(jnp.float32))
+    if axis_psum is not None:
+        total_demand = axis_psum(total_demand)
+    if final_select == "none":
+        # Mirror ops.auction: "none" keeps epilogue-grade selections out
+        # of the loop — gate the rounds only.
+        carry2, iters_run = _stall_gated_rounds(
+            narrow_round, carry, iters, stall_tol, total_demand,
+        )
+        return epilogue(carry2, iters_run)
+
+    idx_p, valid_p, load_p, of_p, p_probe, probe_ok = warm_probe(
+        select, p_init, cap, implied_load, eta, stall_tol, total_demand,
+    )
+
+    def _probe_exit(_):
+        return (idx_p, valid_p, load_p, p_probe, of_p,
+                jnp.asarray(1, jnp.int32))
+
+    def _rounds(_):
+        seeded = (p_probe, p_init, idx_p, valid_p, load_p, of_p)
+        carry2, iters_run = _stall_gated_rounds(
+            narrow_round, seeded, iters, stall_tol, total_demand,
+        )
+        return epilogue(carry2, iters_run + 1)
+
+    return jax.lax.cond(probe_ok, _probe_exit, _rounds, None)
+
+
+def check_sparse_config(config) -> None:
+    """Trace-time validation shared by the single-device and sharded
+    sparse entry points."""
+    check_rounding_config(
+        config.noise_impl, config.final_select, config.auction_iters
+    )
+    if config.tau > 0 and config.noise_impl != "hash":
+        # The positional draw is what keeps gathered/incremental noise
+        # identical to the dense draw; threefry cannot be evaluated at
+        # scattered (row, col) positions without materializing the full
+        # matrix the sparse path exists to avoid.
+        raise ValueError(
+            "sparse solve requires noise_impl='hash' "
+            f"(got {config.noise_impl!r})"
+        )
+    if config.sel_width and not 0 < config.sel_width <= MAX_COPIES:
+        raise ValueError(
+            f"sel_width={config.sel_width} (expected 1..{MAX_COPIES}, "
+            "or 0 for the MAX_COPIES default)"
+        )
+
+
+def perturb_gathered(
+    logits_k: jax.Array, idx_k: jax.Array, feas_k: jax.Array,
+    tau: float, seed: jax.Array, row_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Noise + feasibility mask for gathered plan logits — the sparse
+    twin of ops.auction's perturb-then-mask prologue. ``row_offset``
+    shifts row ids for a model-axis shard so the draw equals the
+    single-device one bit-for-bit."""
+    scores = logits_k.astype(jnp.float32)
+    if tau > 0:
+        rows = jax.lax.broadcasted_iota(
+            jnp.uint32, idx_k.shape, 0
+        ) + jnp.asarray(row_offset, jnp.uint32)
+        scores = scores + tau * hash_gumbel_at(rows, idx_k, seed)
+    return jnp.where(feas_k, scores, _NEG_INF)
+
+
+def solve_sparse(problem, config, seed, init):
+    """Sparse-pipeline twin of ops.solve._solve_placement_impl: cost ->
+    top-K gather -> sparse Sinkhorn -> sparse auction. Same Placement
+    pytree (f/g/prices full-width, so SolveInit warm carries and the
+    donated steady-state entry work unchanged)."""
+    from modelmesh_tpu.ops.solve import Placement
+
+    check_sparse_config(config)
+    seed = jnp.asarray(seed, jnp.uint32)
+    C = costs_mod.assemble_cost(
+        problem, weights=config.weights, dtype=config.dtype
+    )
+    cost_k, idx_k, feas_k, mask = topk_candidates(
+        C, problem.feasible, config.topk, seed=seed
+    )
+    copies = jnp.minimum(problem.copies, MAX_COPIES)
+    row_mass = problem.sizes * copies.astype(jnp.float32)
+    free = jnp.maximum(problem.capacity - problem.reserved, 0.0)
+    sk = sparse_sinkhorn(
+        C, mask, row_mass, free,
+        eps=config.eps, iters=config.sinkhorn_iters,
+        g0=None if init is None else init.g0,
+        tol=config.sinkhorn_tol, chunk=config.sinkhorn_chunk,
+    )
+    # Per-element arithmetic (and the dtype quantization) match
+    # ops.sinkhorn.plan_logits so gathered scores equal the dense ones.
+    logits_k = (
+        (sk.f[:, None] + sk.g[idx_k] - cost_k.astype(jnp.float32))
+        / config.eps
+    ).astype(config.dtype)
+    scores_k = perturb_gathered(
+        logits_k, idx_k, feas_k, config.tau, seed
+    )
+    idx, valid, load, prices, overflow, au_iters = sparse_auction(
+        scores_k, idx_k, problem.sizes, copies, free,
+        iters=config.auction_iters, eta=config.eta,
+        load_impl=config.load_impl, final_select=config.final_select,
+        stall_tol=config.auction_stall_tol,
+        price0=None if init is None else init.price0,
+        sel_k=config.sel_width or MAX_COPIES,
+    )
+    return Placement(
+        indices=idx, valid=valid, load=load, overflow=overflow,
+        row_err=sk.row_err, f=sk.f, g=sk.g, prices=prices,
+        sinkhorn_iters_run=sk.iters_run, auction_iters_run=au_iters,
+    )
+
+
+def resolve_dirty_rows(
+    problem, config, seed, dirty_rows, base_indices, base_valid,
+    g0, price0, base_row_err,
+):
+    """Incremental re-solve: new assignments for the dirty rows only,
+    merged into the previous solve's placement.
+
+    The column state (Sinkhorn potentials ``g0``, congestion prices
+    ``price0``) is FROZEN from the base solve — re-solving a small dirty
+    fraction cannot move the fleet-wide equilibrium materially, and the
+    dispatch layer falls back to a full solve when the dirty fraction or
+    the resulting overflow says otherwise. Each dirty row gets: an exact
+    row potential against the frozen g (one [D, M] row LSE — rows are
+    transport equalities, so f is exact given g), plan logits quantized
+    like the dense path, the SAME positional noise draw as the base
+    solve (the frozen epoch seed must be passed in), and an exact
+    full-width selection at the frozen prices. The merged load/overflow
+    are recomputed exactly over the whole assignment (O(N·MAX_COPIES)
+    scatter, not O(N·M)).
+
+    ``dirty_rows`` is host-padded with an out-of-range sentinel
+    (>= base_indices row count): padded entries gather a clamped row but
+    ``copies = 0`` voids their selection and the merge scatter drops
+    them. ``base_row_err`` rides through as the (frozen) Sinkhorn
+    diagnostic."""
+    from modelmesh_tpu.ops.solve import Placement
+
+    check_sparse_config(config)
+    n = problem.num_models
+    m = problem.num_instances
+    rows = jnp.clip(dirty_rows, 0, n - 1)
+    pad = dirty_rows >= n
+    C_d = costs_mod.assemble_cost_rows(
+        problem, rows, weights=config.weights, dtype=config.dtype
+    )
+    Cf = C_d.astype(jnp.float32)
+    copies_d = jnp.where(
+        pad, 0, jnp.minimum(problem.copies[rows], MAX_COPIES)
+    )
+    row_mass_d = problem.sizes[rows] * copies_d.astype(jnp.float32)
+    g = jnp.minimum(0.0, g0.astype(jnp.float32))
+    prices = jnp.maximum(price0.astype(jnp.float32), 0.0)
+    lse = jax.nn.logsumexp((g[None, :] - Cf) / config.eps, axis=1)
+    f_d = config.eps * (
+        jnp.log(jnp.maximum(row_mass_d, _TINY)) - lse
+    )
+    logits_d = (
+        (f_d[:, None] + g[None, :] - Cf) / config.eps
+    ).astype(config.dtype)
+    scores = logits_d.astype(jnp.float32)
+    if config.tau > 0:
+        cols = jax.lax.broadcasted_iota(jnp.uint32, Cf.shape, 1)
+        rows_mat = jnp.broadcast_to(
+            rows[:, None].astype(jnp.uint32), Cf.shape
+        )
+        scores = scores + config.tau * hash_gumbel_at(
+            rows_mat, cols, jnp.asarray(seed, jnp.uint32)
+        )
+    scores = jnp.where(problem.feasible[rows], scores, _NEG_INF)
+    idx_d, valid_d = final_candidate(
+        scores - prices[None, :], copies_d, "exact"
+    )
+    indices = base_indices.at[dirty_rows].set(idx_d, mode="drop")
+    valid = base_valid.at[dirty_rows].set(valid_d, mode="drop")
+    load = _implied_load(
+        indices, valid, problem.sizes, m,
+        resolve_load_impl(config.load_impl),
+    )
+    free = jnp.maximum(problem.capacity - problem.reserved, 0.0)
+    overflow = jnp.sum(
+        jnp.maximum(load - jnp.maximum(free, 1e-6), 0.0)
+    )
+    zero = jnp.asarray(0, jnp.int32)
+    return Placement(
+        indices=indices, valid=valid, load=load, overflow=overflow,
+        row_err=base_row_err, f=None, g=g0, prices=price0,
+        sinkhorn_iters_run=zero, auction_iters_run=zero,
+    )

@@ -59,9 +59,10 @@ def _norm_sharded(x: jax.Array, axis_name: str) -> jax.Array:
 def _cost_block(p: PlacementProblem, w: CostWeights, dtype) -> jax.Array:
     """Cost matrix block from row-sharded model state + col-sharded instance
     state. Mirrors ops.costs.assemble_cost with sharded reductions."""
+    loaded_f = p.loaded.astype(jnp.float32)
     loaded_mass = jax.lax.psum(
-        p.loaded.astype(jnp.float32).T @ p.sizes, MODEL_AXIS
-    )  # [m_blk]
+        p.sizes @ loaded_f, MODEL_AXIS
+    )  # [m_blk] (sizes @ loaded == loaded.T @ sizes, minus the transpose)
     used_frac = jnp.clip(
         (p.reserved + loaded_mass) / jnp.maximum(p.capacity, 1.0), 0.0, 1.5
     )
@@ -71,14 +72,20 @@ def _cost_block(p: PlacementProblem, w: CostWeights, dtype) -> jax.Array:
 
     zone_onehot = jax.nn.one_hot(p.zone, w.num_zones, dtype=jnp.float32)
     cpz = jax.lax.psum(
-        p.loaded.astype(jnp.float32) @ zone_onehot, INSTANCE_AXIS
+        loaded_f @ zone_onehot, INSTANCE_AXIS
     )  # [n_blk, Z] full-width zone counts
     denom = jnp.maximum(jnp.sum(cpz, axis=1, keepdims=True), 1.0)
-    crowding = (cpz / denom) @ zone_onehot.T
+    # One-element gather of the instance's zone column (bit-identical to
+    # the one-hot matmul it replaces — see ops.costs.assemble_cost).
+    crowding = jnp.where(
+        (p.zone >= 0) & (p.zone < w.num_zones),
+        (cpz / denom)[:, p.zone],
+        0.0,
+    )
 
     per_instance = w.utilization * used_frac - w.lru_age * age
     cost = (
-        w.move * (1.0 - p.loaded.astype(jnp.float32))
+        w.move * (1.0 - loaded_f)
         + per_instance[None, :]
         + w.balance * rate[:, None] * busy[None, :]
         + w.zone_spread * crowding
@@ -291,7 +298,10 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
     # model axis, so every probe scalar is replicated and all devices
     # take the same cond branch.
     idx_p, valid_p, load_p, of_p, p_probe, probe_ok = warm_probe(
-        scores_full, p_init, copies, cap, final_select,
+        lambda p: final_candidate(
+            scores_full - p[None, :], copies, final_select
+        ),
+        p_init, cap,
         implied_load, eta, stall_tol, total_demand,
     )
 
@@ -309,10 +319,105 @@ def _sharded_auction(scores_full, sizes, copies, cap_full, iters: int,
     return jax.lax.cond(probe_ok, _probe_exit, _rounds, None)
 
 
-def _solve_kernel(
+def _sparse_solve_kernel(
     p: PlacementProblem, seed: jax.Array, g0: jax.Array, price0: jax.Array,
     config: SolveConfig, weights: CostWeights,
 ):
+    """Sparse top-K pipeline on the mesh (ops/sparse.py kernels).
+
+    Rows stay sharded on ``mdl``; the per-shard cost block is
+    all-gathered to full instance width (a no-op on the default
+    1-column mesh) so the top-K gather sees whole rows with GLOBAL
+    column ids — the same ids, costs, and positional noise the
+    single-device gather sees for those rows, so the candidate sets are
+    identical. Column reductions (the sparse Sinkhorn's ``u @ P``
+    product, the auction's implied load, the gate scalars) psum over the
+    model axis, after which every device holds replicated full-width
+    column state and takes identical gate branches; the g/price outputs
+    are sliced back to this shard's ``inst`` block to ride the same
+    output specs as the dense kernel.
+    """
+    from modelmesh_tpu.ops.sparse import (
+        perturb_gathered,
+        sparse_auction,
+        sparse_sinkhorn,
+        topk_candidates,
+    )
+
+    C_full = jax.lax.all_gather(
+        _cost_block(p, weights, config.dtype), INSTANCE_AXIS, axis=1,
+        tiled=True,
+    )  # [n_blk, M]
+    feas_full = jax.lax.all_gather(
+        p.feasible, INSTANCE_AXIS, axis=1, tiled=True
+    )
+    n_blk = C_full.shape[0]
+    row_off = (jax.lax.axis_index(MODEL_AXIS) * n_blk).astype(jnp.uint32)
+    cost_k, idx_k, feas_k, mask = topk_candidates(
+        C_full, feas_full, config.topk, seed=seed, row_offset=row_off
+    )
+    copies = jnp.minimum(p.copies, MAX_COPIES)
+    row_mass = p.sizes * copies.astype(jnp.float32)
+    free = jnp.maximum(p.capacity - p.reserved, 0.0)
+    free_full = jax.lax.all_gather(free, INSTANCE_AXIS, axis=0, tiled=True)
+    g0_full = jax.lax.all_gather(g0, INSTANCE_AXIS, axis=0, tiled=True)
+    price0_full = jax.lax.all_gather(
+        price0, INSTANCE_AXIS, axis=0, tiled=True
+    )
+    col_psum = lambda x: jax.lax.psum(x, MODEL_AXIS)  # noqa: E731
+    sk = sparse_sinkhorn(
+        C_full, mask, row_mass, free_full,
+        eps=config.eps, iters=config.sinkhorn_iters, g0=g0_full,
+        tol=config.sinkhorn_tol, chunk=config.sinkhorn_chunk,
+        col_psum=col_psum,
+        dg_reduce=lambda dg: jax.lax.pmax(dg, MODEL_AXIS),
+    )
+    logits_k = (
+        (sk.f[:, None] + sk.g[idx_k] - cost_k.astype(jnp.float32))
+        / config.eps
+    ).astype(config.dtype)
+    scores_k = perturb_gathered(
+        logits_k, idx_k, feas_k, config.tau, seed, row_offset=row_off
+    )
+    idx, valid, load, price, overflow, au_iters = sparse_auction(
+        scores_k, idx_k, p.sizes, copies, free_full,
+        iters=config.auction_iters, eta=config.eta,
+        load_impl=config.load_impl, final_select=config.final_select,
+        stall_tol=config.auction_stall_tol, price0=price0_full,
+        sel_k=config.sel_width or MAX_COPIES, axis_psum=col_psum,
+    )
+    # g and prices are full-width and identical on every device; slice
+    # this shard's block so the outputs ride the ``inst``-sharded specs.
+    m_blk = free.shape[0]
+    blk = jax.lax.axis_index(INSTANCE_AXIS) * m_blk
+    return Placement(
+        indices=idx, valid=valid, load=load, overflow=overflow,
+        row_err=sk.row_err, f=sk.f,
+        g=jax.lax.dynamic_slice_in_dim(sk.g, blk, m_blk),
+        prices=jax.lax.dynamic_slice_in_dim(price, blk, m_blk),
+        sinkhorn_iters_run=sk.iters_run, auction_iters_run=au_iters,
+    )
+
+
+def _solve_kernel(
+    p: PlacementProblem, seed: jax.Array, g0: jax.Array, price0: jax.Array,
+    config: SolveConfig, weights: CostWeights, n_inst: int = 1,
+):
+    # Same gate as solve_placement's ``topk < num_instances``: a K that
+    # covers the full (global, not per-shard) padded width runs the
+    # dense kernel, so the identical config takes the identical path on
+    # and off the mesh — the two pipelines only agree to float rounding,
+    # and path divergence would fork placements between a leader with a
+    # mesh and a single-device solve of the same snapshot.
+    if 0 < config.topk < p.capacity.shape[0] * n_inst:
+        from modelmesh_tpu.ops.sparse import check_sparse_config
+
+        # Trace-time, like solve_sparse: the sparse-only constraints
+        # (hash noise, sel_width) apply only when this branch is taken —
+        # a full-width topk legitimately runs dense, where e.g. threefry
+        # noise is fine, exactly as ops.solve_placement accepts it.
+        check_sparse_config(config)
+        return _sparse_solve_kernel(p, seed, g0, price0, config, weights)
     C = _cost_block(p, weights, config.dtype)
     copies = jnp.minimum(p.copies, MAX_COPIES)
     row_mass = p.sizes * copies.astype(jnp.float32)
@@ -389,6 +494,10 @@ def make_sharded_solver(
     length by ``inst``; outputs: indices/valid sharded on ``mdl``, load
     replicated.
     """
+    # Rounding knobs are route-independent; sparse-only constraints are
+    # validated at trace time inside the kernel's sparse branch, because
+    # the route depends on the PROBLEM width (topk < global padded
+    # count) which build time cannot know.
     check_rounding_config(
         config.noise_impl, config.final_select, config.auction_iters
     )
@@ -400,7 +509,8 @@ def make_sharded_solver(
         f=row, g=col, prices=col,
         sinkhorn_iters_run=P(), auction_iters_run=P(),
     )
-    kernel = partial(_solve_kernel, config=config, weights=weights)
+    kernel = partial(_solve_kernel, config=config, weights=weights,
+                     n_inst=mesh.shape[INSTANCE_AXIS])
     shmapped = mesh_mod.shard_map(
         lambda prob, seed, g0, price0: kernel(prob, seed, g0, price0),
         mesh=mesh,

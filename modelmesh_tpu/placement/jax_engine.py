@@ -19,6 +19,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import OrderedDict
 from collections.abc import Mapping
 from typing import Callable, NamedTuple, Optional, Sequence, Union
 
@@ -479,39 +480,201 @@ def _assemble(sizes, copies, rates, rows, ccols, type_idx, req_masks,
     )
 
 
-_assemble_jits: dict = {}  # keyed by mesh (None = default device)
+# Bounded jit-entry caches. Every distinct (mesh, config) used to leak a
+# compiled executable for the process lifetime — a long-lived leader that
+# cycles through solver configs (sparse widths, gate tunings, transient
+# meshes) accumulated dead XLA programs without bound. An LRU of depth
+# _JIT_CACHE_CAP keeps the steady-state entries hot (production uses one
+# or two) while letting churned ones be collected with their executables.
+_JIT_CACHE_CAP = 8
+_jit_cache_lock = mm_lock("jax_engine._jit_cache_lock")
+# keyed by mesh (None = default device)
+_assemble_jits: "OrderedDict" = OrderedDict()  #: guarded-by: _jit_cache_lock
+_sharded_solvers: "OrderedDict" = OrderedDict()  #: guarded-by: _jit_cache_lock
+
+
+def _cache_get_or_build(cache: "OrderedDict", key, build):
+    """LRU lookup shared by the jit-entry caches. The build runs OUTSIDE
+    the lock (jit wrapping is cheap but make_sharded_solver traces
+    nothing either — still, never hold a registered lock across anything
+    that could reach a compile); the brief double-build race just makes
+    one extra uncompiled wrapper that loses the insert."""
+    with _jit_cache_lock:
+        fn = cache.get(key)
+        if fn is not None:
+            cache.move_to_end(key)
+            return fn
+    fn = build()
+    with _jit_cache_lock:
+        won = cache.setdefault(key, fn)
+        cache.move_to_end(key)
+        while len(cache) > _JIT_CACHE_CAP:
+            cache.popitem(last=False)
+    return won
 
 
 def _ensure_assemble_jit(mesh=None):
-    fn = _assemble_jits.get(mesh)
-    if fn is None:
+    def build():
         import jax
 
         if mesh is None:
-            fn = jax.jit(_assemble)
-        else:
-            from modelmesh_tpu.parallel.mesh import problem_shardings
+            return jax.jit(_assemble)
+        from modelmesh_tpu.parallel.mesh import problem_shardings
 
-            fn = jax.jit(_assemble, out_shardings=problem_shardings(mesh))
-        _assemble_jits[mesh] = fn
-    return fn
+        return jax.jit(_assemble, out_shardings=problem_shardings(mesh))
 
-
-_sharded_solvers: dict = {}
+    return _cache_get_or_build(_assemble_jits, mesh, build)
 
 
 def _solver_for(mesh, config=None):
     """jitted sharded solver per (mesh, config) (rebuilding would
     recompile)."""
-    key = (mesh, config)
-    solver = _sharded_solvers.get(key)
-    if solver is None:
+
+    def build():
         from modelmesh_tpu.parallel.sharded_solver import make_sharded_solver
 
-        solver = _sharded_solvers[key] = make_sharded_solver(
+        return make_sharded_solver(
             mesh, *(() if config is None else (config,))
         )
-    return solver
+
+    return _cache_get_or_build(_sharded_solvers, (mesh, config), build)
+
+
+# Sparse-dispatch policy (ROADMAP item 1: top-k-sparsified cost columns).
+# Default candidate width when MM_SOLVER_TOPK is unset, and the auto
+# rule's floor: the sparse path pays one full-width cost pass + top-k
+# gather up front, so it only wins when the padded instance count is
+# several times the candidate width. 24 measured both faster AND
+# tighter-rounding than 32 at the 20k x 256 / 85%-utilization tier
+# (0.32% vs 0.44% overflow of demand) — candidate quality saturates
+# well before K reaches the fleet's plausible-placement width.
+SPARSE_TOPK_DEFAULT = 24
+SPARSE_AUTO_MIN_INSTANCES = 192
+
+# Quality gate for the incremental dirty-row path: a merged re-solve
+# whose rounding overflow DRIFTS more than this fraction of demand past
+# the base full solve's own overflow triggers a full re-solve (the
+# frozen column potentials/prices no longer price the fleet honestly).
+# Same magnitude as the sparse path's dense-parity overflow budget.
+INCREMENTAL_OVERFLOW_FRAC = 0.005
+
+# Traffic drift that re-selects a CLEAN row on the incremental path: a
+# row whose rate moved by more than this fraction of the base solve's
+# hottest rate since the base froze is treated as dirty (a 30x spike on
+# a warm model clears it; rpm jitter on cold models — whose balance
+# cost term is negligible either way — does not). The dirty-frac
+# ceiling then bounds the expanded set like any other churn.
+RATE_DRIFT_FRAC = 0.2
+
+
+def _resolve_sparse_config(config, m_pad: int, max_copies: int):
+    """Pick dense vs sparse for this dispatch and finalize the config.
+
+    Returns ``(config, sparse)``. The decision: an explicit
+    ``config.topk`` (or MM_SOLVER_SPARSE=1 pin) forces sparse,
+    MM_SOLVER_SPARSE=0 forces dense, and the default ("auto") goes
+    sparse when the padded instance count clears both
+    SPARSE_AUTO_MIN_INSTANCES and 4x the candidate width — below that
+    the up-front full-width gather costs more than the width it saves.
+    Sparse mode also requires the positional "hash" noise (the draw the
+    gathered kernels can evaluate at scattered columns).
+
+    A sparse dispatch narrows ``sel_width`` to the snapshot's real max
+    copy count (bucketed to 2/4/8 so the jit-entry set stays tiny) and,
+    for knobs the operator did NOT pin (``SolveConfig.tier_defaults=False``
+    forbids these rewrites — a programmatic config's deliberate
+    dense-default values are indistinguishable by value), swaps in the
+    sparse-tier defaults: a ``auction_iters=8`` budget under the stall gate and the
+    steady-state Sinkhorn tolerance — with exact in-candidate selection
+    the price loop converges in one round where the dense solver needs
+    five (measured 0.19% residual overflow at 20k x 256 vs the 0.5%
+    dense-parity budget; docs/performance.md has the table).
+    """
+    from modelmesh_tpu.ops.solve import SolveConfig
+    from modelmesh_tpu.utils import envs
+
+    def _densified(c):
+        # The dispatch decided dense: strip a caller-set topk so the
+        # backends — solve_placement's own topk gate and the sharded
+        # kernel's — cannot route sparse anyway and diverge from the
+        # solver_path this dispatch reports.
+        if c is not None and c.topk > 0:
+            return c._replace(topk=0)
+        return c
+
+    cfg = SolveConfig() if config is None else config
+    pin = (envs.get("MM_SOLVER_SPARSE") or "auto").strip().lower()
+    if pin in ("0", "false", "no", "off"):
+        return _densified(config), False
+    topk = cfg.topk
+    if topk <= 0:
+        raw = envs.get("MM_SOLVER_TOPK")
+        topk = int(raw) if raw not in (None, "") else SPARSE_TOPK_DEFAULT
+    forced = pin in ("1", "true", "yes", "on") or cfg.topk > 0
+    auto_ok = (
+        m_pad >= SPARSE_AUTO_MIN_INSTANCES and m_pad >= 4 * topk
+    )
+    if not (forced or auto_ok) or topk >= m_pad:
+        return _densified(config), False
+    if cfg.tau > 0 and cfg.noise_impl != "hash":
+        # threefry pin: sparse cannot match the draw
+        return _densified(config), False
+    if cfg.sel_width <= 0:
+        sel = 2 if max_copies <= 2 else (4 if max_copies <= 4 else 8)
+        cfg = cfg._replace(sel_width=sel)
+    overrides = {"topk": topk}
+    # "Did the operator pin it" is judged by value-equals-default + the
+    # env registry; a programmatic config that DELIBERATELY wants the
+    # dense-default gate values opts out via tier_defaults=False
+    # (SolveConfig) — value equality alone cannot tell the two apart.
+    if cfg.tier_defaults:
+        if cfg.auction_iters == 40 and not envs.get(
+            "MM_SOLVER_AUCTION_ITERS"
+        ):
+            overrides["auction_iters"] = 8
+        if cfg.auction_stall_tol == 0.0 and not envs.get(
+            "MM_SOLVER_AUCTION_STALL_TOL"
+        ):
+            overrides["auction_stall_tol"] = 1e-3
+        if cfg.sinkhorn_tol == 0.0 and not envs.get(
+            "MM_SOLVER_SINKHORN_TOL"
+        ):
+            overrides["sinkhorn_tol"] = 0.02
+    return cfg._replace(**overrides), True
+
+
+class SolveBase(NamedTuple):
+    """Frozen state of the last full solve, the incremental dirty-row
+    path's merge target (device arrays, padded shapes). ``seed`` is the
+    noise epoch the base was solved under — the incremental re-solve is
+    only valid while the strategy's frozen epoch still matches (the
+    carried prices, potentials and the Gumbel draw are a matched
+    triple)."""
+
+    indices: object      # i32[n_pad, MAX_COPIES]
+    valid: object        # bool[n_pad, MAX_COPIES]
+    g: object            # f32[m_pad] frozen column potentials
+    prices: object       # f32[m_pad] frozen congestion prices
+    row_err: object      # f32[] frozen Sinkhorn diagnostic
+    seed: int
+    # The FULL solve's rounding overflow (host float): the incremental
+    # quality gate bounds the DRIFT a merged re-solve adds on top of
+    # this, not the absolute overflow — a loaded fleet legitimately
+    # carries ~0.5% residual overflow even on a clean full solve, and an
+    # absolute bar would make the incremental path unreachable exactly
+    # where it matters. Frozen at the full solve (NOT advanced by
+    # successful increments), so cumulative drift since the last full
+    # solve stays bounded by the gate.
+    overflow: float = 0.0
+    # f32[n] host copy of the rates column the full solve ranked under.
+    # rpm is re-read for EVERY record on each delta patch (traffic
+    # shifts don't touch records, so rpm staleness cannot be
+    # dirty-tracked) — the balance cost term moves without any dirty
+    # mark. Clean rows whose rate drifted materially since this freeze
+    # are re-selected as if dirty (RATE_DRIFT_FRAC); like the overflow
+    # reference, frozen at the full solve so persistent drift keeps
+    # re-selecting (or trips the ceiling) until a full solve re-freezes.
+    rates: object = None
 
 
 def solve_config_from_env():
@@ -831,6 +994,12 @@ class PendingSolve(NamedTuple):
     t_snapshot: float    # perf_counter when the host snapshot was done
     t_dispatch: float    # perf_counter when the solve was enqueued
     warm: bool
+    # Which backend the dispatch picked: dense | sparse | sharded |
+    # sharded-sparse | incremental (observable in plan.stats and the
+    # bench JSON tail).
+    path: str = "dense"
+    topk: int = 0
+    dirty_rows: Optional[int] = None  # rows re-solved (incremental only)
 
 
 def dispatch_solve(
@@ -844,12 +1013,33 @@ def dispatch_solve(
     donate: bool = False,
     t_start: Optional[float] = None,
     t_snapshot: Optional[float] = None,
+    base: Optional[SolveBase] = None,
+    dirty_rows=None,
 ) -> PendingSolve:
     """Expand ``cols`` on device and enqueue the solve WITHOUT blocking.
 
     JAX dispatch is asynchronous: the returned PendingSolve's arrays are
     futures, and the host can immediately go build the next snapshot while
     the device works — ``finalize_plan`` collects the result.
+
+    This is the solver dispatch layer (ROADMAP item 1): one common
+    signature over four backends, picked from problem shape, mesh, and
+    the MM_SOLVER_* env pins —
+
+    - **dense** single-device (ops/solve.py) — small fleets;
+    - **sparse** top-K (ops/sparse.py) — auto above
+      SPARSE_AUTO_MIN_INSTANCES padded columns, or MM_SOLVER_SPARSE /
+      MM_SOLVER_TOPK pins (``_resolve_sparse_config``);
+    - **sharded** across a device mesh (parallel/sharded_solver.py),
+      composing with sparse (the mesh kernel gathers top-K per shard);
+    - **incremental** dirty-row re-solve: when ``base`` (the last full
+      solve's frozen state) and ``dirty_rows`` (row ids into
+      ``cols.model_ids``) are given, only those rows are re-selected
+      against the frozen column potentials/prices and merged into the
+      base assignment. Callers gate on dirty fraction and noise-epoch
+      match (``JaxPlacementStrategy.refresh``) and must check the
+      merged overflow against INCREMENTAL_OVERFLOW_FRAC after
+      finalizing. Single-device only (``mesh=None``).
 
     Warm-start carries, in order of preference: ``carry`` as (g0, price0)
     DEVICE arrays from the previous solve (already bucket-padded and
@@ -866,16 +1056,51 @@ def dispatch_solve(
     caller hands over ownership (device ``carry`` it won't reuse) and the
     backend honors donation (TPU/GPU; CPU warns and copies).
     """
+    import jax.numpy as jnp
+
     from modelmesh_tpu.ops.solve import (
         SolveConfig,
         SolveInit,
         solve_placement,
         solve_placement_donated,
+        solve_placement_incremental,
     )
 
     t_start = time.perf_counter() if t_start is None else t_start
     t_snapshot = time.perf_counter() if t_snapshot is None else t_snapshot
+    n_pad = _bucket(len(cols.model_ids))
     m_pad = _bucket(len(cols.instance_ids), 64)
+    max_copies = int(cols.copies.max()) if len(cols.copies) else 1
+    config, sparse = _resolve_sparse_config(config, m_pad, max_copies)
+
+    if base is not None and dirty_rows is not None:
+        if mesh is not None:
+            raise ValueError("incremental re-solve requires mesh=None")
+        if (
+            getattr(base.indices, "shape", (0,))[0] != n_pad
+            or getattr(base.g, "shape", (0,))[0] != m_pad
+        ):
+            raise ValueError(
+                "SolveBase shapes do not match the padded problem "
+                "(stale base after a fleet resize?)"
+            )
+        cfg = SolveConfig() if config is None else config
+        problem = _expand_problem_device(cols, pad=True)
+        d = np.asarray(sorted(int(r) for r in dirty_rows), np.int32)
+        d_pad = _bucket(max(len(d), 1), 64)
+        padded = np.full(d_pad, n_pad, np.int32)
+        padded[: len(d)] = d
+        sol = solve_placement_incremental(
+            problem, cfg, jnp.asarray(seed, jnp.uint32),
+            jnp.asarray(padded), base.indices, base.valid,
+            base.g, base.prices, base.row_err,
+        )
+        return PendingSolve(
+            cols=cols, sol=sol, t_start=t_start, t_snapshot=t_snapshot,
+            t_dispatch=time.perf_counter(), warm=True,
+            path="incremental", topk=cfg.topk, dirty_rows=len(d),
+        )
+
     if carry is not None:
         g0, price0 = carry
         if g0.shape[0] != m_pad or price0.shape[0] != m_pad:
@@ -911,7 +1136,7 @@ def dispatch_solve(
                 "parallel.mesh.make_mesh"
             )
         n_mdl, n_inst = mesh.shape[MODEL_AXIS], mesh.shape[INSTANCE_AXIS]
-        if _bucket(len(cols.model_ids)) % n_mdl or m_pad % n_inst:
+        if n_pad % n_mdl or m_pad % n_inst:
             raise ValueError(
                 f"mesh {dict(mesh.shape)} does not divide the padded problem"
             )
@@ -919,6 +1144,7 @@ def dispatch_solve(
         sol = _solver_for(mesh, config)(
             problem, seed=seed, g0=g0, price0=price0
         )
+        path = "sharded-sparse" if sparse else "sharded"
     else:
         problem = _expand_problem_device(cols, pad=True)
         # Always pass config explicitly: solve_placement defaults it, but
@@ -929,9 +1155,12 @@ def dispatch_solve(
         solve = solve_placement_donated if donate else solve_placement
         sol = solve(problem, config=cfg, seed=seed,
                     init=SolveInit(g0=g0, price0=price0))
+        path = "sparse" if sparse else "dense"
+    cfg_topk = getattr(config, "topk", 0) if config is not None else 0
     return PendingSolve(
         cols=cols, sol=sol, t_start=t_start, t_snapshot=t_snapshot,
         t_dispatch=time.perf_counter(), warm=warm,
+        path=path, topk=cfg_topk if sparse else 0,
     )
 
 
@@ -951,7 +1180,13 @@ def finalize_plan(pending: PendingSolve) -> GlobalPlan:
     packed_dev = _compact_result(
         sol, narrow=len(cols.instance_ids) < 65_536
     )
-    packed = jax.device_get(packed_dev)
+    # One batched D2H for everything the host needs — the packed plan,
+    # the quality scalars, and the warm-start carries: on a remote
+    # device every separate device_get is its own round trip, and the
+    # link latency (not the solve) dominates the refresh there.
+    packed, overflow, row_err, g_host, price_host = jax.device_get(
+        (packed_dev, sol.overflow, sol.row_err, sol.g, sol.prices)
+    )
     n = len(cols.model_ids)
     idxa = packed[:n, :-1]
     counts = packed[:n, -1].astype(np.uint8)
@@ -974,23 +1209,30 @@ def finalize_plan(pending: PendingSolve) -> GlobalPlan:
         "solve_ms": (t2 - pending.t_snapshot) * 1e3,
         "extract_ms": (t3 - t2) * 1e3,
         "warm": pending.warm,
+        "solver_path": pending.path,
     }
+    if pending.topk:
+        plan.stats["topk"] = pending.topk
+    if pending.dirty_rows is not None:
+        plan.stats["dirty_rows"] = pending.dirty_rows
+    # Solution-quality scalars: the bench JSON tail and the incremental
+    # path's overflow fallback gate both read these.
+    plan.stats["overflow"] = float(overflow)
+    plan.stats["row_err"] = float(row_err)
     for name in ("sinkhorn_iters_run", "auction_iters_run"):
         v = getattr(sol, name, None)
         if v is not None:
             plan.stats[name] = int(np.asarray(v))
     # Warm-start carries for the NEXT refresh (~4 KB each at 1k instances).
-    if sol.g is not None:
-        g_host = np.asarray(jax.device_get(sol.g))[: len(cols.instance_ids)]
+    if g_host is not None:
+        g_arr = np.asarray(g_host)[: len(cols.instance_ids)]
         plan.warm_g = dict(
-            zip(cols.instance_ids, g_host.astype(float).tolist())
+            zip(cols.instance_ids, g_arr.astype(float).tolist())
         )
-    if sol.prices is not None:
-        p_host = np.asarray(
-            jax.device_get(sol.prices)
-        )[: len(cols.instance_ids)]
+    if price_host is not None:
+        p_arr = np.asarray(price_host)[: len(cols.instance_ids)]
         plan.warm_price = dict(
-            zip(cols.instance_ids, p_host.astype(float).tolist())
+            zip(cols.instance_ids, p_arr.astype(float).tolist())
         )
     return plan
 
@@ -1164,6 +1406,20 @@ class JaxPlacementStrategy(PlacementStrategy):
         # also bounds how long an unmarked-dirty record can serve stale
         # columns.
         self._delta_streak = 0  #: guarded-by: _refresh_lock
+        # Frozen state of the last full (non-incremental) solve — the
+        # incremental dirty-row path's merge target. None until a full
+        # solve completes on the default device; invalidated on seed
+        # rotation (SolveBase.seed mismatch), fleet resizes (padded-shape
+        # mismatch), and by the pipelined driver (whose donated flights
+        # may consume the carry buffers a base would alias).
+        self._base: Optional[SolveBase] = None  #: guarded-by: _refresh_lock
+        from modelmesh_tpu.utils import envs
+
+        # Dirty-row fraction ceiling for the incremental re-solve; 0
+        # disables the path entirely (every refresh solves full).
+        self.incr_max_dirty_frac = envs.get_float(
+            "MM_SOLVER_INCREMENTAL_MAX_DIRTY_FRAC"
+        )
 
     @property
     def plan(self) -> Optional[GlobalPlan]:
@@ -1229,7 +1485,9 @@ class JaxPlacementStrategy(PlacementStrategy):
 
     def _build_cols_locked(self, models, instances, rpm_fn, incremental: bool):
         """Delta-patch the cached snapshot when allowed, else rebuild (and
-        re-prime the cache). Returns (cols, was_delta)."""
+        re-prime the cache). Returns (cols, was_delta, dirty_models,
+        dirty_instances) — the consumed marks, so the refresh can derive
+        the dirty ROW ids for the incremental re-solve."""
         dm, di = self._take_dirty()
         if (
             incremental
@@ -1243,7 +1501,7 @@ class JaxPlacementStrategy(PlacementStrategy):
             if cols is not None:
                 self._delta_streak += 1
                 self._requeue_stale_marks_locked(dm, di, models, instances)
-                return cols, True
+                return cols, True, dm, di
         cols, self._snap_cache = snapshot_columns(
             models, instances, rpm_fn, constraints=self.constraints,
             return_cache=True,
@@ -1252,7 +1510,7 @@ class JaxPlacementStrategy(PlacementStrategy):
         # A rebuild from a stale list has the same race: keep marks whose
         # mutation the rebuilt snapshot provably hasn't seen.
         self._requeue_stale_marks_locked(dm, di, models, instances)
-        return cols, False
+        return cols, False, dm, di
 
     def _epoch_carries_locked(self, delta: bool):
         """Noise-epoch discipline, shared by the blocking ``refresh`` and
@@ -1274,6 +1532,123 @@ class JaxPlacementStrategy(PlacementStrategy):
             self._warm_price = None
         return self._warm_g, self._warm_price
 
+    def _incremental_rows_locked(self, cols, delta, dm, di):
+        """Dirty ROW ids for an incremental re-solve, or None when the
+        dispatch gates say full solve:
+
+        - a full rebuild happened (positions may have moved — the base
+          assignment is keyed by row), or there is no base yet;
+        - the base was solved under a different noise epoch (seed) or at
+          different padded shapes (fleet resize);
+        - ANY instance is dirty: the frozen column potentials/prices
+          price the OLD instance state, and a capacity / placeability
+          flip moves the cost surface for every row — column churn
+          always takes the full warm solve;
+        - the dirty-model fraction exceeds incr_max_dirty_frac (above
+          it, re-selecting rows against frozen prices drifts too far
+          from the equilibrium a joint solve would find).
+
+        Clean rows whose RATE drifted past RATE_DRIFT_FRAC of the base
+        solve's hottest rate join the dirty set: each delta patch
+        re-reads rpm for every record, so the balance cost term moves
+        without any dirty mark, and before the incremental path existed
+        every refresh re-ranked those rows for free. The ceiling is
+        applied to the EXPANDED set, so a fleet-wide traffic shift
+        falls back to the full solve it deserves.
+        """
+        base = self._base
+        if (
+            not delta or base is None or di or not dm
+            or self.mesh is not None or self.incr_max_dirty_frac <= 0
+            or base.seed != self._seed
+        ):
+            return None
+        cfg = self.solve_config
+        if cfg is not None and cfg.tau > 0 and cfg.noise_impl != "hash":
+            # The incremental kernel replays the base draw POSITIONALLY
+            # (hash_gumbel_at); threefry cannot be evaluated at scattered
+            # rows, so a threefry-pinned strategy must take the full
+            # path — routing it through resolve_dirty_rows would raise
+            # out of refresh() instead of falling back.
+            return None
+        n = len(cols.model_ids)
+        if (
+            getattr(base.indices, "shape", (0,))[0] != _bucket(n)
+            or getattr(base.g, "shape", (0,))[0]
+            != _bucket(len(cols.instance_ids), 64)
+        ):
+            return None
+        cache = self._snap_cache
+        rows = set()
+        for mid in dm:
+            i = None if cache is None else cache.model_pos.get(mid)
+            if i is None:
+                return None
+            rows.add(i)
+        if base.rates is not None and len(base.rates) >= n:
+            cur = np.asarray(cols.rates, np.float32)[:n]
+            scale = float(base.rates[:n].max()) if n else 0.0
+            if scale > 0.0:
+                drifted = np.nonzero(
+                    np.abs(cur - base.rates[:n]) > RATE_DRIFT_FRAC * scale
+                )[0]
+                rows.update(int(i) for i in drifted)
+        if len(rows) > self.incr_max_dirty_frac * n:
+            return None
+        return sorted(rows)
+
+    def _solve_locked(self, cols, delta, dm, di, t0):
+        """Incremental dirty-row re-solve when the gates allow, with the
+        overflow quality fallback; else a full (warm) solve, whose frozen
+        state becomes the next incremental base."""
+        rows = self._incremental_rows_locked(cols, delta, dm, di)
+        if rows is not None:
+            pending = dispatch_solve(
+                cols, seed=self._seed, config=self.solve_config,
+                base=self._base, dirty_rows=rows, t_start=t0,
+            )
+            plan = finalize_plan(pending)
+            demand = float(np.sum(cols.sizes * cols.copies))
+            budget = self._base.overflow + INCREMENTAL_OVERFLOW_FRAC * max(
+                demand, 1e-9
+            )
+            if plan.stats["overflow"] <= budget:
+                # Advance the merge target to the merged assignment; the
+                # column state (g/prices/row_err — and the overflow
+                # reference) stays frozen at the full solve, so drift
+                # accumulated across MANY increments is still measured
+                # against it.
+                self._base = self._base._replace(
+                    indices=pending.sol.indices, valid=pending.sol.valid
+                )
+                return plan
+            log.info(
+                "incremental re-solve overflow %.3g drifted past the "
+                "base solve's %.3g + %.2f%% of demand; falling back to "
+                "a full solve",
+                plan.stats["overflow"], self._base.overflow,
+                INCREMENTAL_OVERFLOW_FRAC * 100,
+            )
+            self._base = None
+        warm_g, warm_price = self._epoch_carries_locked(delta)
+        pending = dispatch_solve(
+            cols, seed=self._seed, mesh=self.mesh,
+            warm_g=warm_g, warm_price=warm_price,
+            config=self.solve_config, t_start=t0,
+        )
+        plan = finalize_plan(pending)
+        sol = pending.sol
+        if self.mesh is None and sol.g is not None and sol.prices is not None:
+            self._base = SolveBase(
+                indices=sol.indices, valid=sol.valid, g=sol.g,
+                prices=sol.prices, row_err=sol.row_err, seed=self._seed,
+                overflow=plan.stats["overflow"],
+                rates=np.asarray(cols.rates, np.float32).copy(),
+            )
+        else:
+            self._base = None
+        return plan
+
     def refresh(
         self,
         models: Sequence[tuple[str, ModelRecord]],
@@ -1286,7 +1661,7 @@ class JaxPlacementStrategy(PlacementStrategy):
             delta = None
             if models and instances:
                 t0 = time.perf_counter()
-                cols, delta = self._build_cols_locked(
+                cols, delta, dm, di = self._build_cols_locked(
                     models, instances, rpm_fn, incremental
                 )
                 # Noise-epoch discipline (_epoch_carries_locked): a frozen draw
@@ -1295,12 +1670,10 @@ class JaxPlacementStrategy(PlacementStrategy):
                 # draw is never frozen forever: full rebuilds rotate it,
                 # and _build_cols_locked forces one every MAX_DELTA_STREAK
                 # consecutive deltas even under perpetual small churn.
-                warm_g, warm_price = self._epoch_carries_locked(delta)
-                plan = finalize_plan(dispatch_solve(
-                    cols, seed=self._seed, mesh=self.mesh,
-                    warm_g=warm_g, warm_price=warm_price,
-                    config=self.solve_config, t_start=t0,
-                ))
+                # _solve_locked routes model-only small-churn deltas
+                # through the incremental dirty-row re-solve against the
+                # last full solve's frozen column state.
+                plan = self._solve_locked(cols, delta, dm, di, t0)
             else:
                 # Empty view: no solve happens, so do NOT rotate the seed —
                 # _warm_price stays selected under the current draw, and a

@@ -101,9 +101,15 @@ class PipelinedRefresher:
             return self.drain()
         with strat._refresh_lock:
             t0 = time.perf_counter()
-            cols, delta = strat._build_cols_locked(
+            cols, delta, _dm, _di = strat._build_cols_locked(
                 models, instances, rpm_fn, incremental
             )
+            # The pipelined driver always dispatches FULL solves and never
+            # captures an incremental base (a donated flight consumes the
+            # very g/prices buffers a base would alias); a base left over
+            # from an earlier blocking refresh is superseded the moment a
+            # newer pipelined plan lands, so drop it now.
+            strat._base = None
             prev = self._inflight
             carry = None
             donated = False

@@ -75,6 +75,11 @@ REGISTRY: dict[str, EnvVar] = {
                "also measure the steady-state refresh fast path: cold vs "
                "warm e2e refresh under churn (pipelined + delta snapshots "
                "+ convergence-gated early exit)", "bench.py"),
+        EnvVar("MM_BENCH_SOLVER", "int", "0",
+               "also measure the per-backend solver breakdown: dense vs "
+               "sparse top-K device solve and the incremental dirty-row "
+               "re-solve vs a full warm solve, with overflow/row_err "
+               "quality fields in the JSON tail", "bench.py"),
         EnvVar("MM_BENCH_SERVE", "int", "0",
                "also run the serving data-plane microbench: local-hit / "
                "forward / cache-miss request-path latency at simulated "
@@ -196,6 +201,22 @@ REGISTRY: dict[str, EnvVar] = {
         EnvVar("MM_SOLVER_SINKHORN_CHUNK", "int", "",
                "iterations per Sinkhorn convergence check when "
                "MM_SOLVER_SINKHORN_TOL is set (default 4)",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_SPARSE", "str", "",
+               "sparse top-K solve path: auto (default — sparse when the "
+               "padded instance count clears the auto floor), 1/on "
+               "forces sparse, 0/off forces dense",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_TOPK", "int", "",
+               "candidate instances gathered per model on the sparse "
+               "path (default 24); the solve is exact for rows with "
+               "<= K feasible instances",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_INCREMENTAL_MAX_DIRTY_FRAC", "float", "0.05",
+               "dirty-row fraction ceiling for the incremental re-solve "
+               "(frozen column potentials/prices); above it — or when "
+               "the merged overflow fails the quality gate — the refresh "
+               "falls back to a full warm solve; 0 disables incremental",
                "placement/jax_engine.py"),
         EnvVar("MM_SIM_SEED", "int", "0",
                "base seed for the deterministic cluster simulator's "

@@ -1,0 +1,119 @@
+"""Smoke for the per-backend solver bench (MM_BENCH_SOLVER=1).
+
+Runs ``bench._measure_solver_paths`` at a small CPU tier so the JSON
+tail contract can't rot: every backend entry must carry the fields
+BENCH_r*.json tracks (solver_path / device_solve_ms / topk /
+overflow_frac / row_err, dirty_rows for the incremental path), the
+dispatch must actually route each pinned measurement through the
+backend it claims, and the relative orderings the PR's acceptance bars
+rest on must hold with generous flake margins (a loaded shared test
+core makes tight wall-clock ratios noise):
+
+- sparse beats dense at the same tier (the full 4x-vs-BENCH_r05 claim
+  is measured at 20k x 256 and recorded in docs/performance.md — this
+  smoke gates the ordering, not the headline magnitude);
+- the incremental dirty-row re-solve beats the full warm solve by a
+  wide margin at ~1% dirty rows;
+- sparse rounding quality stays within a hair of dense (absolute
+  overflow at this tiny tier is rounding-granularity-dominated for
+  EVERY path, so the bar is relative, not the 0.5%-of-demand
+  production bar).
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+jax = pytest.importorskip("jax")
+
+
+@pytest.fixture(scope="module")
+def solver_result():
+    import bench
+
+    return bench._measure_solver_paths(2048, 256, cycles=3)
+
+
+def _require_incremental_samples(solver_result):
+    """The drift gate falling back on EVERY budgeted churn cycle is a
+    legitimate quality-driven outcome (the bench reports it as
+    ``fallback_cycles`` with ``device_solve_ms: null``), not a broken
+    field contract — skip the incremental-timing assertions with the
+    diagnostic instead of failing on a KeyError."""
+    incr = solver_result["paths"]["incremental"]
+    if incr["device_solve_ms"] is None:
+        pytest.skip(
+            "every incremental churn cycle fell back through the "
+            f"quality gate ({incr.get('fallback_cycles', 0)} fallbacks) "
+            "— no incremental samples to assert on"
+        )
+    return incr
+
+
+class TestBenchSolverSmoke:
+    def test_all_paths_report_and_route_correctly(self, solver_result):
+        paths = solver_result["paths"]
+        assert set(paths) == {"dense", "sparse", "full_warm", "incremental"}
+        for name, entry in paths.items():
+            if name == "incremental" and entry["device_solve_ms"] is None:
+                # All-fallback runs still honor the field contract.
+                assert entry["fallback_cycles"] > 0
+                assert entry["cycles"] == 0
+                continue
+            assert entry["device_solve_ms"] is not None, name
+            assert entry["device_solve_ms"] > 0, name
+            assert entry["cycles"] >= 1, name
+            # Quality fields ride along with every entry.
+            assert 0.0 <= entry["overflow_frac"] < 1.0, name
+            assert entry["row_err"] >= 0.0, name
+        # The pinned dispatch must route each measurement through the
+        # backend it claims — the whole point of the breakdown.
+        assert paths["dense"]["solver_path"] == "dense"
+        assert paths["dense"]["topk"] == 0
+        assert paths["sparse"]["solver_path"] == "sparse"
+        assert paths["sparse"]["topk"] > 0
+        assert paths["full_warm"]["solver_path"] == "sparse"
+        if paths["incremental"]["device_solve_ms"] is not None:
+            assert paths["incremental"]["solver_path"] == "incremental"
+
+    def test_incremental_resolves_only_dirty_rows(self, solver_result):
+        incr = _require_incremental_samples(solver_result)
+        # ~1% of 2048 models churned per cycle — well under the 5%
+        # dirty-fraction ceiling, and a tiny slice of the fleet.
+        assert 0 < incr["dirty_rows"] <= 0.05 * 2048
+
+    def test_sparse_beats_dense(self, solver_result):
+        # Measured ~2.9x warm / ~5.1x cold at this tier standalone, but
+        # the warm ratio compresses hard on a contended core (observed
+        # 1.09x): additive scheduler noise inflates the shorter sparse
+        # timings proportionally most. The cold ratio (compile + first
+        # solve, seconds-scale on both sides) is robust to that, so it
+        # carries the magnitude floor; warm is a pure ORDERING gate —
+        # sparse never loses to dense at the same tier.
+        assert solver_result["sparse_speedup"] >= 1.0
+        assert solver_result["sparse_cold_speedup"] >= 1.5
+
+    def test_incremental_beats_full_warm_solve(self, solver_result):
+        _require_incremental_samples(solver_result)
+        # Measured ~5.8x at this tier standalone (the 20k x 256 headline
+        # in docs/performance.md is 8.9x), but the incremental solves are
+        # the shortest timings in the bench, so scheduler noise under a
+        # full-suite run inflates them proportionally most and compresses
+        # the ratio (observed 2.03x under tier-1 load). This smoke gates
+        # the ORDERING — incremental strictly beats the full warm solve —
+        # not the headline magnitude.
+        assert solver_result["incremental_speedup"] >= 1.5
+
+    def test_sparse_quality_tracks_dense(self, solver_result):
+        paths = solver_result["paths"]
+        # Rounding overflow at this granularity-dominated tier must not
+        # materially exceed dense's (the production 0.5%-of-demand bar
+        # lives in tests/test_sparse_solver.py at a realistic shape).
+        assert (
+            paths["sparse"]["overflow_frac"]
+            <= paths["dense"]["overflow_frac"] + 0.01
+        )
+        assert paths["sparse"]["row_err"] <= paths["dense"]["row_err"] + 0.05

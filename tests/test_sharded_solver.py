@@ -187,3 +187,141 @@ class TestShardedSolver:
                 if valid[m, k]:
                     load[idx[m, k]] += sizes[m]
         np.testing.assert_allclose(load, np.asarray(sol.load), rtol=1e-4)
+
+
+class TestSingleDeviceMeshParity:
+    """The tier-1 parity gate the sharded path was missing: on a 1x1
+    mesh every collective is an identity, so shard_problem +
+    make_sharded_solver must reproduce the single-device solve
+    BITWISE — any drift is a real fork between the hand-mirrored mesh
+    kernel and ops/solve.py, not a reduction-order artifact."""
+
+    def test_dense_bitwise_parity(self, problem):
+        mesh = mesh_mod.make_mesh((1, 1), devices=jax.devices()[:1])
+        single = ops.solve_placement(problem, seed=5)
+        sharded = make_sharded_solver(mesh)(
+            shard_problem(problem, mesh), seed=5
+        )
+        assert bool(jnp.all(single.indices == sharded.indices))
+        assert bool(jnp.all(single.valid == sharded.valid))
+        np.testing.assert_allclose(
+            np.asarray(single.load), np.asarray(sharded.load), atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(single.g), np.asarray(sharded.g), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(single.overflow), float(sharded.overflow), atol=1e-2
+        )
+
+    def test_dense_warm_start_bitwise_parity(self, problem):
+        # The warm-carry plumbing (g0/price0) must route identically.
+        mesh = mesh_mod.make_mesh((1, 1), devices=jax.devices()[:1])
+        cold = ops.solve_placement(problem, seed=5)
+        from modelmesh_tpu.ops.solve import SolveInit
+
+        single = ops.solve_placement(
+            problem, seed=6, init=SolveInit(g0=cold.g, price0=cold.prices)
+        )
+        sharded = make_sharded_solver(mesh)(
+            shard_problem(problem, mesh), seed=6,
+            g0=cold.g, price0=cold.prices,
+        )
+        assert bool(jnp.all(single.indices == sharded.indices))
+        assert bool(jnp.all(single.valid == sharded.valid))
+
+
+class TestSparseShardedParity:
+    """The sparse top-K pipeline composes with the mesh solver: the
+    all-gathered per-shard gather sees GLOBAL column ids and the same
+    positional noise the single-device gather sees, so candidate sets —
+    and therefore the whole solve — match bit-for-bit on EVERY mesh
+    shape, not just the degenerate one."""
+
+    def _cfg(self):
+        from modelmesh_tpu.ops.solve import SolveConfig
+
+        return SolveConfig(topk=16, sel_width=ops.MAX_COPIES)
+
+    def test_bitwise_parity_1x1(self, problem):
+        cfg = self._cfg()
+        mesh = mesh_mod.make_mesh((1, 1), devices=jax.devices()[:1])
+        single = ops.solve_placement(problem, cfg, seed=9)
+        sharded = make_sharded_solver(mesh, cfg)(
+            shard_problem(problem, mesh), seed=9
+        )
+        assert bool(jnp.all(single.indices == sharded.indices))
+        assert bool(jnp.all(single.valid == sharded.valid))
+        np.testing.assert_allclose(
+            float(single.overflow), float(sharded.overflow), atol=1e-2
+        )
+
+    @pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4)])
+    def test_bitwise_parity_multi_device(self, problem, shape):
+        cfg = self._cfg()
+        mesh = mesh_mod.make_mesh(shape)
+        single = ops.solve_placement(problem, cfg, seed=9)
+        sharded = make_sharded_solver(mesh, cfg)(
+            shard_problem(problem, mesh), seed=9
+        )
+        assert bool(jnp.all(single.indices == sharded.indices)), shape
+        assert bool(jnp.all(single.valid == sharded.valid)), shape
+        np.testing.assert_allclose(
+            np.asarray(single.load), np.asarray(sharded.load), atol=1e-3
+        )
+
+    def test_topk_covering_full_width_routes_dense(self, problem):
+        # Gate parity with solve_placement's ``topk < num_instances``:
+        # K = the full GLOBAL width must run the dense kernel on the
+        # mesh too (bitwise-equal to a default-config single-device
+        # dense solve), not a degenerate full-width sparse gather that
+        # agrees with dense only to float rounding.
+        from modelmesh_tpu.ops.solve import SolveConfig
+
+        cfg = SolveConfig(topk=problem.num_instances)
+        mesh = mesh_mod.make_mesh((4, 2))
+        dense = ops.solve_placement(problem, seed=9)
+        sharded = make_sharded_solver(mesh, cfg)(
+            shard_problem(problem, mesh), seed=9
+        )
+        assert bool(jnp.all(dense.indices == sharded.indices))
+        assert bool(jnp.all(dense.valid == sharded.valid))
+
+    def test_full_width_topk_accepts_dense_only_knobs(self, problem):
+        # A config the single-device path accepts must build and solve
+        # on the mesh too: topk = num_instances routes DENSE, where
+        # threefry noise is fine — the sparse-only constraints may not
+        # reject a solve that never takes the sparse branch.
+        from modelmesh_tpu.ops.solve import SolveConfig
+
+        cfg = SolveConfig(topk=problem.num_instances,
+                          noise_impl="threefry")
+        ops.solve_placement(problem, cfg, seed=3)  # accepted off-mesh
+        mesh = mesh_mod.make_mesh((4, 2))
+        sol = make_sharded_solver(mesh, cfg)(
+            shard_problem(problem, mesh), seed=3
+        )
+        _check_solution(problem, sol)
+
+    def test_narrow_topk_with_threefry_rejected_at_solve(self, problem):
+        # ...while a genuinely sparse route still enforces the hash-noise
+        # requirement — at trace time, like solve_sparse.
+        from modelmesh_tpu.ops.solve import SolveConfig
+
+        cfg = SolveConfig(topk=8, noise_impl="threefry")
+        mesh = mesh_mod.make_mesh((4, 2))
+        solver = make_sharded_solver(mesh, cfg)  # builds fine
+        with pytest.raises(ValueError, match="hash"):
+            solver(shard_problem(problem, mesh), seed=3)
+
+    def test_sparse_solution_well_formed_on_mesh(self, problem):
+        cfg = self._cfg()
+        mesh = mesh_mod.make_mesh((4, 2))
+        sol = make_sharded_solver(mesh, cfg)(
+            shard_problem(problem, mesh), seed=2
+        )
+        _check_solution(problem, sol)
+        demand = float(jnp.sum(problem.sizes * jnp.minimum(
+            problem.copies, ops.MAX_COPIES
+        )))
+        assert float(sol.overflow) < 0.05 * demand

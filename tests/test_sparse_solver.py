@@ -1,0 +1,275 @@
+"""Sparse top-K solve + incremental dirty-row re-solve: parity gates.
+
+The sparse pipeline's contract (ops/sparse.py) is that it is EXACT —
+bit-compatible ``Placement.indices/valid`` with the dense solver —
+whenever every row has <= K feasible instances, and a close
+approximation (quality measured by rounding overflow / Sinkhorn
+marginal error) when K truncates. The incremental re-solve's contract
+is that re-selecting rows against the FROZEN column state of a base
+solve reproduces the base assignment exactly when nothing changed
+(selection at the chosen prices is what produced the base), and that a
+real perturbation only moves the dirty rows. These tests pin both, at
+seeds, so kernel refactors can't silently fork the solvers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from modelmesh_tpu import ops
+from modelmesh_tpu.ops.auction import MAX_COPIES
+from modelmesh_tpu.ops.solve import (
+    SolveConfig,
+    solve_placement,
+    solve_placement_incremental,
+)
+from modelmesh_tpu.ops.sparse import check_sparse_config, topk_candidates
+
+
+def _demand(problem) -> float:
+    return float(jnp.sum(
+        problem.sizes * jnp.minimum(problem.copies, MAX_COPIES)
+    ))
+
+
+class TestSparseDenseParity:
+    def test_exact_when_k_covers_feasible(self):
+        # Thinned feasibility so K = the max feasible count is genuinely
+        # narrower than the fleet (K = num_instances would route DENSE
+        # via solve_placement's topk < num_instances gate and compare
+        # dense against dense): the gather holds every feasible instance
+        # of every row — the sparse solve must be EXACT.
+        problem = ops.random_problem(
+            jax.random.PRNGKey(1), 512, 64,
+            capacity_slack=1.5, feasible_frac=0.5,
+        )
+        k = int(np.asarray(problem.feasible).sum(axis=1).max())
+        assert k < problem.num_instances  # sparse path actually runs
+        dense = solve_placement(problem, SolveConfig(), seed=3)
+        sparse = solve_placement(
+            problem, SolveConfig(topk=k, sel_width=MAX_COPIES), seed=3
+        )
+        assert bool(jnp.all(dense.indices == sparse.indices))
+        assert bool(jnp.all(dense.valid == sparse.valid))
+        np.testing.assert_allclose(
+            np.asarray(dense.load), np.asarray(sparse.load), atol=1e-3
+        )
+        np.testing.assert_allclose(
+            float(dense.overflow), float(sparse.overflow), atol=1e-2
+        )
+
+    def test_quality_at_k32_on_2k_x_64(self):
+        # K=32 of 64 columns truncates half the width; the spilled terms
+        # were ~0 transport mass, so rounding quality must stay within
+        # the dense-parity overflow budget (0.5% of demand) and the
+        # Sinkhorn marginal error within a hair of dense.
+        problem = ops.random_problem(
+            jax.random.PRNGKey(0), 2048, 64, capacity_slack=1.2
+        )
+        dense = solve_placement(problem, SolveConfig(), seed=7)
+        sparse = solve_placement(
+            problem, SolveConfig(topk=32, sel_width=MAX_COPIES), seed=7
+        )
+        demand = _demand(problem)
+        assert float(sparse.overflow) <= 0.005 * demand
+        assert float(sparse.overflow) <= float(dense.overflow) + 0.005 * demand
+        assert abs(float(sparse.row_err) - float(dense.row_err)) < 0.05
+        # Same transport mass placed — the gather must not drop rows.
+        np.testing.assert_allclose(
+            float(np.asarray(sparse.load).sum()),
+            float(np.asarray(dense.load).sum()),
+            rtol=1e-5,
+        )
+
+    def test_every_valid_slot_feasible_and_distinct(self):
+        problem = ops.random_problem(
+            jax.random.PRNGKey(5), 256, 64, capacity_slack=1.5
+        )
+        sol = solve_placement(
+            problem, SolveConfig(topk=16, sel_width=MAX_COPIES), seed=1
+        )
+        idx = np.asarray(sol.indices)
+        valid = np.asarray(sol.valid)
+        feas = np.asarray(problem.feasible)
+        copies = np.asarray(jnp.minimum(problem.copies, MAX_COPIES))
+        for m in range(256):
+            chosen = idx[m][valid[m]]
+            assert len(chosen) == copies[m]
+            assert len(set(chosen.tolist())) == len(chosen)
+            assert feas[m][chosen].all()
+
+
+class TestTopkCandidates:
+    def test_gather_contains_all_feasible_when_under_k(self):
+        # Rows with <= K feasible instances must gather ALL of them —
+        # the exactness precondition. Feasibility is thinned so rows
+        # genuinely have few candidates.
+        problem = ops.random_problem(
+            jax.random.PRNGKey(2), 128, 64,
+            capacity_slack=2.0, feasible_frac=0.2,
+        )
+        from modelmesh_tpu.ops import costs as costs_mod
+
+        C = costs_mod.assemble_cost(problem)
+        k = 16
+        _, idx_k, feas_k, mask = topk_candidates(
+            C, problem.feasible, k, seed=jnp.uint32(9)
+        )
+        feas = np.asarray(problem.feasible)
+        idxs = np.asarray(idx_k)
+        feask = np.asarray(feas_k)
+        for m in range(128):
+            want = set(np.nonzero(feas[m])[0].tolist())
+            if len(want) <= k:
+                got = {
+                    int(j) for j, f in zip(idxs[m], feask[m]) if f
+                }
+                assert got == want, f"row {m} missed feasible candidates"
+
+    def test_mask_is_tie_inclusive_superset_of_gather(self):
+        problem = ops.random_problem(
+            jax.random.PRNGKey(4), 64, 32, capacity_slack=2.0
+        )
+        from modelmesh_tpu.ops import costs as costs_mod
+
+        C = costs_mod.assemble_cost(problem)
+        _, idx_k, _, mask = topk_candidates(
+            C, problem.feasible, 8, seed=jnp.uint32(1)
+        )
+        m = np.asarray(mask)
+        idxs = np.asarray(idx_k)
+        rows = np.arange(64)[:, None]
+        assert m[rows, idxs].all(), "gathered column outside the mask"
+        # Tie-inclusive: at least K entries per row.
+        assert (m.sum(axis=1) >= 8).all()
+
+
+class TestIncrementalResolve:
+    def _base(self, problem, cfg=SolveConfig(), seed=11):
+        return solve_placement(problem, cfg, seed=seed)
+
+    def _resolve(self, problem, base, rows, cfg=SolveConfig(), seed=11,
+                 n_pad=None):
+        n = problem.num_models
+        rows = np.asarray(rows, np.int32)
+        padded = np.full(max(len(rows), 4), n if n_pad is None else n_pad,
+                         np.int32)
+        padded[: len(rows)] = rows
+        return solve_placement_incremental(
+            problem, cfg, jnp.uint32(seed), jnp.asarray(padded),
+            base.indices, base.valid, base.g, base.prices, base.row_err,
+        )
+
+    def test_unchanged_problem_is_bitwise_noop_at_f32(self):
+        # Re-selecting any dirty subset against the frozen column state
+        # of the very solve that produced the assignment is algebraically
+        # a no-op: row potentials shift whole rows (cancel in top-k) and
+        # selection at the chosen prices IS the base assignment. At f32
+        # logits this is BITWISE (no quantization ties to flip).
+        problem = ops.random_problem(
+            jax.random.PRNGKey(8), 512, 64, capacity_slack=1.3
+        )
+        cfg = SolveConfig(dtype=jnp.float32)
+        base = self._base(problem, cfg)
+        rows = np.arange(0, 512, 7)
+        merged = self._resolve(problem, base, rows, cfg)
+        assert bool(jnp.all(merged.indices == base.indices))
+        assert bool(jnp.all(merged.valid == base.valid))
+        np.testing.assert_allclose(
+            np.asarray(merged.load), np.asarray(base.load), atol=1e-3
+        )
+        np.testing.assert_allclose(
+            float(merged.overflow), float(base.overflow), atol=1e-2
+        )
+
+    def test_unchanged_problem_near_noop_at_bf16(self):
+        # At the production bf16 logit dtype the incremental path's
+        # EXACT row potential shifts each row by a slightly different
+        # amount than the base's iterated one, so quantization can flip
+        # genuine score ties — a handful of rows, never the clean ones,
+        # and never the merged bookkeeping (this is what the dispatch
+        # layer's overflow drift gate budgets for).
+        problem = ops.random_problem(
+            jax.random.PRNGKey(8), 512, 64, capacity_slack=1.3
+        )
+        base = self._base(problem)
+        rows = np.arange(0, 512, 7)
+        merged = self._resolve(problem, base, rows)
+        clean = np.ones(512, bool)
+        clean[rows] = False
+        assert bool(jnp.all(
+            merged.indices[clean] == base.indices[clean]
+        ))
+        changed = (
+            (np.asarray(merged.indices) != np.asarray(base.indices)).any(1)
+            | (np.asarray(merged.valid) != np.asarray(base.valid)).any(1)
+        ).sum()
+        assert changed <= max(2, len(rows) // 10), (
+            f"{changed} of {len(rows)} re-selected rows moved on an "
+            "unchanged problem — more than quantization ties explain"
+        )
+        demand = _demand(problem)
+        assert float(merged.overflow) <= float(base.overflow) + 0.005 * demand
+
+    def test_perturbation_moves_only_dirty_rows(self):
+        import dataclasses
+
+        problem = ops.random_problem(
+            jax.random.PRNGKey(8), 512, 64, capacity_slack=1.3
+        )
+        cfg = SolveConfig(dtype=jnp.float32)
+        base = self._base(problem, cfg)
+        # Perturb copies for a handful of rows (the delta-snapshot shape:
+        # record churn on a few models).
+        rows = np.asarray([3, 17, 100, 101, 400], np.int32)
+        copies = np.asarray(problem.copies).copy()
+        copies[rows] = np.minimum(copies[rows] + 1, MAX_COPIES)
+        perturbed = dataclasses.replace(problem, copies=jnp.asarray(copies))
+        merged = self._resolve(perturbed, base, rows, cfg)
+        clean = np.ones(512, bool)
+        clean[rows] = False
+        assert bool(jnp.all(
+            merged.indices[clean] == base.indices[clean]
+        )), "incremental re-solve touched a clean row"
+        assert bool(jnp.all(merged.valid[clean] == base.valid[clean]))
+        # Dirty rows picked up their extra copy.
+        v = np.asarray(merged.valid)
+        assert (v[rows].sum(axis=1) == copies[rows]).all()
+        # Merged bookkeeping is an exact recount of the merged plan.
+        idx = np.asarray(merged.indices)
+        sizes = np.asarray(problem.sizes)
+        load = np.zeros(64, np.float64)
+        for m in range(512):
+            for j in idx[m][v[m]]:
+                load[j] += sizes[m]
+        np.testing.assert_allclose(
+            load, np.asarray(merged.load), rtol=1e-4
+        )
+
+    def test_padded_sentinel_rows_are_inert(self):
+        problem = ops.random_problem(
+            jax.random.PRNGKey(8), 128, 32, capacity_slack=1.5
+        )
+        cfg = SolveConfig(dtype=jnp.float32)  # no quantization ties
+        base = self._base(problem, cfg)
+        merged = self._resolve(problem, base, [5], cfg, n_pad=128)
+        assert bool(jnp.all(merged.indices == base.indices))
+        assert bool(jnp.all(merged.valid == base.valid))
+
+
+class TestSparseConfigValidation:
+    def test_threefry_noise_rejected(self):
+        cfg = SolveConfig(topk=8, noise_impl="threefry")
+        with pytest.raises(ValueError, match="hash"):
+            check_sparse_config(cfg)
+
+    def test_threefry_ok_when_tau_zero(self):
+        check_sparse_config(SolveConfig(topk=8, noise_impl="threefry",
+                                        tau=0.0))
+
+    def test_bad_sel_width_rejected(self):
+        with pytest.raises(ValueError, match="sel_width"):
+            check_sparse_config(
+                SolveConfig(topk=8, sel_width=MAX_COPIES + 1)
+            )

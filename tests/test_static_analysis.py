@@ -467,6 +467,78 @@ def build(table):
 """
         assert "jax-unordered-iter" not in _rules(_jax_findings(tmp_path, src))
 
+    def test_unordered_index_arg_to_jitted_fires(self, tmp_path):
+        src = """
+import jax
+import jax.numpy as jnp
+
+def _kernel(rows):
+    return rows
+
+kernel = jax.jit(_kernel)
+
+def resolve(dirty):
+    return kernel(jnp.asarray(list(dirty.keys())))
+"""
+        assert "jax-unordered-index" in _rules(_jax_findings(tmp_path, src))
+
+    def test_unordered_index_arg_to_sparse_entry_fires(self, tmp_path):
+        # The incremental entry points are flagged by NAME — they are
+        # jitted in their home module, invisible to a caller-module scan.
+        src = """
+import numpy as np
+
+def refresh(problem, cfg, seed, dirty_set, base):
+    from modelmesh_tpu.ops.solve import solve_placement_incremental
+
+    return solve_placement_incremental(
+        problem, cfg, seed, np.asarray(list(set(dirty_set))),
+        base.indices, base.valid, base.g, base.prices, base.row_err,
+    )
+"""
+        assert "jax-unordered-index" in _rules(_jax_findings(tmp_path, src))
+
+    def test_sorted_index_arg_is_clean(self, tmp_path):
+        src = """
+import numpy as np
+
+def refresh(problem, cfg, seed, dirty_set, base):
+    from modelmesh_tpu.ops.solve import solve_placement_incremental
+
+    return solve_placement_incremental(
+        problem, cfg, seed, np.asarray(sorted(dirty_set)),
+        base.indices, base.valid, base.g, base.prices, base.row_err,
+    )
+"""
+        assert "jax-unordered-index" not in _rules(_jax_findings(tmp_path, src))
+
+    def test_plain_array_index_arg_is_clean(self, tmp_path):
+        src = """
+import numpy as np
+
+def refresh(problem, cfg, seed, rows, base):
+    from modelmesh_tpu.ops.solve import solve_placement_incremental
+
+    return solve_placement_incremental(
+        problem, cfg, seed, np.asarray(rows),
+        base.indices, base.valid, base.g, base.prices, base.row_err,
+    )
+"""
+        assert "jax-unordered-index" not in _rules(_jax_findings(tmp_path, src))
+
+    def test_set_comprehension_index_arg_fires(self, tmp_path):
+        src = """
+import numpy as np
+
+def gather(C, feas, dirty):
+    from modelmesh_tpu.ops.sparse import topk_candidates
+
+    return topk_candidates(C, feas, 32, seed=np.asarray(
+        [v for v in {d for d in dirty}]
+    ))
+"""
+        assert "jax-unordered-index" in _rules(_jax_findings(tmp_path, src))
+
 
 # --------------------------------------------------------------------- #
 # MM_LOCK_DEBUG runtime validator                                       #

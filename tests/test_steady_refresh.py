@@ -321,7 +321,14 @@ class TestPipelinedRefresh:
 
         def reader():
             last_gen = -1
-            while not stop.is_set():
+            # One more observation AFTER stop: drain() installs the final
+            # plan before stop is set, so the post-stop read
+            # deterministically sees the last generation (a loop that
+            # only reads while running can exit between the install and
+            # its next poll, flaking the final-generation assertion).
+            final_pass = False
+            while not final_pass:
+                final_pass = stop.is_set()
                 plan = strat.plan
                 if plan is None:
                     continue

@@ -17,6 +17,15 @@ Three rules, scoped to the JAX-bearing subpackages:
   order varies across processes (sets hash-order by id); when it feeds
   bucketing or shape-determining arguments the jit cache re-compiles
   per ordering and plans diverge between leader and followers.
+- ``jax-unordered-index`` (ops/, parallel/, placement/): an argument to
+  a jitted callable — or to one of the sparse/incremental solver entry
+  points that consume gathered index columns (``dirty_rows``,
+  ``idx_k``) — derived from a dict view or set (directly, or through a
+  ``list``/``np.asarray``/``jnp.asarray``/``np.fromiter`` conversion)
+  without ``sorted(...)``. The sparse kernels treat index columns as
+  POSITIONAL data (the hash-noise draw and the scatter merge key off
+  them), so hash-ordered indices make the leader's solve diverge from a
+  follower's replay of the same snapshot.
 
 Jit detection: ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators,
 ``name = jax.jit(fn)`` bindings (the bound local ``fn`` is scanned for
@@ -40,10 +49,32 @@ from tools.analysis.core import (
 TRACER_RULE = "jax-tracer-leak"
 SYNC_RULE = "jax-sync-under-lock"
 ITER_RULE = "jax-unordered-iter"
+INDEX_RULE = "jax-unordered-index"
 
 JAX_DIRS = ("modelmesh_tpu/ops/", "modelmesh_tpu/parallel/",
             "modelmesh_tpu/placement/")
 ITER_DIRS = ("modelmesh_tpu/ops/", "modelmesh_tpu/parallel/")
+
+# Sparse/incremental solver entry points whose index-column arguments
+# are positional data (the hash-noise draw and the merge scatter key
+# off them): a hash-ordered dict/set feeding them desyncs the leader's
+# solve from any replay of the same snapshot. Kept in lockstep with
+# ops/sparse.py and placement/jax_engine.dispatch_solve.
+INDEX_CONSUMERS = frozenset({
+    "solve_placement_incremental",
+    "resolve_dirty_rows",
+    "dispatch_solve",
+    "topk_candidates",
+    "perturb_gathered",
+    "sparse_auction",
+})
+
+# Conversions that preserve (not launder) the iteration order of their
+# operand — an unordered container wrapped in one is still unordered.
+_ORDER_PRESERVING = frozenset({
+    "list", "tuple", "asarray", "array", "fromiter", "stack",
+    "concatenate",
+})
 
 
 def _is_jit_expr(node: ast.AST) -> bool:
@@ -268,6 +299,75 @@ def _check_unordered_iter(
     return findings
 
 
+def _unordered_index_source(node: ast.AST) -> Optional[str]:
+    """The unordered-container expression an argument derives from, or
+    None. ``sorted(...)`` anywhere in the chain launders the order;
+    order-preserving conversions (list/asarray/fromiter/...) do not."""
+    token = _unsorted_iter_expr(node)
+    if token is not None:
+        return token
+    if isinstance(node, ast.SetComp):
+        return "{...} set comprehension"
+    if isinstance(node, ast.DictComp):
+        return "{...} dict comprehension"
+    if isinstance(node, ast.Call):
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        if fname == "sorted":
+            return None
+        if fname in _ORDER_PRESERVING:
+            for arg in node.args[:1]:
+                inner = _unordered_index_source(arg)
+                if inner is not None:
+                    return inner
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+        for gen in node.generators:
+            inner = _unordered_index_source(gen.iter)
+            if inner is not None:
+                return inner
+    return None
+
+
+def _check_unordered_index(
+    mod: ModuleInfo, jitted_names: set[str]
+) -> list[Finding]:
+    findings = []
+    for cls, func in iter_functions(mod):
+        qual = f"{cls}.{func.name}" if cls else func.name
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if fname not in INDEX_CONSUMERS and fname not in jitted_names:
+                continue
+            values = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in values:
+                token = _unordered_index_source(arg)
+                if token is None:
+                    continue
+                findings.append(Finding(
+                    rule=INDEX_RULE,
+                    path=mod.relpath,
+                    line=getattr(arg, "lineno", node.lineno),
+                    qualname=qual,
+                    token=token,
+                    message=(
+                        f"argument to {fname}() derives from {token} — "
+                        f"index columns feeding the sparse/incremental "
+                        f"kernels are positional data (noise draw + "
+                        f"merge scatter key off them); wrap in "
+                        f"sorted(...) so the solve replays identically "
+                        f"across processes"
+                    ),
+                ))
+    return findings
+
+
 def check(ctx: AnalysisContext) -> list[Finding]:
     findings: list[Finding] = []
     for mod in ctx.modules:
@@ -286,4 +386,6 @@ def check(ctx: AnalysisContext) -> list[Finding]:
             findings += visitor.findings
         if any(d in mod.relpath for d in ITER_DIRS):
             findings += _check_unordered_iter(mod, ctx, jitted_names)
+        if in_jax_dir:
+            findings += _check_unordered_index(mod, jitted_names)
     return findings

@@ -432,7 +432,7 @@ class EtcdKV(KVStore):
         threading.Thread(
             target=pump, name=f"etcd-watch-{prefix}", daemon=True
         ).start()
-        if not created.wait(10.0):
+        if not created.wait(10.0):  #: wall-clock: bounds a REAL etcd watch subscribe ack; wire latency is physical time
             log.warning("etcd watch on %r: no created ack within 10s", prefix)
         self._watches.append(handle)
         return handle

@@ -492,9 +492,9 @@ class InMemoryKV(KVStore):
 
     def wait_idle(self, timeout: float = 5.0) -> None:
         """Block until the watch event queue has drained (tests)."""
-        deadline = time.monotonic() + timeout
+        deadline = time.monotonic() + timeout  #: wall-clock: test helper bounding REAL dispatcher-thread progress; a virtual deadline would never expire while the clock is parked
         while not self._events.empty():
-            if time.monotonic() > deadline:
+            if time.monotonic() > deadline:  #: wall-clock: same wall bound as above
                 raise TimeoutError("watch queue did not drain")
-            time.sleep(0.005)
-        time.sleep(0.02)  # let the in-flight callback finish
+            time.sleep(0.005)  #: wall-clock: polls a real thread's queue drain
+        time.sleep(0.02)  #: wall-clock: lets the in-flight callback finish on its real thread

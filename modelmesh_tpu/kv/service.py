@@ -475,7 +475,7 @@ class RemoteKV(KVStore):
                 backoff = min(backoff * 2, 5.0)
 
         threading.Thread(target=pump, name=f"kvwatch-{prefix}", daemon=True).start()
-        if not created.wait(10.0):
+        if not created.wait(10.0):  #: wall-clock: bounds a REAL gRPC subscribe ack; wire latency is physical time
             log.warning("watch on %r: no created ack within 10s", prefix)
         self._watches.append(handle)
         return handle
@@ -525,7 +525,7 @@ class RemoteKV(KVStore):
                             e.set()
 
             self._barrier_watch = self.watch("__barrier__/", on_barrier)
-        token = _uuid.uuid4().hex
+        token = _uuid.uuid4().hex  # analysis-ok: det-entropy — one-shot wire barrier token, unique per call by design; never reaches a trace or record
         evt = threading.Event()
         with self._barrier_lock:
             self._barrier_events[token] = evt
@@ -535,7 +535,7 @@ class RemoteKV(KVStore):
         self.delete(f"__barrier__/{token}")
         # Events for OTHER watches dispatch on their own streams; give their
         # pumps a beat to drain callbacks.
-        _time.sleep(0.05)
+        _time.sleep(0.05)  #: wall-clock: test helper letting real pump threads drain
 
     def close(self) -> None:
         for w in self._watches:

@@ -184,7 +184,7 @@ class SessionNode:
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=2.0)
+            self._thread.join(timeout=2.0)  #: wall-clock: bounds a REAL keepalive-thread teardown at close
         with self._lock:
             lease, self._lease = self._lease, None
         if lease is not None:

@@ -232,4 +232,4 @@ class KVStore(abc.ABC):
         only allow propagation time."""
         import time as _time
 
-        _time.sleep(0.25)
+        _time.sleep(0.25)  #: wall-clock: test helper allowing REAL wire/dispatcher propagation; virtual time cannot advance a network

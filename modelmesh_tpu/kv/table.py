@@ -39,6 +39,24 @@ from modelmesh_tpu.utils.lockdebug import mm_rlock
 R = TypeVar("R", bound="Record")
 
 
+def _cas_backoff(attempt: int) -> None:
+    """Bounded exponential backoff between CAS retry attempts.
+
+    Contended retry loops over a shared wire connection can livelock in
+    lockstep: every round trip re-enters the socket queue in the same
+    order, so the same contender wins every round while the others burn
+    their whole retry budget (observed against the ZooKeeper backend).
+    A short, attempt-proportional pause desynchronizes the losers.
+
+    Deliberately WALL time, not the injectable clock: this paces real
+    wire I/O, and the retry loop can run on the simulation's advancing
+    thread (the runner's inline janitor cycle) where a virtual sleep
+    would wedge the clock beneath itself.
+    """
+    if attempt > 0:
+        time.sleep(min(0.0005 * (1 << min(attempt - 1, 6)), 0.02))  #: wall-clock: CAS retry pacing; see _cas_backoff docstring
+
+
 class Record:
     """Base for table records: JSON dataclass + KV version for CAS.
 
@@ -184,7 +202,8 @@ class KVTable(Generic[R]):
         desired record (None = delete / no-op if also absent). Returns the
         final stored record (None if deleted/no-op).
         """
-        for _ in range(max_attempts):
+        for attempt in range(max_attempts):
+            _cas_backoff(attempt)
             current = self.get(id_)
             desired = mutate(current)
             if desired is None:
@@ -238,7 +257,8 @@ class KVTable(Generic[R]):
 
         Returns id -> final record (None if deleted/absent no-op).
         """
-        for _ in range(max_attempts):
+        for attempt in range(max_attempts):
+            _cas_backoff(attempt)
             compares: list[Compare] = []
             ops: list[Op] = []
             results: dict[str, Optional[R]] = {}
@@ -650,9 +670,9 @@ class TableView(Generic[R]):
         slack; ``poll_s`` only bounds the re-check cadence for
         predicates that depend on state outside this view. Deliberately
         real-time (it bounds real thread progress, like wait_idle)."""
-        deadline = time.monotonic() + timeout
+        deadline = time.monotonic() + timeout  #: wall-clock: test helper bounding REAL watch-thread progress (docstring above)
         while not predicate(self):
-            remaining = deadline - time.monotonic()
+            remaining = deadline - time.monotonic()  #: wall-clock: same wall bound as above
             if remaining <= 0:
                 raise TimeoutError("condition not reached")
             with self._change_cv:

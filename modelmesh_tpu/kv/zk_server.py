@@ -85,7 +85,7 @@ class _Node:
     )
 
     def __init__(self, data: bytes, zxid: int, owner: int = 0):
-        now = int(time.time() * 1000)
+        now = int(time.time() * 1000)  #: wall-clock: wire-visible znode ctime/mtime stamps — the server emulates a real external ensemble, outside the sim's clock
         self.data = data
         self.czxid = zxid
         self.mzxid = zxid
@@ -125,7 +125,7 @@ class _Session:
     def __init__(self, sid: int, timeout_ms: int):
         self.sid = sid
         self.timeout_ms = timeout_ms
-        self.last_seen = time.monotonic()
+        self.last_seen = time.monotonic()  #: wall-clock: session-idle tracking for REAL client connections; an external ensemble's clock, not the sim's
         self.ephemerals: set[str] = set()
         self.conn: Optional["_ZkConnHandler"] = None
         self.closed = False
@@ -179,7 +179,7 @@ class ZkState:
                     peers.discard(s)
 
     def expire_idle_sessions(self) -> list[_Session]:
-        now = time.monotonic()
+        now = time.monotonic()  #: wall-clock: expires real wire sessions against their real last_seen stamps
         expired = []
         with self.lock:
             for s in list(self.sessions.values()):
@@ -271,7 +271,7 @@ class ZkState:
         node.data = data
         node.version += 1
         node.mzxid = self.zxid
-        node.mtime = int(time.time() * 1000)
+        node.mtime = int(time.time() * 1000)  #: wall-clock: wire-visible znode mtime stamp, like _Node.__init__
         self._fire(self.data_watches, path, EV_NODE_DATA_CHANGED)
         return node
 
@@ -454,7 +454,7 @@ class _ZkConnHandler(socketserver.BaseRequestHandler):
         assert self.session is not None
         if self.session.closed:
             return False  # expired under us; drop the connection
-        self.session.last_seen = time.monotonic()
+        self.session.last_seen = time.monotonic()  #: wall-clock: liveness stamp for a real client connection
         if op == OP_PING:
             self._reply(XID_PING, ERR_OK)
             return True
@@ -711,7 +711,7 @@ class ZkWireServer:
         self._tcp = _ThreadingTCP((host, port), _ZkConnHandler)
         # The handler reaches shared state through self.server (the TCP
         # server instance socketserver hands it).
-        self._tcp.state = self.state          # type: ignore[attr-defined]
+        self._tcp.state = self.state          # type: ignore[attr-defined]  # analysis-ok: state-funnel — name collision: this is the ZkState tree handed to socketserver, not CacheEntry.state
         self._tcp.stopping = self.stopping    # type: ignore[attr-defined]
         self._tcp.tls_ctx = (                 # type: ignore[attr-defined]
             tls.ssl_server_context() if tls is not None else None
@@ -730,7 +730,7 @@ class ZkWireServer:
         return self
 
     def _reap_loop(self) -> None:
-        while not self.stopping.wait(0.05):
+        while not self.stopping.wait(0.05):  #: wall-clock: server reaper cadence over real wire sessions
             try:
                 self.state.expire_idle_sessions()
             except Exception:  # noqa: BLE001

@@ -73,6 +73,7 @@ from modelmesh_tpu.kv.jute import (
     write_acl_vector,
 )
 from modelmesh_tpu.kv.store import (
+    CasFailed,
     Compare,
     EventType,
     KeyValue,
@@ -490,13 +491,18 @@ class ZookeeperKV(KVStore):
         return _stat_to_kv(key, value, Stat.read(r))
 
     def _recreate_multi(self, key: str, value: bytes, flags: int,
-                        session: Optional[_ZkSession]) -> Optional[KeyValue]:
+                        session: Optional[_ZkSession],
+                        delete_version: int = -1) -> Optional[KeyValue]:
         """Atomic delete + create of one key (ZK cannot change a node's
         ephemerality or owner in place). None = the multi lost a race;
-        caller retries. ``session`` None targets the main session."""
+        caller retries. ``session`` None targets the main session.
+        ``delete_version`` guards the delete (ZK wire version, -1 =
+        unconditional) — callers repairing a specific committed write
+        pass the version they observed so they can never clobber a
+        LATER committed write."""
         w = Writer()
         MultiHeader(OP_DELETE, False, -1).write(w)
-        w.string(_esc(key)).int32(-1)
+        w.string(_esc(key)).int32(delete_version)
         MultiHeader(OP_CREATE2, False, -1).write(w)
         w.string(_esc(key)).buffer(value)
         write_acl_vector(w)
@@ -612,6 +618,103 @@ class ZookeeperKV(KVStore):
             return True
         except _ZkReplyError as e:
             if e.code == ERR_NO_NODE:
+                return False
+            raise
+
+    def put_if_version(
+        self, key: str, value: bytes, expected_version: int, lease: int = 0
+    ) -> KeyValue:
+        """CAS put as ONE native conditional setData RPC.
+
+        The generic txn-based implementation costs three round trips per
+        attempt (shape probe, multi, trailing get). On the shared
+        xid-serialized socket that made contended CAS loops *unfair*: a
+        loser's next attempt always queued its extra RPCs behind the
+        winner's next commit, so the same thread won every round and the
+        others livelocked until their retry budget ran out (the
+        update_or_create_retry_loop failure). ZK's setData takes the
+        expected version natively, so the conditional write — and the
+        resulting Stat — is a single round trip and every contender
+        re-enters the queue on equal footing.
+        """
+        if lease or expected_version <= 0:
+            # Creation (expected 0) and lease-binding writes keep the txn
+            # path: both need the create/ownership shape logic.
+            return super().put_if_version(key, value, expected_version, lease)
+        self.check_value_size(value)
+        try:
+            w = Writer()
+            w.string(_esc(key)).buffer(value).int32(expected_version - 1)
+            _, r = self._req(OP_SET_DATA, w.getvalue())
+        except _ZkReplyError as e:
+            if e.code in (ERR_BAD_VERSION, ERR_NO_NODE):
+                raise CasFailed(key) from None
+            raise
+        st = Stat.read(r)
+        if st.ephemeral_owner:
+            # The guarded write landed on a leased key: an unleased put
+            # DETACHES the lease (etcd/InMemoryKV contract) — recreate
+            # persistent, same as put()'s detach path. The value is ours
+            # (the CAS committed), only the ownership flag is repaired —
+            # so the delete is GUARDED on the ZK version our CAS
+            # produced: an unconditional delete+create could clobber a
+            # LATER committed write (a lost update on the one method
+            # whose whole contract is version-guarded writes).
+            zk_ver = st.version
+            for _ in range(8):
+                try:
+                    out = self._recreate_multi(
+                        key, value, 0, None, delete_version=zk_ver
+                    )
+                except _ZkReplyError as e:
+                    if e.code not in (ERR_NO_NODE, ERR_NODE_EXISTS,
+                                      ERR_BAD_VERSION):
+                        raise
+                    out = None
+                if out is not None:
+                    return out
+                # The guarded multi failed; re-read to find out why (the
+                # in-repo server reports multi op errors in the body, a
+                # real ensemble in the reply header — both land here).
+                cur = self.get(key)
+                if cur is None:
+                    # The owner expired and the ephemeral died with it
+                    # before the detach landed. Our CAS committed —
+                    # repair its persistence, guarded on absence.
+                    try:
+                        return self._create(key, value, None,
+                                            ephemeral=False)
+                    except _ZkReplyError as e:
+                        if e.code != ERR_NODE_EXISTS:
+                            raise
+                        continue  # a concurrent creator won; re-examine
+                if cur.value == value and not cur.lease:
+                    return cur  # another detacher repaired the ownership
+                if cur.value == value and cur.lease:
+                    # Still ours, still leased: the multi tripped on a
+                    # transient (e.g. a same-value republish bumped the
+                    # version) — re-guard on what is there NOW.
+                    zk_ver = cur.version - 1
+                    continue
+                # A NEWER write superseded our committed CAS before the
+                # detach landed: the current state is that writer's to
+                # shape. Our write DID commit — report it as observed.
+                return _stat_to_kv(key, value, st)
+            raise RuntimeError(
+                f"put_if_version({key!r}) lost detach races 8 times"
+            )
+        return _stat_to_kv(key, value, st)
+
+    def delete_if_version(self, key: str, expected_version: int) -> bool:
+        if expected_version <= 0:
+            return super().delete_if_version(key, expected_version)
+        try:
+            w = Writer()
+            w.string(_esc(key)).int32(expected_version - 1)
+            self._req(OP_DELETE, w.getvalue())
+            return True
+        except _ZkReplyError as e:
+            if e.code in (ERR_BAD_VERSION, ERR_NO_NODE):
                 return False
             raise
 
@@ -917,7 +1020,7 @@ class ZookeeperKV(KVStore):
                 try:
                     self._reconnect(failed=s)
                 except (ZkSessionLost, ConnectionError, OSError):
-                    self._closed.wait(0.3)
+                    self._closed.wait(0.3)  #: wall-clock: reconnect backoff against a real ensemble outage
                 continue
             if s is not self._mirror_session:
                 # The mirror's watches are armed on a PREVIOUS session —
@@ -929,7 +1032,7 @@ class ZookeeperKV(KVStore):
                     with self._watch_lock:
                         self._sync_mirror_locked(full=True)
                 except (ZkSessionLost, ConnectionError, OSError):
-                    self._closed.wait(0.3)
+                    self._closed.wait(0.3)  #: wall-clock: resync backoff against a real ensemble outage
                 continue
             try:
                 ev = s.watch_events.get(timeout=0.5)
@@ -1069,11 +1172,11 @@ class ZookeeperKV(KVStore):
     def wait_idle(self, timeout: float = 5.0) -> None:
         import time as _time
 
-        deadline = _time.monotonic() + timeout
-        _time.sleep(0.05)
-        while _time.monotonic() < deadline:
+        deadline = _time.monotonic() + timeout  #: wall-clock: test helper bounding REAL dispatcher-thread progress
+        _time.sleep(0.05)  #: wall-clock: lets the wire reader enqueue in-flight events
+        while _time.monotonic() < deadline:  #: wall-clock: same wall bound as above
             if self._session.watch_events.empty() and self._idle.is_set():
-                _time.sleep(0.05)
+                _time.sleep(0.05)  #: wall-clock: settle window before re-checking the queue
                 if self._session.watch_events.empty():
                     return
-            _time.sleep(0.02)
+            _time.sleep(0.02)  #: wall-clock: polls real dispatcher idleness

@@ -16,7 +16,6 @@ from __future__ import annotations
 import argparse
 import logging
 import threading
-import time
 from concurrent import futures
 from typing import Optional
 

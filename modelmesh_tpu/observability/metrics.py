@@ -15,7 +15,6 @@ import http.server
 import logging
 import socket
 import threading
-import time
 from typing import Optional, Sequence
 
 from modelmesh_tpu.utils.lockdebug import mm_lock
@@ -222,13 +221,13 @@ class PrometheusMetrics(Metrics):
 
     def inc(self, metric: Metric, value: float = 1.0, model_id: str = "") -> None:
         key = (metric.metric_name, self._label(model_id))
-        stripe = self._stripes[hash(key) & (_N_STRIPES - 1)]
+        stripe = self._stripes[hash(key) & (_N_STRIPES - 1)]  # analysis-ok: det-hash — order-free stripe sharding: render() merges every stripe, so WHICH stripe a key lands on is invisible
         with stripe.lock:
             stripe.counters[key] = stripe.counters.get(key, 0.0) + value
 
     def observe(self, metric: Metric, value_ms: float, model_id: str = "") -> None:
         key = (metric.metric_name, self._label(model_id))
-        stripe = self._stripes[hash(key) & (_N_STRIPES - 1)]
+        stripe = self._stripes[hash(key) & (_N_STRIPES - 1)]  # analysis-ok: det-hash — same order-free stripe sharding as inc()
         with stripe.lock:
             hist = stripe.hists.get(key)
             if hist is None:

@@ -135,7 +135,7 @@ class AsyncPayloadProcessor(PayloadProcessor):
         # must not race a closed delegate, and remaining queued payloads
         # are accounted as dropped rather than silently vanishing.
         for t in self._threads:
-            t.join(timeout=2.0)
+            t.join(timeout=2.0)  #: wall-clock: bounds REAL worker-thread teardown at close
         try:
             while True:
                 self._q.get_nowait()

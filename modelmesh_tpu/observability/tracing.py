@@ -110,7 +110,7 @@ class Tracer:
         self._ring: list[dict] = []  #: guarded-by: _lock
         # Unique-enough ids without uuid4-per-span: a per-tracer salt plus
         # a counter (itertools.count.__next__ is GIL-atomic).
-        self._salt = uuid.uuid4().hex[:6]
+        self._salt = uuid.uuid4().hex[:6]  # analysis-ok: det-entropy — once-per-tracer process-identity salt; sim assertions key on span STRUCTURE and propagated parent links, never on id values
         self._span_seq = itertools.count(1)
         self._sample_seq = itertools.count(1)
 

@@ -1066,8 +1066,8 @@ def dispatch_solve(
         solve_placement_incremental,
     )
 
-    t_start = time.perf_counter() if t_start is None else t_start
-    t_snapshot = time.perf_counter() if t_snapshot is None else t_snapshot
+    t_start = time.perf_counter() if t_start is None else t_start  #: wall-clock: perf_counter solve-timing metric
+    t_snapshot = time.perf_counter() if t_snapshot is None else t_snapshot  #: wall-clock: perf_counter solve-timing metric
     n_pad = _bucket(len(cols.model_ids))
     m_pad = _bucket(len(cols.instance_ids), 64)
     max_copies = int(cols.copies.max()) if len(cols.copies) else 1
@@ -1097,7 +1097,7 @@ def dispatch_solve(
         )
         return PendingSolve(
             cols=cols, sol=sol, t_start=t_start, t_snapshot=t_snapshot,
-            t_dispatch=time.perf_counter(), warm=True,
+            t_dispatch=time.perf_counter(), warm=True,  #: wall-clock: perf_counter solve-timing metric
             path="incremental", topk=cfg.topk, dirty_rows=len(d),
         )
 
@@ -1159,7 +1159,7 @@ def dispatch_solve(
     cfg_topk = getattr(config, "topk", 0) if config is not None else 0
     return PendingSolve(
         cols=cols, sol=sol, t_start=t_start, t_snapshot=t_snapshot,
-        t_dispatch=time.perf_counter(), warm=warm,
+        t_dispatch=time.perf_counter(), warm=warm,  #: wall-clock: perf_counter solve-timing metric
         path=path, topk=cfg_topk if sparse else 0,
     )
 
@@ -1170,7 +1170,7 @@ def finalize_plan(pending: PendingSolve) -> GlobalPlan:
 
     cols, sol = pending.cols, pending.sol
     sol = jax.block_until_ready(sol)
-    t2 = time.perf_counter()
+    t2 = time.perf_counter()  #: wall-clock: perf_counter solve-timing metric
     # Compact readback: u16 indices + per-row valid counts instead of the
     # raw i32[N,K] + bool[N,K] (2.1 MB vs 5.2 MB at the padded 100k tier —
     # the D2H link, not the solve, dominates the refresh on a remote
@@ -1199,7 +1199,7 @@ def finalize_plan(pending: PendingSolve) -> GlobalPlan:
     valid = np.arange(idxo.shape[1], dtype=np.uint8)[None, :] < counts[:, None]
     flat = idxo[valid]
     model_ids = [cols.model_ids[i] for i in order.tolist()]
-    t3 = time.perf_counter()
+    t3 = time.perf_counter()  #: wall-clock: perf_counter solve-timing metric
     plan = GlobalPlan.from_columnar(
         model_ids, counts, flat, cols.instance_ids, now_ms(),
         (t3 - pending.t_start) * 1e3,
@@ -1280,12 +1280,12 @@ def solve_plan(
     """
     if not models or not instances:
         return GlobalPlan({}, now_ms(), 0.0)
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  #: wall-clock: perf_counter solve-timing metric
     if cols is None:
         cols = snapshot_columns(
             models, instances, rpm_fn, constraints=constraints
         )
-    t1 = time.perf_counter()
+    t1 = time.perf_counter()  #: wall-clock: perf_counter solve-timing metric
     pending = dispatch_solve(
         cols, seed=seed, mesh=mesh, warm_g=warm_g, warm_price=warm_price,
         config=config, t_start=t0, t_snapshot=t1,
@@ -1660,7 +1660,7 @@ class JaxPlacementStrategy(PlacementStrategy):
             self._generation += 1
             delta = None
             if models and instances:
-                t0 = time.perf_counter()
+                t0 = time.perf_counter()  #: wall-clock: perf_counter solve-timing metric
                 cols, delta, dm, di = self._build_cols_locked(
                     models, instances, rpm_fn, incremental
                 )

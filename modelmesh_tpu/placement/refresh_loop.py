@@ -100,7 +100,7 @@ class PipelinedRefresher:
             # real refresh (transient empty views must not force cold).
             return self.drain()
         with strat._refresh_lock:
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()  #: wall-clock: perf_counter solve-timing metric
             cols, delta, _dm, _di = strat._build_cols_locked(
                 models, instances, rpm_fn, incremental
             )

@@ -91,7 +91,7 @@ class DrainController:
         # view epoch on every peer, so memoized serve routes recompute
         # and new placements exclude us from here on.
         inst.flightrec.record("drain", phase="advertise")
-        inst.draining = True
+        inst.set_draining(True)
         inst.publish_instance_record(force=True)
         deadline = clock.monotonic() + self.deadline_s
         recent_cutoff = now_ms() - self.hot_window_ms

@@ -115,8 +115,13 @@ class FakeRuntimeServicer:
         return rpb.ModelSizeResponse(size_bytes=size)
 
     def _size_for(self, model_id: str) -> int:
-        # Deterministic per-id size: default +/- up to 50%.
-        h = hash(model_id) % 1000
+        # Deterministic per-id size: default +/- up to 50%. A real
+        # digest, not builtin hash() — that one is salted per process,
+        # so "deterministic" sizes would diverge across test processes
+        # (same fix as SimLoader._size_for).
+        import zlib
+
+        h = zlib.crc32(model_id.encode()) % 1000
         return int(self.default_size_bytes * (0.5 + h / 1000.0))
 
     # -- inference ----------------------------------------------------------

@@ -159,7 +159,7 @@ def call_cancellable(callable_, request, timeout=None, metadata=None,
     fut = callable_.future(request, timeout=timeout, metadata=metadata)
     done = threading.Event()
     fut.add_done_callback(lambda _f: done.set())
-    while not done.wait(0.05):
+    while not done.wait(0.05):  #: wall-clock: polls a REAL in-flight gRPC future at cancel-check cadence
         if cancel_event.is_set():
             fut.cancel()
             raise RequestCancelledError("client disconnected")

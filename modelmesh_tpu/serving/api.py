@@ -471,7 +471,7 @@ class InferenceFallback:
         # fires on normal completion — harmless, the request is done.)
         cancel_event = threading.Event()
         context.add_callback(cancel_event.set)
-        t0 = _time.perf_counter()
+        t0 = _time.perf_counter()  #: wall-clock: perf_counter latency metric (API request time)
         metrics.observe(MX.REQUEST_BYTES, len(request), model_id)
         try:
             with self.log_headers.bind(md.items()), self.instance.tracer.trace(
@@ -483,7 +483,7 @@ class InferenceFallback:
                 )
             metrics.observe(MX.RESPONSE_BYTES, len(result.payload), model_id)
             metrics.observe(
-                MX.API_REQUEST_TIME, (_time.perf_counter() - t0) * 1e3,
+                MX.API_REQUEST_TIME, (_time.perf_counter() - t0) * 1e3,  #: wall-clock: perf_counter latency metric
                 model_id=model_id,
             )
             if proc is not None:
@@ -548,7 +548,7 @@ class InferenceFallback:
         self._observe_payload(req_id, model_ids, method, "request", request, "OK")
         cancel_event = threading.Event()
         context.add_callback(cancel_event.set)
-        t0 = _time.perf_counter()
+        t0 = _time.perf_counter()  #: wall-clock: perf_counter latency metric (API request time)
         # Adopted ids always trace; a fan-out without one is sampled like
         # any minted root (maybe_mint, not uuid4: no per-request entropy
         # I/O, and sampled-out fan-outs skip tracing entirely instead of
@@ -602,7 +602,7 @@ class InferenceFallback:
             )
             context.abort(code, f"multi-model {mid}: {e}")
         metrics.observe(
-            MX.API_REQUEST_TIME, (_time.perf_counter() - t0) * 1e3,
+            MX.API_REQUEST_TIME, (_time.perf_counter() - t0) * 1e3,  #: wall-clock: perf_counter latency metric
             model_id=model_ids,
         )
         self._observe_payload(

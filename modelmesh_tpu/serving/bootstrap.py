@@ -18,11 +18,11 @@ from __future__ import annotations
 import http.server
 import json
 import logging
-import os
 import threading
 from typing import Optional
 
 from modelmesh_tpu.cache.lru import now_ms
+from modelmesh_tpu.utils import envs
 from modelmesh_tpu.runtime.spi import ModelInfo
 from modelmesh_tpu.serving.errors import ReadOnlyModeError
 from modelmesh_tpu.serving.instance import ModelMeshInstance
@@ -52,8 +52,9 @@ def register_static_models(
     Returns the list of registered model ids; raises RuntimeError if
     ``verify`` and any declared model fails to load.
     """
-    text = config_json if config_json is not None else os.environ.get(
-        STATIC_MODELS_ENV, ""
+    text = (
+        config_json if config_json is not None
+        else envs.get(STATIC_MODELS_ENV) or ""
     )
     if not text.strip():
         return []

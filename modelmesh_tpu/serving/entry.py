@@ -90,6 +90,11 @@ class CacheEntry:
         self.info = info
         self.weight_units = weight_units
         self.last_used = last_used if last_used is not None else now_ms()
+        # Every transition goes through the ONE funnel (PR-8): bare
+        # writes would skip the terminal-state check, the cv broadcast,
+        # and the flight-recorder event — the state-funnel rule flags
+        # them.
+        #: state-funnel: _transition_locked
         self.state = EntryState.NEW  #: guarded-by: _lock [rebind]
         self.error: Optional[str] = None  #: guarded-by: _lock
         self.loaded: Optional[LoadedModel] = None
@@ -272,12 +277,12 @@ class CacheEntry:
                 # queueing for the slot immediately.
                 import time as _t
 
-                deadline = _t.monotonic() + (timeout_s or 30.0)
+                deadline = _t.monotonic() + (timeout_s or 30.0)  #: wall-clock: slices a REAL semaphore acquire at cancel-check cadence; the waker is a real thread's release, not virtual time
                 acquired = False
                 while not acquired:
                     if cancel_event.is_set():
                         return False
-                    remaining = deadline - _t.monotonic()
+                    remaining = deadline - _t.monotonic()  #: wall-clock: same wall bound as above
                     if remaining <= 0:
                         return False
                     acquired = sem.acquire(timeout=min(0.05, remaining))

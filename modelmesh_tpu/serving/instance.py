@@ -173,7 +173,7 @@ class InstanceConfig:
         trace_sample: Optional[int] = None,
         slo_spec: Optional[str] = None,
     ):
-        self.instance_id = instance_id or f"i-{uuid.uuid4().hex[:8]}"
+        self.instance_id = instance_id or f"i-{uuid.uuid4().hex[:8]}"  # analysis-ok: det-entropy — deliberately unique process identity; every replay-bearing path (sim, scenarios) passes an explicit instance_id
         self.kv_prefix = kv_prefix.rstrip("/")
         self.endpoint = endpoint
         self.zone = zone
@@ -302,6 +302,9 @@ class ModelMeshInstance:
         # Graceful drain in progress (reconfig/drain.py): advertised in
         # the instance record so peers stop placing here and deprioritize
         # us as a serve target while the drain pre-copies to survivors.
+        # Written only through set_draining so every drain-state flip
+        # lands in the flight recorder (state-funnel rule).
+        #: state-funnel: set_draining
         self.draining = False
         # Dynamic config `log_each_invocation`.
         self.log_each_invocation = False
@@ -597,6 +600,19 @@ class ModelMeshInstance:
             labels=list(self.config.labels),
             instance_version=self.config.instance_version,
         )
+
+    def set_draining(self, value: bool) -> None:
+        """The ONE write funnel for the ``draining`` flag (state-funnel
+        rule, like ``CacheEntry._transition_locked``): every drain-state
+        flip lands in the flight recorder, so a shutdown investigation
+        can see exactly when the instance stopped accepting placements.
+        Callers publish the record themselves — flipping and advertising
+        are separate steps by design (the drain controller forces the
+        publish so the epoch bump is immediate)."""
+        prev = self.draining
+        self.draining = value
+        if prev != value:
+            self.flightrec.record("drain-flag", to=str(value).lower())
 
     def publish_instance_record(self, force: bool = False) -> None:
         """Refresh our advertisement; suppress no-op updates (reference
@@ -1132,12 +1148,12 @@ class ModelMeshInstance:
             # waited (reference cache-miss-delay metric). A PARTIAL
             # streamed copy is already servable — no miss recorded.
             self.metrics.inc(MX.CACHE_MISS_COUNT, model_id=ce.model_id)
-            t_wait = _time.perf_counter()
+            t_wait = _time.perf_counter()  #: wall-clock: perf_counter latency metric (load-wait stage)
             with self.tracer.span("load-wait", model=ce.model_id):
                 ok = self._wait_entry_active(ce, cancel_event=cancel_event)
             self.metrics.observe(
                 MX.CACHE_MISS_DELAY,
-                (_time.perf_counter() - t_wait) * 1e3, ce.model_id,
+                (_time.perf_counter() - t_wait) * 1e3, ce.model_id,  #: wall-clock: perf_counter latency metric
             )
             if not ok:
                 raise ModelLoadException(
@@ -1164,7 +1180,7 @@ class ModelMeshInstance:
                 raise RequestCancelledError(ce.model_id)
             raise ModelLoadException(f"{ce.model_id}: concurrency gate timeout")
         try:
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  #: wall-clock: perf_counter latency metric (runtime invoke)
             with self.tracer.span("runtime-call", model=ce.model_id):
                 if self._runtime_call_cancellable:
                     out = self._runtime_call(
@@ -1173,7 +1189,7 @@ class ModelMeshInstance:
                     )
                 else:
                     out = self._runtime_call(ce, method, payload, headers)
-            ce.record_latency((_time.perf_counter() - t0) * 1e3)
+            ce.record_latency((_time.perf_counter() - t0) * 1e3)  #: wall-clock: perf_counter latency metric
             self.rate.record()
             self._model_rate(ce.model_id).record()
             self.cache.get(ce.model_id)  # LRU touch
@@ -1534,10 +1550,10 @@ class ModelMeshInstance:
                     self._correct_sizing(ce, loaded)
                 return
             if not size_bytes and ce.try_transition(EntryState.SIZING):
-                t_size = _time.perf_counter()
+                t_size = _time.perf_counter()  #: wall-clock: perf_counter latency metric (sizing)
                 size_bytes = self.loader.model_size(model_id, loaded.handle)
                 self.metrics.observe(
-                    MX.SIZING_TIME, (_time.perf_counter() - t_size) * 1e3,
+                    MX.SIZING_TIME, (_time.perf_counter() - t_size) * 1e3,  #: wall-clock: perf_counter latency metric
                     model_id,
                 )
             if size_bytes:
@@ -1609,10 +1625,10 @@ class ModelMeshInstance:
         copy is never touched."""
         model_id = ce.model_id
         try:
-            t_size = _time.perf_counter()
+            t_size = _time.perf_counter()  #: wall-clock: perf_counter latency metric (overlapped sizing)
             size_bytes = self.loader.model_size(model_id, loaded.handle)
             self.metrics.observe(
-                MX.SIZING_TIME, (_time.perf_counter() - t_size) * 1e3,
+                MX.SIZING_TIME, (_time.perf_counter() - t_size) * 1e3,  #: wall-clock: perf_counter latency metric
                 model_id,
             )
         except Exception as e:  # noqa: BLE001 — keep serving on prediction

@@ -151,7 +151,7 @@ def generate_self_signed(
     name = x509.Name(
         [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
     )
-    now = datetime.datetime.now(datetime.timezone.utc)
+    now = datetime.datetime.now(datetime.timezone.utc)  #: wall-clock: X.509 validity window — peers check it against REAL time; a virtual timestamp would mint an expired cert
     cert = (
         x509.CertificateBuilder()
         .subject_name(name)

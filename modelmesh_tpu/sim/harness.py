@@ -638,12 +638,12 @@ class SimCluster:
         stuck mutation would have."""
         import time as _wall
 
-        deadline = _wall.monotonic() + wall_timeout_s
+        deadline = _wall.monotonic() + wall_timeout_s  #: wall-clock: bounds REAL pool-thread progress (docstring above) — the clock is the thing being pumped here
         while self.pools_pending():
-            if _wall.monotonic() >= deadline:
+            if _wall.monotonic() >= deadline:  #: wall-clock: same wall bound as above
                 return False
             clock.advance(step_ms)
-            _wall.sleep(0.001)
+            _wall.sleep(0.001)  #: wall-clock: yields to real pool threads between virtual pumps
         return True
 
     # -- teardown ----------------------------------------------------------

@@ -280,7 +280,7 @@ class ScenarioRunner:
 
     def run(self) -> ScenarioResult:
         sc = self.scenario
-        t_wall = _wall.perf_counter()
+        t_wall = _wall.perf_counter()  #: wall-clock: reports the run's REAL duration (ScenarioResult.wall_s)
         clock = _clock.VirtualClock()
         cluster = None
         # installed() restores the previous clock and closes this one on
@@ -313,7 +313,7 @@ class ScenarioRunner:
                         self._fire(cluster, clock, events[idx])
                         idx += 1
                     clock.advance(self.step_ms)
-                    _wall.sleep(self.yield_s)
+                    _wall.sleep(self.yield_s)  #: wall-clock: yields the advancing thread so product threads run between virtual steps
                 for ev in events[idx:]:
                     self._fire(cluster, clock, ev)
                 # Quiesce: heal every partition (a permanently-partitioned
@@ -331,13 +331,13 @@ class ScenarioRunner:
                 end = clock.now_ms() + quiesce
                 while clock.now_ms() < end:
                     clock.advance(self.step_ms)
-                    _wall.sleep(self.yield_s)
+                    _wall.sleep(self.yield_s)  #: wall-clock: same advancing-thread yield as the event loop
                 # Disarm injected latency/conflicts: the invariant suite
                 # (and teardown) reads through the same facades on THIS
                 # thread.
                 cluster.kv.config = SimKVConfig()
                 for t in self._workers:
-                    t.join(timeout=5.0)
+                    t.join(timeout=5.0)  #: wall-clock: bounds REAL worker-thread teardown at quiesce
                 cluster.kv.inner.wait_idle(timeout=10.0)
                 if sc.quiesce_async:
                     # Async-mutation drain (the registry_cache_convergence
@@ -357,7 +357,7 @@ class ScenarioRunner:
                             # best-effort; invariants report what remains
                             log.exception("quiesce janitor cycle failed")
                     cluster.kv.inner.wait_idle(timeout=5.0)
-                _wall.sleep(0.05)  # drain listener fan-out
+                _wall.sleep(0.05)  #: wall-clock: lets real listener fan-out threads drain before invariants read
                 grace_ms = tc.assume_gone_ms + int(
                     tc.reaper_interval_s * 2000
                 )
@@ -386,7 +386,7 @@ class ScenarioRunner:
                     seed=sc.seed,
                     trace=self.trace,
                     verdicts=verdicts,
-                    wall_s=_wall.perf_counter() - t_wall,
+                    wall_s=_wall.perf_counter() - t_wall,  #: wall-clock: reports the run's REAL duration
                     flight_records=flight,
                 )
             finally:

@@ -140,7 +140,7 @@ class WeightTransferManager:
             snap = None
         if snap is not None:
             try:
-                t0 = _time.perf_counter()
+                t0 = _time.perf_counter()  #: wall-clock: perf_counter transfer-throughput metric
                 loaded = loader.load_from_stream(
                     model_id, info, iter(snap.chunks),
                     partial_ready=partial_cb,
@@ -148,7 +148,7 @@ class WeightTransferManager:
                 self._record_transfer(
                     model_id, MX.LOAD_FROM_HOST_TIER_COUNT,
                     sum(len(c.payload) for c in snap.chunks),
-                    _time.perf_counter() - t0,
+                    _time.perf_counter() - t0,  #: wall-clock: perf_counter transfer-throughput metric
                 )
                 return loaded, "host"
             except Exception as e:  # noqa: BLE001 — poisoned snapshot
@@ -237,7 +237,7 @@ class WeightTransferManager:
                 raise TransferUnavailable(sender_iid)
             total = first.total_chunks
             rx = {"bytes": len(first.payload)}
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter()  #: wall-clock: perf_counter transfer-throughput metric
 
             def chunks():
                 yield first.to_chunk()
@@ -264,7 +264,7 @@ class WeightTransferManager:
             sp["bytes"] = rx["bytes"]
         self._record_transfer(
             model_id, MX.LOAD_FROM_PEER_COUNT, rx["bytes"],
-            _time.perf_counter() - t0,
+            _time.perf_counter() - t0,  #: wall-clock: perf_counter transfer-throughput metric
         )
         return loaded, "peer"
 

@@ -315,10 +315,17 @@ def get_clock() -> Clock:
 def install(clock: Clock) -> Clock:
     """Install ``clock`` process-wide; returns the previous one. Construct
     every simulated component AFTER installing — events and lease
-    deadlines are created against the clock live at construction."""
+    deadlines are created against the clock live at construction.
+
+    Installing a VirtualClock with MM_CLOCK_DEBUG=1 arms the runtime
+    clock-discipline witness (utils/clockdebug.py); installing anything
+    else disarms it."""
     global _clock
     prev = _clock
     _clock = clock
+    from modelmesh_tpu.utils import clockdebug
+
+    clockdebug.on_clock_installed(clock)
     return prev
 
 

@@ -258,6 +258,23 @@ REGISTRY: dict[str, EnvVar] = {
                "faults, drain phases); 0 disables recording; dump via "
                "the ***FLIGHTREC*** diagnostic id",
                "observability/flightrec.py"),
+        EnvVar("MM_CLOCK_DEBUG", "bool", "0",
+               "runtime witness for the clock-discipline static rule: "
+               "while a VirtualClock is installed, wall-clock reads "
+               "(time.time/monotonic/sleep/perf_counter) from "
+               "modelmesh_tpu code raise WallClockViolation unless the "
+               "call line carries a `#: wall-clock: <reason>` "
+               "annotation — the same grammar the static analyzer "
+               "enforces; read at clock-install time. Debug/test aid, "
+               "not for production", "utils/clockdebug.py"),
+        # Not an MM_ knob, but the registry documents every env var the
+        # process READS: JAX owns the name, utils/platform.py re-asserts
+        # it over sitecustomize's config-level override.
+        EnvVar("JAX_PLATFORMS", "str", "",
+               "standard JAX platform selector; honor_platform_env() "
+               "re-asserts it over a PJRT-plugin sitecustomize override "
+               "so JAX_PLATFORMS=cpu test/bench runs stay on CPU",
+               "utils/platform.py"),
     ]
 }
 

@@ -9,7 +9,7 @@ benches, virtual-device dry runs) calls this before touching JAX backends.
 
 from __future__ import annotations
 
-import os
+from modelmesh_tpu.utils import envs
 
 
 def honor_platform_env() -> None:
@@ -18,7 +18,7 @@ def honor_platform_env() -> None:
     No-op when the variable is unset. Must run before the first backend
     initialization (jax.devices() / first op).
     """
-    plats = os.environ.get("JAX_PLATFORMS", "")
+    plats = envs.get("JAX_PLATFORMS") or ""
     if not plats:
         return
     import jax

@@ -285,6 +285,113 @@ class TestTable:
         view.close()
 
 
+class TestZkNativeCas:
+    """Regression for the update_or_create_retry_loop livelock: the ZK
+    backend's CAS must be ONE native conditional setData round trip, not
+    the generic probe+multi+get txn — three RPCs per attempt on the
+    shared xid-serialized socket made contended retry loops unfair (the
+    loser's extra round trips always queued behind the winner's next
+    commit, so the same thread won every round)."""
+
+    @pytest.fixture()
+    def zk(self):
+        from modelmesh_tpu.kv.zk_server import ZkWireServer
+        from modelmesh_tpu.kv.zookeeper import ZookeeperKV
+
+        server = ZkWireServer().start()
+        client = ZookeeperKV(f"127.0.0.1:{server.port}")
+        yield client
+        client.close()
+        server.stop()
+
+    def test_guarded_update_is_one_round_trip(self, zk):
+        zk.put("cas/k", b"v1")
+        calls = []
+        orig = zk._req
+
+        def counting_req(op, payload, timeout=10.0):
+            calls.append(op)
+            return orig(op, payload, timeout)
+
+        zk._req = counting_req
+        out = zk.put_if_version("cas/k", b"v2", expected_version=1)
+        assert len(calls) == 1, calls
+        assert out.version == 2 and out.value == b"v2"
+        zk._req = orig
+        assert zk.get("cas/k").value == b"v2"
+
+    def test_version_conflict_raises_cas_failed(self, zk):
+        zk.put("cas/k", b"v1")
+        zk.put("cas/k", b"v2")  # version now 2
+        with pytest.raises(CasFailed):
+            zk.put_if_version("cas/k", b"x", expected_version=1)
+        assert zk.get("cas/k").value == b"v2"
+
+    def test_absent_key_conflicts_and_create_still_works(self, zk):
+        with pytest.raises(CasFailed):
+            zk.put_if_version("cas/none", b"x", expected_version=3)
+        created = zk.put_if_version("cas/none", b"x", expected_version=0)
+        assert created.version == 1
+
+    def test_delete_if_version(self, zk):
+        zk.put("cas/d", b"v1")
+        assert not zk.delete_if_version("cas/d", 7)
+        assert zk.get("cas/d") is not None
+        assert zk.delete_if_version("cas/d", 1)
+        assert zk.get("cas/d") is None
+        assert not zk.delete_if_version("cas/d", 1)  # already gone
+
+    def test_leased_key_cas_detaches_the_lease(self, zk):
+        lease = zk.lease_grant(30.0)
+        zk.put("cas/l", b"v1", lease=lease)
+        out = zk.put_if_version("cas/l", b"v2", expected_version=1)
+        assert out.value == b"v2" and out.lease == 0
+        cur = zk.get("cas/l")
+        assert cur.value == b"v2" and cur.lease == 0
+        zk.lease_revoke(lease)
+        zk.wait_idle()
+        assert zk.get("cas/l") is not None  # persistent survives revoke
+
+    def test_detach_never_clobbers_newer_committed_write(
+        self, zk, monkeypatch
+    ):
+        """The detach's delete+create is GUARDED on the version our CAS
+        produced: a concurrent writer committing a NEWER CAS between our
+        setData and our detach multi must win — an unconditional delete
+        would silently destroy its acknowledged write (lost update)."""
+        from modelmesh_tpu.kv.zookeeper import ZookeeperKV
+
+        lease = zk.lease_grant(30.0)
+        zk.put("cas/r", b"v1", lease=lease)
+        real = zk._recreate_multi
+        raced = []
+
+        def racing(key, value, flags, session, delete_version=-1):
+            if not raced:
+                raced.append(1)
+                # A second client commits a NEWER CAS before our detach
+                # lands (its own detach completes inline).
+                other = ZookeeperKV(zk._endpoint)
+                try:
+                    got = other.put_if_version(
+                        "cas/r", b"winner", expected_version=2
+                    )
+                    assert got.value == b"winner"
+                finally:
+                    other.close()
+            return real(key, value, flags, session,
+                        delete_version=delete_version)
+
+        monkeypatch.setattr(zk, "_recreate_multi", racing)
+        out = zk.put_if_version("cas/r", b"v2", expected_version=1)
+        assert out.value == b"v2"  # our CAS did commit...
+        final = zk.get("cas/r")
+        assert final.value == b"winner", (
+            "detach clobbered a newer committed write"
+        )
+        assert raced and final.lease == 0
+
+
 class TestSession:
     def test_session_node_lives_and_dies(self, kv):
         node = SessionNode(kv, "instances/i1", b"rec", ttl_s=0.3)

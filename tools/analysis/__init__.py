@@ -1,6 +1,7 @@
-"""Concurrency & JAX-hazard static analysis for modelmesh_tpu.
+"""Concurrency, determinism & JAX-hazard static analysis for
+modelmesh_tpu.
 
-Four rule families tuned to this codebase (see docs/static-analysis.md):
+Eight rule families tuned to this codebase (see docs/static-analysis.md):
 
 - ``guarded-by``      writes to ``#: guarded-by:``-annotated attributes
                       must happen while the named lock is held
@@ -13,8 +14,22 @@ Four rule families tuned to this codebase (see docs/static-analysis.md):
                       ``tools/analysis/lock_order.txt``
 - ``jax-*``           tracer leaks, device sync inside lock regions,
                       unordered dict/set iteration feeding jitted code
+- ``clock-discipline``  logical time reads through utils/clock.py;
+                      deliberate wall-time sites carry
+                      ``#: wall-clock: <reason>`` (enforced dynamically
+                      too by MM_CLOCK_DEBUG=1)
+- ``det-*``           unseeded global-RNG draws / uuid4 / os.urandom,
+                      salted builtin hash() derivation, unordered set
+                      iteration in replay-bearing code
+- ``state-funnel``    ``#: state-funnel:``-annotated state-machine
+                      fields are written only via their transition
+                      methods
+- ``env-*``           direct os.environ reads outside utils/envs.py,
+                      registered-but-undocumented and
+                      registered-but-never-read knobs
 
 Run: ``python -m tools.analysis modelmesh_tpu/``
+(``--only clock,env`` for a fast subset)
 """
 
 from tools.analysis.core import (  # noqa: F401

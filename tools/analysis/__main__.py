@@ -38,18 +38,43 @@ def main(argv=None) -> int:
     ap.add_argument("--write-lock-order", action="store_true",
                     help="regenerate tools/analysis/lock_order.txt from "
                          "the derived acquisition graph")
+    ap.add_argument("--lock-order-file", default=None,
+                    help="lock-order file to check/write instead of "
+                         "tools/analysis/lock_order.txt")
+    ap.add_argument("--only", default=None, metavar="FAMILY[,FAMILY...]",
+                    help="run only these rule families for fast local "
+                         "iteration; known: " + ", ".join(core.FAMILY_KEYS))
     args = ap.parse_args(argv)
 
     paths = args.paths or [os.path.join(root, "modelmesh_tpu")]
+    only = None
+    if args.only:
+        only = [s.strip() for s in args.only.split(",") if s.strip()]
+    if only and args.update_baseline:
+        # A partial run sees only the selected families' findings;
+        # rewriting the SHARED baseline from it would silently drop
+        # every other family's justified entries.
+        print("error: --update-baseline requires a full run "
+              "(drop --only)", file=sys.stderr)
+        return 2
 
     if args.write_lock_order:
         ctx = core.build_context(paths, root)
-        out = os.path.join(root, lockorder.DEFAULT_ORDER_FILE)
+        out = args.lock_order_file or os.path.join(
+            root, lockorder.DEFAULT_ORDER_FILE
+        )
         lockorder.write_order_file(ctx, out)
         print(f"wrote {os.path.relpath(out, root)}")
         return 0
 
-    findings = core.run_analysis(paths, repo_root=root)
+    try:
+        findings = core.run_analysis(
+            paths, repo_root=root,
+            lock_order_path=args.lock_order_file, only=only,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
 
     if args.update_baseline:
         core.write_baseline(args.baseline, findings)

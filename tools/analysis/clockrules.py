@@ -1,0 +1,142 @@
+"""Rule family 5 — ``clock-discipline``: logical time goes through the
+injectable clock (``utils/clock.py``), wall time is opt-in and declared.
+
+The PR-5 guarantee — a scenario trace is a pure function of
+``(seed, virtual time)`` — holds only while every *logical*-time call
+site reads through ``get_clock()``. A single bare ``time.time()`` in a
+cadence, lease, or timeout path silently breaks bit-for-bit replay, and
+nothing in review distinguishes it from the deliberate wall-time sites
+(wire I/O pacing, perf_counter metrics, real-thread-progress bounds).
+This rule makes the distinction machine-checked:
+
+Flagged unless the line (or the line above) carries an explicit
+``#: wall-clock: <reason>`` annotation:
+
+- ``time.time/monotonic/sleep/perf_counter`` (and ``*_ns`` twins)
+  through the module receivers this codebase uses (``time``, ``_time``,
+  ``_t``, ``_wall``);
+- ``datetime.now/utcnow/today`` — wall-time reads with extra steps;
+- ``threading.Timer(...)`` — one-shot timers must be
+  ``clock.call_later`` so virtual time can fire them;
+- timed waits with a **literal** timeout: ``x.wait(0.5)`` /
+  ``x.join(timeout=2.0)`` — an Event/Condition/thread wait bounded by a
+  hard-coded wall interval is either a logical wait that should be
+  ``clock.wait_event``/``cond_wait`` or a deliberate wall bound that
+  must say so. (Non-literal timeouts are out of scope: the budget's
+  origin decides, and the rule cannot see it.)
+
+``utils/clock.py`` itself is exempt (it IS the seam), as is
+``utils/clockdebug.py`` (the runtime witness that enforces the same
+annotation grammar dynamically under ``MM_CLOCK_DEBUG=1``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.analysis.core import (
+    AnalysisContext,
+    Finding,
+    ModuleInfo,
+    receiver_and_attr,
+)
+
+RULE = "clock-discipline"
+
+# The aliases `import time as X` goes by in this codebase. Receiver-name
+# based by design, like the blocking rule: tuned to local naming.
+TIME_RECEIVERS = frozenset({"time", "_time", "_t", "_wall"})
+TIME_FNS = frozenset({
+    "time", "monotonic", "sleep", "perf_counter", "process_time",
+    "time_ns", "monotonic_ns", "perf_counter_ns",
+})
+DATETIME_FNS = frozenset({"now", "utcnow", "today"})
+WAIT_FNS = frozenset({"wait", "join"})
+
+EXEMPT_SUFFIXES = (
+    "modelmesh_tpu/utils/clock.py",
+    "modelmesh_tpu/utils/clockdebug.py",
+)
+
+
+def _literal_timeout(node: ast.Call) -> Optional[float]:
+    """The numeric literal bounding a .wait()/.join() call, if any."""
+    candidates = list(node.args[:1]) + [
+        kw.value for kw in node.keywords if kw.arg == "timeout"
+    ]
+    for arg in candidates:
+        if isinstance(arg, ast.Constant) and isinstance(
+            arg.value, (int, float)
+        ) and not isinstance(arg.value, bool):
+            return float(arg.value)
+    return None
+
+
+def _classify(node: ast.Call) -> Optional[tuple[str, str]]:
+    """-> (token, message) when the call is a wall-clock construct."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "Timer":
+            return ("Timer()",
+                    "threading.Timer one-shot — use clock.call_later so "
+                    "virtual time can fire it")
+        return None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    ra = receiver_and_attr(fn)
+    if ra is None:
+        return None
+    recv, method = ra
+    token = f"{recv}.{method}"
+    if recv in TIME_RECEIVERS and method in TIME_FNS:
+        return (token,
+                f"bare {token}() — logical time must read through "
+                f"utils.clock.get_clock() (now_ms/monotonic/sleep) or "
+                f"declare `#: wall-clock: <reason>`")
+    if recv == "datetime" and method in DATETIME_FNS:
+        return (token,
+                f"{token}() is a wall-clock read — route logical "
+                f"timestamps through the clock or declare "
+                f"`#: wall-clock: <reason>`")
+    if recv == "threading" and method == "Timer":
+        return ("threading.Timer",
+                "threading.Timer one-shot — use clock.call_later so "
+                "virtual time can fire it")
+    if method in WAIT_FNS:
+        timeout = _literal_timeout(node)
+        if timeout is not None and recv not in ("clock", "path", "os"):
+            return (f"{token}({timeout:g})",
+                    f"timed {token}() with a literal timeout — a logical "
+                    f"wait belongs on clock.wait_event/cond_wait; a "
+                    f"deliberate wall bound declares "
+                    f"`#: wall-clock: <reason>`")
+    return None
+
+
+def _check_module(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    # One shared walk covers function bodies AND module/class-level
+    # import-time calls, each node exactly once (no double-visit of
+    # nested defs).
+    for node, qual in mod.walked():
+        if not isinstance(node, ast.Call):
+            continue
+        hit = _classify(node)
+        if hit is None or mod.wall_clock_ok(node.lineno):
+            continue
+        token, message = hit
+        findings.append(Finding(
+            rule=RULE, path=mod.relpath, line=node.lineno,
+            qualname=qual, token=token, message=message,
+        ))
+    return findings
+
+
+def check(ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in ctx.modules:
+        if mod.relpath.endswith(EXEMPT_SUFFIXES):
+            continue
+        findings += _check_module(mod)
+    return findings

@@ -21,6 +21,15 @@ Conventions understood across the rules:
   them as lock-held regions.
 - ``# analysis-ok: <rule>[, <rule>...] — <justification>`` on (or
   immediately above) a line suppresses the named rules for that line.
+- ``#: wall-clock: <reason>`` on (or immediately above) a line declares
+  a DELIBERATE wall-time call site (wire I/O pacing, perf_counter
+  metrics, real-thread-progress bounds) for the clock-discipline rule —
+  and for the MM_CLOCK_DEBUG runtime witness, which reads the same
+  grammar from source at call time (utils/clockdebug.py).
+- ``#: state-funnel: <method>[, <method>...]`` on (or immediately
+  above) an attribute assignment declares a state-machine field whose
+  every write outside the named transition methods (the "funnel") is a
+  finding; ``__init__``-family constructors are exempt.
 """
 
 from __future__ import annotations
@@ -37,6 +46,13 @@ LOCKED_SUFFIX = "_locked"
 
 _ANNOTATION_RE = re.compile(
     r"#:\s*guarded-by:\s*(?P<lock>\w+)\s*(?:\[(?P<mode>\w+)\])?"
+)
+# Shared with the MM_CLOCK_DEBUG runtime witness (utils/clockdebug.py),
+# which greps the same grammar out of source at call time — keep the
+# two in sync or the static and dynamic checks stop pinning each other.
+WALL_CLOCK_RE = re.compile(r"#:\s*wall-clock:\s*(?P<why>\S.*)$")
+_STATE_FUNNEL_RE = re.compile(
+    r"#:\s*state-funnel:\s*(?P<methods>\w+(?:\s*,\s*\w+)*)"
 )
 # Rule names contain single hyphens, so the justification separator is
 # an em/en dash or a double hyphen: "# analysis-ok: <rules> — <why>".
@@ -79,6 +95,15 @@ class Annotation:
 
 
 @dataclass
+class FunnelAnnotation:
+    attr: str
+    methods: tuple[str, ...]   # the only methods allowed to write
+    cls: str
+    path: str
+    line: int
+
+
+@dataclass
 class ModuleInfo:
     path: str                      # absolute
     relpath: str                   # repo-relative
@@ -87,6 +112,39 @@ class ModuleInfo:
     lines: list[str] = field(default_factory=list)
     # line -> set of suppressed rule names ("*" = all)
     suppressions: dict[int, set[str]] = field(default_factory=dict)
+    # line -> justification for a deliberate wall-clock call site
+    wall_clock: dict[int, str] = field(default_factory=dict)
+    # lazily-built shared walk: every node paired with its innermost
+    # enclosing function qualname (see walked())
+    _walked: Optional[list] = field(default=None, repr=False)
+
+    def walked(self) -> list[tuple[ast.AST, str]]:
+        """Every AST node paired with the qualname of its innermost
+        enclosing function ('Cls.fn', or '<module>' outside any def).
+        Computed once and shared by the rule families whose traversal is
+        a flat node scan (clock-discipline, det-*, env-direct-read) —
+        one tree walk instead of one per family per scope."""
+        if self._walked is None:
+            out: list[tuple[ast.AST, str]] = []
+
+            def walk(node: ast.AST, cls: str, func: str) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, ast.ClassDef):
+                        out.append((child, func))
+                        walk(child, child.name, func)
+                    elif isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        out.append((child, func))
+                        q = f"{cls}.{child.name}" if cls else child.name
+                        walk(child, cls, q)
+                    else:
+                        out.append((child, func))
+                        walk(child, cls, func)
+
+            walk(self.tree, "", "<module>")
+            self._walked = out
+        return self._walked
 
     def suppressed(self, rule: str, line: int) -> bool:
         for ln in (line, line - 1):
@@ -94,6 +152,11 @@ class ModuleInfo:
             if rules and ("*" in rules or rule in rules):
                 return True
         return False
+
+    def wall_clock_ok(self, line: int) -> bool:
+        """A ``#: wall-clock:`` annotation on the line or the line above
+        declares the call deliberately wall-time."""
+        return line in self.wall_clock or (line - 1) in self.wall_clock
 
 
 class LockRegistry:
@@ -113,6 +176,10 @@ class LockRegistry:
         self.annotations: dict[str, dict[str, Annotation]] = {}
         # attr -> annotations across all classes (cross-object writes)
         self.annotations_by_attr: dict[str, list[Annotation]] = {}
+        # class -> {attr: FunnelAnnotation} (state-machine write funnels)
+        self.funnels: dict[str, dict[str, FunnelAnnotation]] = {}
+        # attr -> funnel annotations across all classes
+        self.funnels_by_attr: dict[str, list[FunnelAnnotation]] = {}
 
     def add_lock(self, cls: str, attr: str) -> None:
         self.class_locks.setdefault(cls, set()).add(attr)
@@ -122,6 +189,10 @@ class LockRegistry:
     def add_annotation(self, ann: Annotation) -> None:
         self.annotations.setdefault(ann.cls, {})[ann.attr] = ann
         self.annotations_by_attr.setdefault(ann.attr, []).append(ann)
+
+    def add_funnel(self, ann: FunnelAnnotation) -> None:
+        self.funnels.setdefault(ann.cls, {})[ann.attr] = ann
+        self.funnels_by_attr.setdefault(ann.attr, []).append(ann)
 
     def alias_of(self, cls: str, attr: str) -> Optional[str]:
         return self.cond_alias.get((cls, attr))
@@ -167,6 +238,9 @@ def load_module(path: str, repo_root: str) -> Optional[ModuleInfo]:
         if m:
             rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
             mod.suppressions[i] = rules
+        w = WALL_CLOCK_RE.search(line)
+        if w:
+            mod.wall_clock[i] = w.group("why").strip()
     return mod
 
 
@@ -234,6 +308,29 @@ class _RegistryVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _annotated_attr(
+    mod: ModuleInfo, i: int
+) -> Optional[tuple[str, int]]:
+    """Resolve the ``self.<attr>`` assignment an annotation comment on
+    line ``i`` applies to: the line itself, or (for a standalone comment
+    line) the next non-comment line. -> (attr, target_line) or None."""
+    n = len(mod.lines)
+    sm = _SELF_ASSIGN_RE.search(mod.lines[i - 1])
+    if sm:
+        return sm.group("attr"), i
+    j = i + 1
+    while j <= n and (
+        not mod.lines[j - 1].strip()
+        or mod.lines[j - 1].lstrip().startswith("#")
+    ):
+        j += 1
+    if j <= n:
+        sm = _SELF_ASSIGN_RE.search(mod.lines[j - 1])
+        if sm:
+            return sm.group("attr"), j
+    return None
+
+
 def _collect_annotations(registry: LockRegistry, mod: ModuleInfo) -> None:
     # Map each line to its enclosing class (for the annotation owner).
     line_class: dict[int, str] = {}
@@ -243,40 +340,35 @@ def _collect_annotations(registry: LockRegistry, mod: ModuleInfo) -> None:
             for ln in range(node.lineno, end + 1):
                 # innermost class wins: later (nested) defs overwrite
                 line_class[ln] = node.name
-    n = len(mod.lines)
     for i, line in enumerate(mod.lines, start=1):
         m = _ANNOTATION_RE.search(line)
-        if not m:
-            continue
-        attr = None
-        sm = _SELF_ASSIGN_RE.search(line)
-        target_line = i
-        if sm:
-            attr = sm.group("attr")
-        else:
-            # standalone annotation comment: applies to the next
-            # non-comment line's self.<attr> assignment
-            j = i + 1
-            while j <= n and (
-                not mod.lines[j - 1].strip()
-                or mod.lines[j - 1].lstrip().startswith("#")
-            ):
-                j += 1
-            if j <= n:
-                sm = _SELF_ASSIGN_RE.search(mod.lines[j - 1])
-                if sm:
-                    attr = sm.group("attr")
-                    target_line = j
-        if attr is None:
-            continue
-        registry.add_annotation(Annotation(
-            attr=attr,
-            lock=m.group("lock"),
-            mode=(m.group("mode") or "full"),
-            cls=line_class.get(target_line, ""),
-            path=mod.relpath,
-            line=target_line,
-        ))
+        if m:
+            resolved = _annotated_attr(mod, i)
+            if resolved is not None:
+                attr, target_line = resolved
+                registry.add_annotation(Annotation(
+                    attr=attr,
+                    lock=m.group("lock"),
+                    mode=(m.group("mode") or "full"),
+                    cls=line_class.get(target_line, ""),
+                    path=mod.relpath,
+                    line=target_line,
+                ))
+        f = _STATE_FUNNEL_RE.search(line)
+        if f:
+            resolved = _annotated_attr(mod, i)
+            if resolved is not None:
+                attr, target_line = resolved
+                registry.add_funnel(FunnelAnnotation(
+                    attr=attr,
+                    methods=tuple(
+                        s.strip() for s in f.group("methods").split(",")
+                        if s.strip()
+                    ),
+                    cls=line_class.get(target_line, ""),
+                    path=mod.relpath,
+                    line=target_line,
+                ))
 
 
 # --------------------------------------------------------------------- #
@@ -392,22 +484,57 @@ def build_context(paths: Iterable[str], repo_root: str) -> AnalysisContext:
     )
 
 
+# Family key -> check runner. ``--only <family>`` filters on these keys
+# (comma-separated); every key runs by default.
+FAMILY_KEYS = (
+    "guarded-by", "blocking", "lock-order", "jax",
+    "clock", "determinism", "state-funnel", "env",
+)
+
+
 def run_analysis(
     paths: Iterable[str],
     repo_root: Optional[str] = None,
     lock_order_path: Optional[str] = None,
+    only: Optional[Iterable[str]] = None,
 ) -> list[Finding]:
-    """Run every rule family; returns findings with inline suppressions
-    already applied (baseline filtering is the caller's job)."""
-    from tools.analysis import blocking, guards, jaxhazards, lockorder
+    """Run the rule families (all by default, or the ``only`` subset of
+    FAMILY_KEYS); returns findings with inline suppressions already
+    applied (baseline filtering is the caller's job)."""
+    from tools.analysis import (
+        blocking,
+        clockrules,
+        determinism,
+        envrules,
+        guards,
+        jaxhazards,
+        lockorder,
+        statefunnel,
+    )
 
     root = repo_root or os.getcwd()
     ctx = build_context(paths, root)
+    runners = {
+        "guarded-by": guards.check,
+        "blocking": blocking.check,
+        "lock-order": lambda c: lockorder.check(c, lock_order_path),
+        "jax": jaxhazards.check,
+        "clock": clockrules.check,
+        "determinism": determinism.check,
+        "state-funnel": statefunnel.check,
+        "env": envrules.check,
+    }
+    selected = list(only) if only else list(FAMILY_KEYS)
+    unknown = [k for k in selected if k not in runners]
+    if unknown:
+        raise ValueError(
+            f"unknown rule famil{'ies' if len(unknown) > 1 else 'y'} "
+            f"{unknown}; known: {', '.join(FAMILY_KEYS)}"
+        )
     findings: list[Finding] = []
-    findings += guards.check(ctx)
-    findings += blocking.check(ctx)
-    findings += lockorder.check(ctx, lock_order_path)
-    findings += jaxhazards.check(ctx)
+    for key in FAMILY_KEYS:
+        if key in selected:
+            findings += runners[key](ctx)
     by_path = {m.relpath: m for m in ctx.modules}
     kept = []
     for fd in findings:

@@ -1,0 +1,200 @@
+"""Rule family 6 — determinism hazards: seeded randomness, stable
+hashing, ordered iteration in replay-bearing code.
+
+Complements clock-discipline: the sim's replay contract is that a
+scenario trace is a pure function of ``(seed, virtual time)``. These
+rules guard the *other* entropy sources:
+
+- ``det-entropy`` (everywhere): draws from the process-global RNGs —
+  ``random.random/randint/choice/shuffle/...`` and
+  ``np.random.rand/...`` — plus ``uuid.uuid1/uuid4`` and
+  ``os.urandom``. Seeded constructions (``random.Random(seed)``,
+  ``np.random.default_rng(seed)``, ``jax.random.PRNGKey``) are the
+  sanctioned pattern and are not flagged; neither is anything under
+  ``jax.random`` (explicit-key, deterministic by construction).
+- ``det-hash`` (everywhere): builtin ``hash(...)`` — salted per process
+  (PYTHONHASHSEED), so any value *derived* from it (sizes, buckets that
+  feed ordering, synthetic payloads) diverges across processes. Stable
+  derivation uses ``zlib.crc32``/``hashlib``; genuinely order-free
+  sharding (metrics stripe picking) suppresses inline.
+- ``det-unordered-iter`` (``sim/``, ``observability/``): iteration over
+  a set construct (``set(...)``, set literal/comprehension,
+  ``frozenset``) without ``sorted(...)`` — set iteration order is hash
+  order, which is salted; in trace/invariant/flight-recorder code that
+  turns into replay-breaking event order. This generalizes PR-11's
+  ``jax-unordered-index`` beyond jitted code and shares its
+  launder/conversion tracking (``sorted()`` launders; ``list``/
+  ``tuple``/... conversions do not). Dict views are NOT flagged here:
+  CPython dicts iterate in insertion order, which the replay contract
+  already pins.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.analysis.core import (
+    AnalysisContext,
+    Finding,
+    ModuleInfo,
+)
+
+ENTROPY_RULE = "det-entropy"
+HASH_RULE = "det-hash"
+ITER_RULE = "det-unordered-iter"
+
+# Replay-bearing subtrees for the iteration rule: scenario traces,
+# invariants, and the flight recorder / tracing pipeline live here.
+ITER_DIRS = ("modelmesh_tpu/sim/", "modelmesh_tpu/observability/")
+
+# Global-RNG draw methods (stdlib random module and numpy.random's
+# legacy global generator share most of these names).
+GLOBAL_DRAWS = frozenset({
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "normalvariate", "betavariate",
+    "expovariate", "triangular", "getrandbits", "randbytes",
+    "rand", "randn", "permutation", "standard_normal", "integers",
+    "bytes",
+})
+UUID_FNS = frozenset({"uuid1", "uuid4"})
+
+
+def _dotted(node: ast.AST) -> str:
+    """Full dotted name of a call target: 'np.random.rand'."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _entropy_hit(node: ast.Call) -> Optional[tuple[str, str]]:
+    dotted = _dotted(node.func)
+    if not dotted:
+        return None
+    parts = dotted.split(".")
+    head, tail = parts[0], parts[-1]
+    # jax.random.* takes an explicit key — deterministic by construction.
+    if head in ("jax", "jrandom") or "jax" in parts[:-1]:
+        return None
+    if tail in GLOBAL_DRAWS and len(parts) >= 2 and (
+        parts[-2] == "random"
+    ):
+        return (dotted,
+                f"{dotted}() draws from the process-global RNG — seed an "
+                f"explicit generator (random.Random(seed) / "
+                f"np.random.default_rng(seed)) so the draw replays")
+    if tail in UUID_FNS:
+        return (dotted,
+                f"{dotted}() is per-process entropy — replay-bearing ids "
+                f"must derive from the scenario seed (or suppress for "
+                f"deliberately unique wire/process identity)")
+    if tail == "urandom" and (len(parts) == 1 or parts[-2] == "os"):
+        return (dotted, f"{dotted}() reads OS entropy — not replayable")
+    return None
+
+
+def _check_entropy(mod: ModuleInfo) -> list[Finding]:
+    findings = []
+    # The shared walk covers function bodies AND module/class-level
+    # import-time code, each node exactly once.
+    for node, qual in mod.walked():
+        if not isinstance(node, ast.Call):
+            continue
+        hit = _entropy_hit(node)
+        if hit is not None:
+            token, message = hit
+            findings.append(Finding(
+                rule=ENTROPY_RULE, path=mod.relpath, line=node.lineno,
+                qualname=qual, token=token, message=message,
+            ))
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "hash":
+            findings.append(Finding(
+                rule=HASH_RULE, path=mod.relpath, line=node.lineno,
+                qualname=qual, token="hash()",
+                message=(
+                    "builtin hash() is salted per process "
+                    "(PYTHONHASHSEED): derived values diverge across "
+                    "processes — use zlib.crc32/hashlib for stable "
+                    "derivation, or suppress for order-free sharding"
+                ),
+            ))
+    return findings
+
+
+def _set_source(node: ast.AST) -> Optional[str]:
+    """The set-construct expression ``node`` iterates/derives from, or
+    None. ``sorted(...)`` anywhere in the chain launders the order;
+    order-preserving conversions (list/tuple/...) do not."""
+    if isinstance(node, ast.Set):
+        return "{...} set literal"
+    if isinstance(node, ast.SetComp):
+        return "{...} set comprehension"
+    if isinstance(node, ast.Call):
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        if fname == "sorted":
+            return None
+        if fname in ("set", "frozenset"):
+            return f"{fname}(...)"
+        if fname in ("list", "tuple"):
+            for arg in node.args[:1]:
+                inner = _set_source(arg)
+                if inner is not None:
+                    return inner
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.Sub, ast.BitAnd, ast.BitOr, ast.BitXor)
+    ):
+        # set algebra: `set(a) - b` etc. yields a set either side.
+        return _set_source(node.left) or _set_source(node.right)
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+        for gen in node.generators:
+            inner = _set_source(gen.iter)
+            if inner is not None:
+                return inner
+    return None
+
+
+def _check_unordered_iter(mod: ModuleInfo) -> list[Finding]:
+    findings = []
+    for n, qual in mod.walked():
+        iters: list[tuple[ast.AST, ast.AST]] = []
+        if isinstance(n, ast.For):
+            iters.append((n, n.iter))
+        elif isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp)):
+            for gen in n.generators:
+                iters.append((n, gen.iter))
+        for holder, it in iters:
+            token = _set_source(it)
+            if token is None:
+                continue
+            findings.append(Finding(
+                rule=ITER_RULE,
+                path=mod.relpath,
+                line=getattr(it, "lineno", holder.lineno),
+                qualname=qual,
+                token=token,
+                message=(
+                    f"iteration over {token} in replay-bearing code — "
+                    f"set order is salted hash order; wrap in "
+                    f"sorted(...) so traces/invariant output replay "
+                    f"identically across processes"
+                ),
+            ))
+    return findings
+
+
+def check(ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in ctx.modules:
+        findings += _check_entropy(mod)
+        if any(d in mod.relpath for d in ITER_DIRS):
+            findings += _check_unordered_iter(mod)
+    return findings

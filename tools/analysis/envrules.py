@@ -1,0 +1,196 @@
+"""Rule family 8 — env-registry & doc drift: every environment knob is
+registered, documented, and actually read.
+
+``utils/envs.py`` exists so operators have ONE authoritative list of
+knobs (the reference concentrates ~45 env vars in ModelMeshEnvVars.java
+for the same reason), and so a typo'd name fails loudly instead of
+silently defaulting. Three drift modes erode that guarantee, each now a
+finding (the ``lock_order.txt`` drift-as-finding pattern):
+
+- ``env-direct-read``: ``os.environ.get(...)`` / ``os.getenv(...)`` /
+  ``os.environ[...]`` anywhere outside ``utils/envs.py``. MM_* names
+  must go through the typed accessors; foreign names (e.g. a knob owned
+  by another library) get registered too — the registry documents every
+  env var the process *reads*, not just the ones it owns.
+- ``env-undocumented``: a registered knob with no row in
+  ``docs/configuration.md``.
+- ``env-unread``: a registered knob whose name literal appears neither
+  in any analyzed module nor in its declared ``consumer`` file — a
+  knob nothing reads is documentation lying to operators.
+
+The registry itself is parsed from the ``EnvVar("NAME", ...)``
+constructor calls in ``utils/envs.py`` (stdlib ast — no import), so the
+rule also works on fixture trees: no registry file, no registry
+findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from tools.analysis.core import (
+    AnalysisContext,
+    Finding,
+    ModuleInfo,
+    load_module,
+    receiver_and_attr,
+)
+
+READ_RULE = "env-direct-read"
+DOC_RULE = "env-undocumented"
+UNREAD_RULE = "env-unread"
+
+ENVS_RELPATH = "modelmesh_tpu/utils/envs.py"
+DOCS_RELPATH = "docs/configuration.md"
+
+
+def _direct_read(node: ast.AST) -> Optional[tuple[str, int]]:
+    """(env-name-or-expr token, line) when ``node`` reads the process
+    environment directly."""
+    if isinstance(node, ast.Call):
+        fn = node.func
+        ra = receiver_and_attr(fn) if isinstance(fn, ast.Attribute) else None
+        is_environ_get = ra is not None and ra == ("environ", "get")
+        is_getenv = (
+            isinstance(fn, ast.Attribute) and fn.attr == "getenv"
+        ) or (isinstance(fn, ast.Name) and fn.id == "getenv")
+        if is_environ_get or is_getenv:
+            name = "<dynamic>"
+            if node.args and isinstance(node.args[0], ast.Constant):
+                name = str(node.args[0].value)
+            return name, node.lineno
+    if isinstance(node, ast.Subscript):
+        ra = receiver_and_attr(node.value)
+        if (ra is not None and ra[1] == "environ") or (
+            isinstance(node.value, ast.Name)
+            and node.value.id == "environ"
+        ):
+            name = "<dynamic>"
+            if isinstance(node.slice, ast.Constant):
+                name = str(node.slice.value)
+            return name, node.lineno
+    return None
+
+
+def _check_direct_reads(mod: ModuleInfo) -> list[Finding]:
+    findings = []
+    # The shared walk covers function bodies AND module/class-level
+    # import-time reads, each node exactly once.
+    for node, qual in mod.walked():
+        hit = _direct_read(node)
+        if hit is None:
+            continue
+        name, line = hit
+        extra = (
+            " (registered — use the typed accessor)"
+            if name.startswith("MM_") else
+            " — register it in utils/envs.py so the knob inventory "
+            "stays authoritative"
+        )
+        findings.append(Finding(
+            rule=READ_RULE, path=mod.relpath, line=line,
+            qualname=qual, token=name,
+            message=(
+                f"direct environment read of {name!r} outside "
+                f"utils/envs.py — go through the envs registry"
+                f"{extra}"
+            ),
+        ))
+    return findings
+
+
+def _registry_entries(envs_mod: ModuleInfo) -> list[tuple[str, str, int]]:
+    """(name, consumer, line) for every EnvVar(...) constructor call."""
+    out = []
+    for node in ast.walk(envs_mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        if fname != "EnvVar" or not node.args:
+            continue
+        first = node.args[0]
+        if not isinstance(first, ast.Constant):
+            continue
+        consumer = ""
+        if len(node.args) >= 5 and isinstance(node.args[4], ast.Constant):
+            consumer = str(node.args[4].value)
+        for kw in node.keywords:
+            if kw.arg == "consumer" and isinstance(kw.value, ast.Constant):
+                consumer = str(kw.value.value)
+        out.append((str(first.value), consumer, node.lineno))
+    return out
+
+
+def _consumer_source(repo_root: str, consumer: str) -> str:
+    """Source of the declared consumer file ('' if unresolvable). The
+    registry's consumer paths are relative to modelmesh_tpu/ except the
+    repo-root bench drivers."""
+    for base in (os.path.join(repo_root, "modelmesh_tpu"), repo_root):
+        path = os.path.join(base, consumer)
+        if os.path.isfile(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    return f.read()
+            except OSError:
+                return ""
+    return ""
+
+
+def check(ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    envs_mod = None
+    for mod in ctx.modules:
+        if mod.relpath == ENVS_RELPATH:
+            envs_mod = mod
+            continue
+        findings += _check_direct_reads(mod)
+
+    if envs_mod is None:
+        # Not scanned (partial run / fixture tree): load from the repo
+        # root so registry drift is still checked on targeted runs.
+        path = os.path.join(ctx.repo_root, ENVS_RELPATH)
+        if os.path.isfile(path):
+            envs_mod = load_module(path, ctx.repo_root)
+    if envs_mod is None:
+        return findings
+
+    docs_text = ""
+    docs_path = os.path.join(ctx.repo_root, DOCS_RELPATH)
+    if os.path.isfile(docs_path):
+        with open(docs_path, encoding="utf-8") as f:
+            docs_text = f.read()
+
+    scanned = [m for m in ctx.modules if m.relpath != ENVS_RELPATH]
+    for name, consumer, line in _registry_entries(envs_mod):
+        if docs_text and name not in docs_text:
+            findings.append(Finding(
+                rule=DOC_RULE, path=envs_mod.relpath, line=line,
+                qualname="<registry>", token=name,
+                message=(
+                    f"{name} is registered but has no row in "
+                    f"{DOCS_RELPATH} — document it (operators read the "
+                    f"doc, not the registry source)"
+                ),
+            ))
+        read_somewhere = any(name in m.source for m in scanned)
+        if not read_somewhere and consumer:
+            read_somewhere = name in _consumer_source(
+                ctx.repo_root, consumer
+            )
+        if not read_somewhere:
+            findings.append(Finding(
+                rule=UNREAD_RULE, path=envs_mod.relpath, line=line,
+                qualname="<registry>", token=name,
+                message=(
+                    f"{name} is registered but never read — neither any "
+                    f"analyzed module nor its declared consumer "
+                    f"({consumer or 'none'}) mentions it; prune the "
+                    f"entry or fix the consumer"
+                ),
+            ))
+    return findings

@@ -1,0 +1,172 @@
+"""Rule family 7 — ``state-funnel``: state-machine fields are written
+only through their declared transition funnel.
+
+PR-8 consolidated every ``CacheEntry`` transition into
+``_transition_locked`` (condition broadcast + flight-recorder event per
+transition); PR-4 had already converted the one bare ``ce.state = X``
+write it found into a guarded transition because it clobbered a racing
+deletion's REMOVED. Nothing, however, stops the NEXT bare write from
+creeping in — a ``ce.state = ACTIVE`` compiles fine and silently skips
+the broadcast, the flight recorder, and the terminal-state check.
+
+Declaration rides the annotation grammar, on (or immediately above) the
+field's initializing assignment:
+
+    #: state-funnel: _transition_locked
+    self.state = EntryState.NEW  #: guarded-by: _lock [rebind]
+
+Semantics:
+
+- Writes to the field **inside the declaring class** are allowed only in
+  the funnel methods and ``__init__``-family constructors.
+- Writes **outside the class** (``ce.state = ...``, ``inst.draining =
+  ...``) resolve through the attribute name when every funnel annotation
+  for that attribute agrees (the guards.py cross-object convention) and
+  are always findings — external code goes through the funnel method.
+- Funnel methods ending in ``_locked`` keep their caller-holds-the-lock
+  contract (the guarded-by/blocking rules already enforce it).
+
+Reads are never checked — the whole point of the funnel is that the
+field stays cheaply readable everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.analysis.core import (
+    AnalysisContext,
+    Finding,
+    FunnelAnnotation,
+    ModuleInfo,
+    iter_functions,
+    receiver_and_attr,
+)
+
+RULE = "state-funnel"
+
+EXEMPT_FUNCS = {"__init__", "__new__", "__post_init__"}
+
+
+def _writes(node: ast.AST) -> list[tuple[str, str, int]]:
+    """(receiver, attr, line) for attribute rebinds in a target."""
+    out: list[tuple[str, str, int]] = []
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            out += _writes(elt)
+        return out
+    if isinstance(node, ast.Starred):
+        return _writes(node.value)
+    ra = receiver_and_attr(node)
+    if ra is not None:
+        out.append((ra[0], ra[1], node.lineno))
+    return out
+
+
+class _FunnelVisitor(ast.NodeVisitor):
+    def __init__(self, mod: ModuleInfo, ctx: AnalysisContext,
+                 cls: str, func_name: str, qualname: str):
+        self.mod = mod
+        self.ctx = ctx
+        self.cls = cls
+        self.func_name = func_name
+        self.qualname = qualname
+        self.findings: list[Finding] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass  # nested defs are visited with their own context
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _funnel_for(
+        self, recv: str, attr: str
+    ) -> Optional[FunnelAnnotation]:
+        reg = self.ctx.registry
+        if recv == "self":
+            return reg.funnels.get(self.cls, {}).get(attr)
+        anns = reg.funnels_by_attr.get(attr, [])
+        # Cross-object resolution only when unambiguous, like guards.py.
+        if len({(a.cls, a.methods) for a in anns}) == 1:
+            return anns[0]
+        return None
+
+    def _check(self, recv: str, attr: str, line: int) -> None:
+        ann = self._funnel_for(recv, attr)
+        if ann is None:
+            return
+        if recv == "self" and self.cls == ann.cls and (
+            self.func_name in ann.methods
+            or self.func_name in EXEMPT_FUNCS
+        ):
+            return
+        where = (
+            f"outside funnel method(s) {', '.join(ann.methods)}"
+            if recv == "self" and self.cls == ann.cls
+            else f"from outside {ann.cls or '<module>'} — go through "
+                 f"{' / '.join(ann.methods)}"
+        )
+        self.findings.append(Finding(
+            rule=RULE,
+            path=self.mod.relpath,
+            line=line,
+            qualname=self.qualname,
+            token=f"{recv}.{attr}",
+            message=(
+                f"write to {recv}.{attr} (state-funnel field declared at "
+                f"{ann.path}:{ann.line}) {where}: bare writes skip the "
+                f"transition broadcast / flight-recorder event / "
+                f"terminal-state check"
+            ),
+        ))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            for recv, attr, line in _writes(target):
+                self._check(recv, attr, line)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            for recv, attr, line in _writes(node.target):
+                self._check(recv, attr, line)
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        for recv, attr, line in _writes(node.target):
+            self._check(recv, attr, line)
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            for recv, attr, line in _writes(target):
+                self._check(recv, attr, line)
+
+
+def check(ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    if not ctx.registry.funnels_by_attr:
+        return findings
+    for mod in ctx.modules:
+        for cls, func in iter_functions(mod):
+            visitor = _FunnelVisitor(
+                mod, ctx, cls, func.name,
+                f"{cls}.{func.name}" if cls else func.name,
+            )
+            for stmt in func.body:
+                visitor.visit(stmt)
+            findings += visitor.findings
+        # Module/class-level writes (script-style `ce.state = X` at
+        # import time) are the same bare-write hazard — the shared walk
+        # tags them "<module>"; no `self` exists there, so only the
+        # cross-object resolution path applies.
+        visitor = _FunnelVisitor(mod, ctx, "", "<module>", "<module>")
+        for node, qual in mod.walked():
+            if qual == "<module>" and isinstance(
+                node, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                       ast.Delete)
+            ):
+                visitor.visit(node)
+        findings += visitor.findings
+    return findings

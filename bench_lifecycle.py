@@ -58,9 +58,10 @@ from __future__ import annotations
 
 import argparse
 import json
-import statistics
 import sys
 import time
+
+from bench_util import median_ms, timed_ms
 
 from modelmesh_tpu.kv import InMemoryKV
 from modelmesh_tpu.runtime.spi import (
@@ -286,15 +287,15 @@ def _measure_first_serve(fastpath: bool, load_ms: float, size_ms: float,
                        load_ms=load_ms, size_ms=size_ms, inline_size=False)
         inst = insts[0]
         inst.register_model(f"m-{r}", INFO)
-        t0 = time.perf_counter()
-        inst.invoke_model(f"m-{r}", "predict", b"x" * 64, [])
-        samples.append((time.perf_counter() - t0) * 1e3)
+        samples.append(timed_ms(
+            lambda: inst.invoke_model(f"m-{r}", "predict", b"x" * 64, [])
+        ))
         _close(insts, kv)
     return {
         "reps": reps,
         "load_ms": load_ms,
         "size_ms": size_ms,
-        "ttfs_ms": round(statistics.median(samples), 1),
+        "ttfs_ms": median_ms(samples),
     }
 
 
@@ -308,15 +309,17 @@ def _measure_n_copies(fastpath: bool, n_copies: int, fleet: int,
         inst = insts[0]
         mid = f"m-{r}"
         inst.register_model(mid, INFO)
-        t0 = time.perf_counter()
-        inst.ensure_loaded(mid, sync=True, chain=n_copies - 1)
-        deadline = time.monotonic() + 60
-        while time.monotonic() < deadline:
-            mr = inst.registry.get(mid)
-            if mr is not None and len(mr.instance_ids) >= n_copies:
-                break
-            time.sleep(0.002)
-        samples.append((time.perf_counter() - t0) * 1e3)
+
+        def spread():
+            inst.ensure_loaded(mid, sync=True, chain=n_copies - 1)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                mr = inst.registry.get(mid)
+                if mr is not None and len(mr.instance_ids) >= n_copies:
+                    break
+                time.sleep(0.002)
+
+        samples.append(timed_ms(spread))
         mr = inst.registry.get(mid)
         copies = len(mr.instance_ids) if mr else 0
         _close(insts, kv)
@@ -328,7 +331,7 @@ def _measure_n_copies(fastpath: bool, n_copies: int, fleet: int,
         "n": n_copies,
         "fleet": fleet,
         "load_ms": load_ms,
-        "time_to_n_ms": round(statistics.median(samples), 1),
+        "time_to_n_ms": median_ms(samples),
     }
 
 
@@ -387,15 +390,17 @@ def _measure_flash_crowd(peer_fetch: bool, copies: int, fleet: int,
         inst = insts[0]
         mid = f"hot-{r}"
         inst.register_model(mid, INFO)
-        t0 = time.perf_counter()
-        inst.ensure_loaded(mid, sync=True, chain=copies - 1)
-        deadline = time.monotonic() + 60
-        while time.monotonic() < deadline:
-            mr = inst.registry.get(mid)
-            if mr is not None and len(mr.instance_ids) >= copies:
-                break
-            time.sleep(0.002)
-        samples.append((time.perf_counter() - t0) * 1e3)
+
+        def crowd():
+            inst.ensure_loaded(mid, sync=True, chain=copies - 1)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                mr = inst.registry.get(mid)
+                if mr is not None and len(mr.instance_ids) >= copies:
+                    break
+                time.sleep(0.002)
+
+        samples.append(timed_ms(crowd))
         mr = inst.registry.get(mid)
         got = len(mr.instance_ids) if mr else 0
         store_loads.append(sum(ld.store_loads for ld in loaders))
@@ -407,7 +412,7 @@ def _measure_flash_crowd(peer_fetch: bool, copies: int, fleet: int,
         "copies": copies,
         "fleet": fleet,
         "load_ms": load_ms,
-        "time_to_n_ms": round(statistics.median(samples), 1),
+        "time_to_n_ms": median_ms(samples),
         "store_loads": max(store_loads),
         "stream_loads": min(stream_loads),
     }
@@ -421,9 +426,7 @@ def _measure_host_rewarm(load_ms: float, reps: int) -> dict:
         inst, loader = insts[0], loaders[0]
         mid = f"warm-{r}"
         inst.register_model(mid, INFO)
-        t0 = time.perf_counter()
-        inst.ensure_loaded(mid, sync=True)
-        cold.append((time.perf_counter() - t0) * 1e3)
+        cold.append(timed_ms(lambda: inst.ensure_loaded(mid, sync=True)))
         # Capacity eviction -> demotion into the host tier.
         inst.cache.set_capacity(1)
         deadline = time.monotonic() + 10
@@ -438,13 +441,11 @@ def _measure_host_rewarm(load_ms: float, reps: int) -> dict:
             time.sleep(0.002)
         assert inst.host_tier.peek(mid) is not None, "demotion never landed"
         inst.cache.set_capacity(1 << 17)
-        t0 = time.perf_counter()
-        inst.ensure_loaded(mid, sync=True)
-        rewarm.append((time.perf_counter() - t0) * 1e3)
+        rewarm.append(timed_ms(lambda: inst.ensure_loaded(mid, sync=True)))
         assert loader.stream_loads >= 1, "re-warm paid a store load"
         _close(insts, kv)
-    cold_ms = round(statistics.median(cold), 1)
-    rewarm_ms = round(statistics.median(rewarm), 2)
+    cold_ms = median_ms(cold)
+    rewarm_ms = median_ms(rewarm, 2)
     return {
         "reps": reps,
         "load_ms": load_ms,
@@ -490,9 +491,11 @@ def _measure_drain(peer_fetch: bool, models: int, fleet: int,
 
         t = threading.Thread(target=probe, daemon=True)
         t.start()
-        t0 = time.perf_counter()
-        report = DrainController(src, deadline_s=120).drain()
-        drain_ms.append((time.perf_counter() - t0) * 1e3)
+        reports = []
+        drain_ms.append(timed_ms(
+            lambda: reports.append(DrainController(src, deadline_s=120).drain())
+        ))
+        report = reports[0]
         stop.set()
         t.join(timeout=10)
         gaps.append(len(failures))
@@ -504,7 +507,7 @@ def _measure_drain(peer_fetch: bool, models: int, fleet: int,
         "models": models,
         "fleet": fleet,
         "load_ms": load_ms,
-        "drain_ms": round(statistics.median(drain_ms), 1),
+        "drain_ms": median_ms(drain_ms),
         "migrated": min(migrated),
         "probe_requests": min(probes),
         "failed_requests": max(gaps),

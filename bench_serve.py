@@ -21,6 +21,13 @@ Scenarios per tier:
                    (µs/op + speedup): the number the route cache exists
                    to improve, isolated from invoke plumbing.
 
+Plus ``throughput_per_device`` (the batched-data-plane headline): one
+real instance over the in-process JAX runtime, concurrent requester
+threads over co-located same-family models, one-at-a-time baseline vs
+the continuous-batching + fused-dispatch path (serving/batching.py) —
+requests/s/chip at the observed p99 for both modes, with the batch
+occupancy and fused-group evidence in the JSON tail.
+
 Run directly (`python bench_serve.py`, prints one JSON document) or via
 `MM_BENCH_SERVE=1 python bench.py` (attached under the "serve" key).
 Env knobs (registered in utils/envs.py): MM_ROUTE_CACHE /
@@ -100,34 +107,13 @@ def _make_instance(n_instances: int):
     return kv, inst, forwards
 
 
-def _percentiles(samples_ms: list[float], wall_s: float) -> dict:
-    xs = sorted(samples_ms)
-    n = len(xs)
-    return {
-        "reps": n,
-        "rps": round(n / wall_s, 1) if wall_s > 0 else None,
-        "p50_us": round(xs[n // 2] * 1e3, 1),
-        "p99_us": round(xs[min(n - 1, (n * 99) // 100)] * 1e3, 1),
-    }
-
-
-def _drive(fn, reps: int) -> dict:
-    fn()  # warm (first-route caches, lazy imports)
-    samples = []
-    t_wall = time.perf_counter()
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        fn()
-        samples.append((time.perf_counter() - t0) * 1e3)
-    return _percentiles(samples, time.perf_counter() - t_wall)
-
-
-def _time_per_op_us(fn, iters: int) -> float:
-    fn()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        fn()
-    return (time.perf_counter() - t0) * 1e6 / iters
+# Shared bench timing helpers (bench_util.py) under their historical
+# local names — bench_lifecycle.py uses the same module.
+from bench_util import (  # noqa: E402
+    drive as _drive,
+    percentiles as _percentiles,
+    time_per_op_us as _time_per_op_us,
+)
 
 
 def _bench_tier(n_instances: int, reps: int, select_iters: int) -> dict:
@@ -336,7 +322,146 @@ def tracing_overhead(reps: int = 3000, batches: int = 5) -> dict:
         kv.close()
 
 
-def run(tiers=(1, 100, 1000), reps: int = 2000, select_iters: int = 20_000) -> dict:
+def throughput_per_device(
+    n_models: int = 4,
+    threads: int = 16,
+    reps_per_thread: int = 80,
+) -> dict:
+    """Batched-data-plane headline: requests/s/chip, one-at-a-time vs
+    continuous batching + fused same-family dispatch.
+
+    One real instance over the in-process JAX runtime serves
+    ``n_models`` co-located same-architecture MLPs; ``threads``
+    concurrent requesters each issue ``reps_per_thread`` single-row
+    requests round-robin over the models. The sequential mode detaches
+    the batch queue (every request is its own JAX dispatch — the
+    pre-batching data plane); the batched mode re-attaches it, so
+    concurrent requests coalesce into micro-batches and same-family
+    models fuse into stacked multi-model kernels. Both modes report
+    requests/s (normalized per visible device) AND p99, so the speedup
+    is read at comparable tail latency, not bought with it. Parity is
+    pinned separately in tier-1 (tests/test_batching.py): batched and
+    sequential outputs are bit-for-bit identical on CPU f32.
+    """
+    import threading as _threading
+
+    import jax
+
+    from modelmesh_tpu.models.server import InProcessJaxLoader
+    from modelmesh_tpu.serving.instance import ModelMeshInstance
+
+    kv = InMemoryKV(sweep_interval_s=3600.0)
+    loader = InProcessJaxLoader(capacity_bytes=1 << 30)
+    inst = ModelMeshInstance(
+        kv, loader,
+        InstanceConfig(instance_id="i-tpd", load_timeout_s=60,
+                       min_churn_age_ms=0),
+    )
+    try:
+        info = ModelInfo(
+            model_type="mlp", model_path="mlp://in=64,hidden=256,out=10",
+        )
+        models = [f"tpd-{i}" for i in range(n_models)]
+        for mid in models:
+            inst.register_model(mid, info)
+            inst.invoke_model(
+                mid, None, b"", [],
+                RoutingContext(hop=RoutingContext.LOAD_LOCAL_ONLY),
+                sync=True,
+            )
+        import numpy as np
+
+        payload = np.ones((1, 64), np.float32).tobytes()
+        batcher = inst.batcher
+        if batcher is None:
+            # MM_BATCH_MAX<=1 disables the queue: there is no batched
+            # mode to measure — report the degenerate scenario instead
+            # of crashing the whole bench document.
+            return {
+                "devices": len(jax.devices()),
+                "models": n_models,
+                "threads": threads,
+                "batching_disabled": True,
+            }
+
+        def measure(tag: str) -> dict:
+            samples: list[list[float]] = [[] for _ in range(threads)]
+            start = _threading.Barrier(threads + 1)
+
+            def worker(k: int) -> None:
+                my = samples[k]
+                start.wait()
+                for j in range(reps_per_thread):
+                    mid = models[(k + j) % n_models]
+                    t0 = time.perf_counter()
+                    inst.invoke_model(mid, "predict", payload, [])
+                    my.append((time.perf_counter() - t0) * 1e3)
+
+            ts = [
+                _threading.Thread(target=worker, args=(k,), daemon=True)
+                for k in range(threads)
+            ]
+            for t in ts:
+                t.start()
+            start.wait()
+            t_wall = time.perf_counter()
+            for t in ts:
+                t.join()
+            wall = time.perf_counter() - t_wall
+            flat = [s for per in samples for s in per]
+            return _percentiles(flat, wall)
+
+        # Warm every model through both paths (jit compiles, fused
+        # kernel trace) before measuring either mode.
+        for mid in models:
+            inst.invoke_model(mid, "predict", payload, [])
+        inst.batcher = None
+        sequential = measure("sequential")
+        inst.batcher = batcher
+        measure("warm-batched")  # let the queue reach steady state
+        # Snapshot AFTER the warm run: the occupancy/solo evidence must
+        # describe the measured steady state, not startup compiles.
+        b0, r0, s0 = (
+            batcher.batch_count, batcher.batched_requests,
+            batcher.solo_count,
+        )
+        batched = measure("batched")
+        out = {
+            "devices": len(jax.devices()),
+            "models": n_models,
+            "threads": threads,
+            "sequential": sequential,
+            "batched": batched,
+            "sequential_rps_per_device": round(
+                (sequential["rps"] or 0) / len(jax.devices()), 1
+            ),
+            "batched_rps_per_device": round(
+                (batched["rps"] or 0) / len(jax.devices()), 1
+            ),
+            "speedup": (
+                round(batched["rps"] / sequential["rps"], 2)
+                if sequential["rps"] else None
+            ),
+            "p99_ratio": (
+                round(batched["p99_us"] / sequential["p99_us"], 2)
+                if sequential["p99_us"] else None
+            ),
+            "batches_dispatched": batcher.batch_count - b0,
+            "batched_requests": batcher.batched_requests - r0,
+            "solo_passthroughs": batcher.solo_count - s0,
+        }
+        out["mean_batch_occupancy"] = (
+            round(out["batched_requests"] / out["batches_dispatched"], 2)
+            if out["batches_dispatched"] else None
+        )
+        return out
+    finally:
+        inst.shutdown()
+        kv.close()
+
+
+def run(tiers=(1, 100, 1000), reps: int = 2000, select_iters: int = 20_000,
+        throughput_kwargs: dict | None = None) -> dict:
     from modelmesh_tpu.serving.route_cache import RouteCache
 
     probe = RouteCache()
@@ -348,6 +473,9 @@ def run(tiers=(1, 100, 1000), reps: int = 2000, select_iters: int = 20_000) -> d
         "tracing_overhead": tracing_overhead(
             reps=max(reps // 2, 200), batches=5
         ),
+        "throughput_per_device": throughput_per_device(
+            **(throughput_kwargs or {})
+        ),
     }
 
 
@@ -356,7 +484,13 @@ def main() -> int:
     ap.add_argument("--tiers", type=str, default="1,100,1000")
     ap.add_argument("--reps", type=int, default=2000)
     ap.add_argument("--select-iters", type=int, default=20_000)
+    ap.add_argument("--throughput-only", action="store_true",
+                    help="run only the batched-data-plane "
+                         "throughput-per-device scenario")
     args = ap.parse_args()
+    if args.throughput_only:
+        print(json.dumps(throughput_per_device()))
+        return 0
     tiers = [int(t) for t in args.tiers.split(",") if t.strip()]
     print(json.dumps(run(tiers, args.reps, args.select_iters)))
     return 0

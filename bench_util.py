@@ -1,0 +1,65 @@
+"""Shared timing helpers for the repo-root microbenches.
+
+``bench_serve.py`` and ``bench_lifecycle.py`` grew copy-pasted timing
+loops (percentile summaries, warm-then-measure drivers, per-op
+timers); this module is the single home for them so the two benches —
+and the tier-1 smokes that run them — can't drift apart on how a
+sample becomes a number.
+
+Wall-clock by design: benches measure real elapsed time on whatever
+core runs them; the structure and the ratios are the signal.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+
+
+def percentiles(samples_ms: list[float], wall_s: float) -> dict:
+    """Summary row for one scenario: rep count, requests/s over the
+    measured wall time, and p50/p99 in microseconds."""
+    xs = sorted(samples_ms)
+    n = len(xs)
+    return {
+        "reps": n,
+        "rps": round(n / wall_s, 1) if wall_s > 0 else None,
+        "p50_us": round(xs[n // 2] * 1e3, 1),
+        "p99_us": round(xs[min(n - 1, (n * 99) // 100)] * 1e3, 1),
+    }
+
+
+def drive(fn, reps: int) -> dict:
+    """Warm once (first-route caches, lazy imports), then measure
+    ``reps`` sequential calls and summarize with ``percentiles``."""
+    fn()
+    samples = []
+    t_wall = time.perf_counter()
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return percentiles(samples, time.perf_counter() - t_wall)
+
+
+def time_per_op_us(fn, iters: int) -> float:
+    """Mean microseconds per call over ``iters`` calls (one warm call
+    first) — for sub-millisecond ops where per-call timing is noise."""
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) * 1e6 / iters
+
+
+def timed_ms(fn) -> float:
+    """One wall-clock sample of ``fn`` in milliseconds."""
+    t0 = time.perf_counter()
+    fn()
+    return (time.perf_counter() - t0) * 1e3
+
+
+def median_ms(samples: list[float], digits: int = 1) -> float:
+    """Rounded median of millisecond samples (the lifecycle bench's
+    standard reduction)."""
+    return round(statistics.median(samples), digits)

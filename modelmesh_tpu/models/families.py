@@ -44,13 +44,31 @@ class ModelSpec:
 
 
 class ServableModel:
-    """A loaded model: jitted apply + parameter tree + sizing."""
+    """A loaded model: jitted apply + parameter tree + sizing.
 
-    def __init__(self, apply_fn: Callable, params, input_shape, input_dtype):
+    ``family``/``fuse_key`` are stamped by ``build_model``: the fuse key
+    identifies the ARCHITECTURE (family + every non-seed spec param), so
+    two models with equal keys have identical pytree structure, leaf
+    shapes/dtypes, and apply semantics — the eligibility contract for
+    the fused cross-model dispatch (models/server.py), where one model's
+    apply runs every group member's stacked parameters."""
+
+    def __init__(self, apply_fn: Callable, params, input_shape, input_dtype,
+                 family: str = "", fuse_key: str = "",
+                 batch_safe: bool = True):
         self.apply = apply_fn
         self.params = params
         self.input_shape = input_shape
         self.input_dtype = input_dtype
+        self.family = family
+        self.fuse_key = fuse_key
+        # Row independence: True when apply computes each input row
+        # independently, so row-concat batching / zero-row padding
+        # cannot change any real row's output (the batched data plane's
+        # eligibility contract). MoE transformers are the exception:
+        # capacity-based routing couples every token's slot to the
+        # whole batch, so they must dispatch per-request.
+        self.batch_safe = batch_safe
 
     @property
     def size_bytes(self) -> int:
@@ -59,16 +77,21 @@ class ServableModel:
             for leaf in jax.tree.leaves(self.params)
         )
 
-    def predict_bytes(self, payload: bytes) -> bytes:
-        """Raw-bytes inference: payload is a little-endian array matching the
-        family's input dtype; output is f32 logits bytes."""
+    def decode_rows(self, payload: bytes) -> np.ndarray:
+        """Raw request bytes -> [n, *input_shape] numpy rows (the
+        family's input dtype, short payloads zero-padded)."""
         flat = np.frombuffer(payload, dtype=self.input_dtype)
         feat = int(np.prod(self.input_shape))
         n = max(1, len(flat) // feat)
         usable = flat[: n * feat]
         if len(usable) < n * feat:
             usable = np.pad(usable, (0, n * feat - len(usable)))
-        x = jnp.asarray(usable.reshape((n, *self.input_shape)))
+        return usable.reshape((n, *self.input_shape))
+
+    def predict_bytes(self, payload: bytes) -> bytes:
+        """Raw-bytes inference: payload is a little-endian array matching the
+        family's input dtype; output is f32 logits bytes."""
+        x = jnp.asarray(self.decode_rows(payload))
         out = np.asarray(self.apply(self.params, x), dtype=np.float32)
         return out.tobytes()
 
@@ -383,6 +406,18 @@ FAMILIES: dict[str, Callable[[ModelSpec, str], ServableModel]] = {
 }
 
 
+def fuse_key_for(spec: ModelSpec) -> str:
+    """Architecture identity for fused cross-model dispatch: family plus
+    every spec param EXCEPT the seed (the seed moves the weights, not
+    the architecture). Models sharing a key are guaranteed structurally
+    identical — same pytree, same leaf shapes/dtypes, same apply
+    semantics (head counts, expert counts, ... are all spec params)."""
+    arch = ",".join(
+        f"{k}={v}" for k, v in sorted(spec.params.items()) if k != "seed"
+    )
+    return f"{spec.family}|{arch}"
+
+
 def build_model(model_id: str, model_type: str, model_path: str) -> ServableModel:
     spec = ModelSpec.parse(model_type, model_path)
     builder = FAMILIES.get(spec.family)
@@ -391,4 +426,14 @@ def build_model(model_id: str, model_type: str, model_path: str) -> ServableMode
             f"unknown model family {spec.family!r} "
             f"(known: {sorted(FAMILIES)})"
         )
-    return builder(spec, model_id)
+    model = builder(spec, model_id)
+    model.family = spec.family
+    model.fuse_key = fuse_key_for(spec)
+    # MoE transformers route with per-batch capacity (parallel/moe.py):
+    # concatenating requests or padding rows changes slot competition
+    # and thus REAL rows' outputs — they are not row-independent and
+    # must never share a dispatch or be shape-padded.
+    model.batch_safe = not (
+        spec.family == "transformer" and spec.params.get("experts", 0) > 0
+    )
+    return model

@@ -39,12 +39,42 @@ PREDICT_METHOD = "/mmtpu.models.JaxPredictor/Predict"
 
 
 class JaxModelStore:
-    """Loaded-model registry shared by the gRPC and in-process fronts."""
+    """Loaded-model registry shared by the gRPC and in-process fronts.
+
+    Beyond single-request ``predict_bytes``, the store executes whole
+    micro-batches (``predict_batch``): requests for ONE model ride a
+    single row-concatenated JAX dispatch, and requests for several
+    co-located same-architecture models of a layer-streamable family
+    fuse into ONE stacked kernel — parameter pytrees stacked along a
+    leading "expert" axis, ``vmap``'d apply, per-request model-index
+    route — the dense-N-models-one-kernel trick from
+    ``parallel/moe.py`` applied to whole models. Stacked parameter
+    groups and fused callables are cached (invalidated on unload /
+    reinstall) so steady-state fused dispatches pay no re-stacking.
+    """
+
+    # Bounded caches. Stacked groups are weights-sized: ONE entry per
+    # fuse_key (the FULL co-located group), never per batch-membership
+    # subset — subset keying would hold up to 2^N weight duplicates and
+    # thrash. Fused serving thus carries at most one extra copy of each
+    # fused architecture's weights. Fused fns are trace-sized.
+    _MAX_STACKED = 8
+    _MAX_FUSED_FNS = 32
 
     def __init__(self, capacity_bytes: int):
+        from modelmesh_tpu.utils import envs
+
         self.capacity_bytes = capacity_bytes
         self._models: dict[str, ServableModel] = {}
         self._lock = threading.Lock()
+        # Operator gate for the fused cross-model path (tests flip the
+        # attribute directly; the env is process-fixed).
+        self.fused_enabled = envs.get_bool("MM_FUSED_DISPATCH")
+        # fuse_key -> (sorted member-id tuple, stacked pytree, member
+        # object tuple): the FULL group's stacked parameters
+        self._stacked: dict[str, tuple] = {}  #: guarded-by: _lock
+        # fuse_key -> jit(vmap(apply)) over (stacked params, [M, C, ...])
+        self._fused_fns: dict[str, object] = {}  #: guarded-by: _lock
 
     def load(self, model_id: str, model_type: str, model_path: str) -> int:
         with self._lock:
@@ -69,10 +99,20 @@ class JaxModelStore:
         """Register an externally-materialized model (stream-loaded)."""
         with self._lock:
             self._models[model_id] = model
+            self._drop_stacked_locked(model_id)
 
     def unload(self, model_id: str) -> bool:
         with self._lock:
+            self._drop_stacked_locked(model_id)
             return self._models.pop(model_id, None) is not None
+
+    def _drop_stacked_locked(self, model_id: str) -> None:
+        """Invalidate stacked-parameter groups containing the model
+        (its weights are going away or being replaced)."""
+        self._stacked = {
+            key: entry for key, entry in self._stacked.items()
+            if model_id not in entry[0]
+        }
 
     def get(self, model_id: str) -> Optional[ServableModel]:
         with self._lock:
@@ -85,7 +125,263 @@ class JaxModelStore:
     @property
     def used_bytes(self) -> int:
         with self._lock:
-            return sum(m.size_bytes for m in self._models.values())
+            return sum(
+                m.size_bytes for m in self._models.values()
+            ) + self._stacked_bytes_locked()
+
+    def _stacked_bytes_locked(self) -> int:
+        return sum(entry[3] for entry in self._stacked.values())
+
+    # -- batched execution -------------------------------------------------
+
+    def predict_batch(self, items: list[tuple[str, bytes]]) -> list:
+        """Execute a micro-batch of (model_id, payload) requests.
+
+        Returns a list aligned with ``items``; entries are response
+        bytes or Exception instances (per-item isolation: one missing
+        model or malformed payload never fails its batch-mates). All
+        requests for one model share a single row-concatenated
+        dispatch; a multi-model batch whose members share a fuse key
+        executes as one stacked fused kernel, and falls back to
+        per-model dispatches when architectures diverge.
+        """
+        from modelmesh_tpu.runtime.spi import ModelNotLoadedError
+
+        results: list = [None] * len(items)
+        # model_id -> (mid, model, [(result_index, decoded rows)])
+        per_model: dict[str, tuple] = {}
+        for i, (mid, payload) in enumerate(items):
+            model = self.get(mid)
+            if model is None:
+                results[i] = ModelNotLoadedError(mid)
+                continue
+            try:
+                rows = model.decode_rows(payload)
+            except Exception as e:  # noqa: BLE001 — per-item isolation
+                results[i] = ValueError(f"bad payload: {e}")
+                continue
+            per_model.setdefault(mid, (mid, model, []))[2].append((i, rows))
+        groups = [per_model[mid] for mid in sorted(per_model)]
+        if len(groups) > 1 and self._fusable(groups):
+            self._predict_fused(groups, results)
+        else:
+            for _, model, reqs in groups:
+                self._predict_single(model, reqs, results)
+        return results
+
+    def _fusable(self, groups: list[tuple]) -> bool:
+        from modelmesh_tpu.models.families import LAYER_STREAMABLE_FAMILIES
+
+        if not self.fused_enabled:
+            return False
+        keys = {model.fuse_key for _, model, _ in groups}
+        families = {model.family for _, model, _ in groups}
+        return (
+            len(keys) == 1
+            and "" not in keys
+            and families <= LAYER_STREAMABLE_FAMILIES
+            and all(model.batch_safe for _, model, _ in groups)
+        )
+
+    @staticmethod
+    def _row_bucket(n: int) -> int:
+        """Round a batch's row count up to a power of two: XLA compiles
+        per input shape, so free-running batch sizes would each pay a
+        fresh compile — bucketing collapses the shape space to
+        log2(max batch) warm shapes. Every family is row-independent,
+        so the zero padding rows can't perturb real outputs (the
+        bit-for-bit parity tests pin this)."""
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+
+    @classmethod
+    def _predict_single(
+        cls, model: ServableModel, reqs: list, results: list
+    ) -> None:
+        """One model's requests as one row-concatenated dispatch
+        (row count padded to the shape bucket, outputs sliced back).
+        Batch-coupled models (MoE routing: capacity depends on the
+        whole token batch) run per request with exact solo shapes —
+        concat or padding would change real rows' outputs."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        if not model.batch_safe:
+            for i, rows in reqs:
+                try:
+                    out = np.asarray(
+                        model.apply(model.params, jnp.asarray(rows)),
+                        np.float32,
+                    )
+                    results[i] = out.tobytes()
+                except Exception as e:  # noqa: BLE001 — per-item
+                    results[i] = e
+            return
+        try:
+            total = sum(rows.shape[0] for _, rows in reqs)
+            if len(reqs) == 1 and reqs[0][1].shape[0] == cls._row_bucket(total):
+                x = reqs[0][1]
+            else:
+                x = np.zeros(
+                    (cls._row_bucket(total), *model.input_shape),
+                    model.input_dtype,
+                )
+                ofs = 0
+                for _, rows in reqs:
+                    x[ofs: ofs + rows.shape[0]] = rows
+                    ofs += rows.shape[0]
+            out = np.asarray(
+                model.apply(model.params, jnp.asarray(x)), np.float32
+            )
+            ofs = 0
+            for i, rows in reqs:
+                n = rows.shape[0]
+                results[i] = out[ofs: ofs + n].tobytes()
+                ofs += n
+        except Exception as e:  # noqa: BLE001 — fail this model's items
+            for i, _ in reqs:
+                results[i] = e
+
+    def _predict_fused(self, groups: list[tuple], results: list) -> None:
+        """Multi-model micro-batch as ONE stacked kernel: the FULL
+        co-located fuse group's parameters stacked [M_full, ...], inputs
+        [M_full, C, ...] with each batched model's rows at its group
+        index (absent members ride zero rows — row/model independence
+        means they can't perturb real outputs), vmapped apply. The
+        full-group layout keeps ONE weights-duplicate per architecture
+        and a stable kernel shape across varying batch membership.
+        Parity with the sequential path is exact."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        try:
+            rep = groups[0][1]
+            member_ids, stacked, members = self._full_group_stack(
+                rep.fuse_key
+            )[:3]
+            index = {mid: g for g, mid in enumerate(member_ids)}
+            # A batched model missing from the stacked group (raced an
+            # unload/membership change) falls back per-model.
+            if any(
+                mid not in index or members[index[mid]] is not model
+                for mid, model, _ in groups
+            ):
+                raise LookupError("fuse-group membership moved")
+            counts = [
+                sum(rows.shape[0] for _, rows in reqs)
+                for _, _, reqs in groups
+            ]
+            cap = self._row_bucket(max(counts))
+            x = np.zeros(
+                (len(member_ids), cap, *rep.input_shape), rep.input_dtype
+            )
+            for mid, _, reqs in groups:
+                g, ofs = index[mid], 0
+                for _, rows in reqs:
+                    x[g, ofs: ofs + rows.shape[0]] = rows
+                    ofs += rows.shape[0]
+            fn = self._fused_fn(rep)
+            out = np.asarray(fn(stacked, jnp.asarray(x)), np.float32)
+            for mid, _, reqs in groups:
+                g, ofs = index[mid], 0
+                for i, rows in reqs:
+                    n = rows.shape[0]
+                    results[i] = out[g, ofs: ofs + n].tobytes()
+                    ofs += n
+        except Exception:  # noqa: BLE001 — shapes diverged mid-flight etc.
+            log.warning(
+                "fused dispatch over %d models failed; falling back "
+                "per-model", len(groups), exc_info=True,
+            )
+            for _, model, reqs in groups:
+                self._predict_single(model, reqs, results)
+
+    def _current_members_locked(self, fuse_key: str):
+        """Sorted (ids, models) of every loaded model sharing the
+        architecture. Callers hold _lock."""
+        members = sorted(
+            (mid, m) for mid, m in self._models.items()
+            if m.fuse_key == fuse_key
+        )
+        return (
+            tuple(mid for mid, _ in members),
+            tuple(m for _, m in members),
+        )
+
+    def _full_group_stack(self, fuse_key: str):
+        """(member_ids, stacked, members) over the FULL co-located
+        group: one cached weights-duplicate per architecture, rebuilt
+        whenever membership or any member's identity moved (load,
+        unload, reinstall)."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            ids, models = self._current_members_locked(fuse_key)
+            cached = self._stacked.get(fuse_key)
+            if (
+                cached is not None
+                and cached[0] == ids
+                and cached[2] == models
+            ):
+                return cached
+        stacked = jax.tree.map(
+            lambda *leaves: jnp.stack(leaves), *[m.params for m in models]
+        )
+        stack_bytes = sum(m.size_bytes for m in models)
+        entry = (ids, stacked, models, stack_bytes)
+        with self._lock:
+            # Re-validate at insert time: a concurrent install()/load
+            # may have moved the group while we stacked the OLD
+            # objects — caching the stale stack would poison fused
+            # dispatch until the next invalidation.
+            cur_ids, cur_models = self._current_members_locked(fuse_key)
+            if cur_ids == ids and cur_models == models:
+                # Byte-budgeted against capacity (and counted in
+                # used_bytes): fused serving holds at most one extra
+                # copy of each fused architecture's weights, and never
+                # caches past the store budget — an over-budget stack
+                # is used once and dropped.
+                model_bytes = sum(
+                    m.size_bytes for m in self._models.values()
+                )
+                budget = max(self.capacity_bytes - model_bytes, 0)
+                if stack_bytes <= budget:
+                    # Evict only when eviction can actually make room —
+                    # a stack that can never fit must not wipe other
+                    # groups' cached stacks (they would re-stack on
+                    # every alternating dispatch).
+                    while self._stacked and (
+                        len(self._stacked) >= self._MAX_STACKED
+                        or self._stacked_bytes_locked() + stack_bytes
+                        > budget
+                    ):
+                        self._stacked.pop(next(iter(self._stacked)))
+                    if self._stacked_bytes_locked() + stack_bytes <= budget:
+                        self._stacked[fuse_key] = entry
+        return entry
+
+    def _fused_fn(self, rep: ServableModel):
+        """jit(vmap(apply)) for the group's architecture, cached per
+        fuse key — the representative's apply runs every member's
+        stacked parameters (equal fuse keys guarantee identical
+        semantics)."""
+        import jax
+
+        with self._lock:
+            fn = self._fused_fns.get(rep.fuse_key)
+        if fn is not None:
+            return fn
+        fn = jax.jit(jax.vmap(rep.apply, in_axes=(0, 0)))
+        with self._lock:
+            while len(self._fused_fns) >= self._MAX_FUSED_FNS:
+                self._fused_fns.pop(next(iter(self._fused_fns)))
+            self._fused_fns[rep.fuse_key] = fn
+        return fn
 
 
 def predict_size_estimate(model_type: str, model_path: str) -> int:
@@ -258,6 +554,39 @@ class InProcessJaxLoader(ModelLoader[ServableModel]):
             raise ModelNotLoadedError(model_id)
         return model.predict_bytes(payload)
 
+    # -- batched dispatch (serving/batching.py data plane) -----------------
+
+    @property
+    def supports_batched_dispatch(self) -> bool:
+        """The store executes micro-batches as real single-kernel
+        dispatches (row-concat per model, stacked-vmap across fused
+        same-family models) — worth a batch queue in front."""
+        return True
+
+    def call_model_batch(self, items, cancel_event=None) -> list:
+        return self.store.predict_batch(
+            [(item.model_id, item.payload) for item in items]
+        )
+
+    def batch_group_key(self, model_id: str) -> str:
+        """Fused-dispatch grouping: co-located models of one
+        layer-streamable family with identical architecture share a
+        queue, so cross-model micro-batches reach predict_batch's
+        stacked kernel. Everything else batches per-model."""
+        from modelmesh_tpu.models.families import LAYER_STREAMABLE_FAMILIES
+
+        if not self.store.fused_enabled:
+            return model_id
+        model = self.store.get(model_id)
+        if (
+            model is None
+            or not model.fuse_key
+            or not model.batch_safe
+            or model.family not in LAYER_STREAMABLE_FAMILIES
+        ):
+            return model_id
+        return f"fuse:{model.fuse_key}"
+
     @property
     def requires_unload(self) -> bool:
         return True
@@ -348,8 +677,12 @@ class InProcessJaxLoader(ModelLoader[ServableModel]):
             arr = np.frombuffer(blob, dtype=leaf.dtype).reshape(leaf.shape)
             new_leaves.append(jnp.asarray(arr))
         params = jax.tree.unflatten(treedef, new_leaves)
+        # Carry the architecture identity: a peer-streamed copy must
+        # batch and fuse exactly like a store-loaded one.
         model = ServableModel(
-            skeleton.apply, params, skeleton.input_shape, skeleton.input_dtype
+            skeleton.apply, params, skeleton.input_shape,
+            skeleton.input_dtype, family=skeleton.family,
+            fuse_key=skeleton.fuse_key, batch_safe=skeleton.batch_safe,
         )
         # Warm like a store load: first inference must not be a compile.
         jax.block_until_ready(jax.tree.leaves(model.params))

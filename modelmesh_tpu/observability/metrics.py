@@ -62,6 +62,15 @@ class Metric(enum.Enum):
                              "snapshots evicted from the host tier")
     PARTIAL_SERVE_COUNT = ("mm_partial_serve_count", "counter",
                            "copies that began serving mid-transfer (PARTIAL)")
+    # batched data plane (serving/batching.py): flush-reason counters
+    BATCH_FLUSH_FULL_COUNT = ("mm_batch_flush_full_count", "counter",
+                              "micro-batches dispatched at MM_BATCH_MAX")
+    BATCH_FLUSH_WINDOW_COUNT = ("mm_batch_flush_window_count", "counter",
+                                "micro-batches dispatched below max "
+                                "(window expired / queue drained)")
+    BATCH_FLUSH_DRAIN_COUNT = ("mm_batch_flush_drain_count", "counter",
+                               "micro-batches flushed by a drain before "
+                               "the copy dropped")
     # histograms (ms)
     API_REQUEST_TIME = ("mm_api_request_time_ms", "histogram", "request latency")
     # Per-stage latency decomposition: closed tracing spans export here
@@ -85,6 +94,11 @@ class Metric(enum.Enum):
     EVICT_AGE = ("mm_evict_age_seconds", "histogram", "entry age at eviction")
     REQUEST_BYTES = ("mm_request_payload_bytes", "histogram", "request payload size")
     RESPONSE_BYTES = ("mm_response_payload_bytes", "histogram", "response payload size")
+    # batched data plane (serving/batching.py): per-dispatch shape
+    BATCH_OCCUPANCY = ("mm_batch_occupancy", "histogram",
+                       "requests per dispatched micro-batch")
+    FUSED_GROUP_SIZE = ("mm_fused_group_size", "histogram",
+                        "distinct models per fused cross-model dispatch")
     # gauges
     MODELS_LOADED = ("mm_models_loaded", "gauge", "local loaded model count")
     CACHE_USED_UNITS = ("mm_cache_used_units", "gauge", "cache units in use")

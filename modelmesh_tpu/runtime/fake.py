@@ -62,6 +62,11 @@ class FakeRuntimeServicer:
         self.load_count = 0      # successful loads
         self.load_attempts = 0   # LoadModel RPCs incl. injected failures
         self.unload_count = 0
+        # Batched-dispatch accounting (predict_batch): batch count and
+        # per-batch sizes, so tests can assert the serving layer's
+        # micro-batch queue really coalesced concurrent requests.
+        self.batch_calls = 0      #: guarded-by: _lock
+        self.batch_sizes: list[int] = []  #: guarded-by: _lock
         self._lock = threading.Lock()
 
     # -- SPI methods ----------------------------------------------------------
@@ -149,6 +154,42 @@ class FakeRuntimeServicer:
         # Deterministic "prediction": classify payload by hash.
         label = (len(request) + sum(request[:16])) % 10
         return f"{mid}:category_{label}".encode()
+
+    def predict_batch(self, items) -> list:
+        """Deterministic batched twin of ``predict`` (direct-call, no
+        gRPC context): per-item results are byte-identical to N solo
+        calls — the batched-vs-sequential identity the serving layer's
+        parity tests pin — with per-item fault isolation (a missing or
+        vanish- model fails only its own slot) and batch accounting for
+        queue assertions. One slow-predict member costs the batch ONE
+        virtual sleep (a fused dispatch is one kernel), not N.
+
+        ``items`` are ``runtime.spi.BatchItem``-shaped (model_id,
+        payload attrs).
+        """
+        from modelmesh_tpu.runtime.spi import ModelNotLoadedError
+
+        with self._lock:
+            self.batch_calls += 1
+            self.batch_sizes.append(len(items))
+        if any(SLOW_PREDICT_MARK in item.model_id for item in items):
+            self._clock.sleep(3.0)
+        out: list = []
+        for item in items:
+            mid = item.model_id
+            with self._lock:
+                present = mid in self.loaded
+            if not present or mid.startswith(NOT_FOUND_SERVE_PREFIX):
+                out.append(ModelNotLoadedError(mid))
+                continue
+            request = item.payload
+            if (getattr(item, "method", "") or "").endswith("/Echo"):
+                # Mirror the solo path's large-payload Echo probe.
+                out.append(request)
+                continue
+            label = (len(request) + sum(request[:16])) % 10
+            out.append(f"{mid}:category_{label}".encode())
+        return out
 
 
 def start_fake_runtime(

@@ -57,6 +57,18 @@ class ModelNotLoadedError(Exception):
 
 
 @dataclasses.dataclass(frozen=True)
+class BatchItem:
+    """One request inside a batched runtime dispatch
+    (``ModelLoader.call_model_batch``). ``headers`` is the per-request
+    metadata list exactly as ``call_model`` receives it."""
+
+    model_id: str
+    method: str = ""
+    payload: bytes = b""
+    headers: Optional[list] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class WeightChunk:
     """One unit of a streamed weight transfer (peer fetch / host-tier
     re-warm). ``layer`` tags the model layer this chunk completes for
@@ -100,6 +112,55 @@ class ModelLoader(abc.ABC, Generic[T]):
         """True if capacity isn't freed until unload completes (drives the
         unload-buffer accounting, ModelCacheUnloadBufManager)."""
         return True
+
+    # -- batched dispatch (optional capability; serving/batching.py) -------
+
+    @property
+    def supports_batched_dispatch(self) -> bool:
+        """True when ``call_model_batch`` executes a whole micro-batch as
+        one (or few) real runtime dispatches, so the serving layer's
+        continuous-batching queue is worth putting in front of this
+        loader. The default loop-over-singles implementation keeps
+        ``call_model_batch`` callable everywhere, but a loader that
+        merely loops gains nothing from queueing — the serving layer
+        only engages the batch queue when this flag is True (or an
+        explicit batched runtime call is injected)."""
+        return False
+
+    def call_model_batch(self, items: list[BatchItem], cancel_event=None):
+        """Execute a micro-batch of inference requests.
+
+        Returns a list aligned with ``items``; each entry is either the
+        response ``bytes`` or an ``Exception`` instance failing THAT
+        item (per-item isolation — one malformed payload must not fail
+        its batch-mates). A raised exception fails the whole batch.
+
+        Default: loop over ``call_model`` singles with per-item error
+        isolation, so sidecar/fake/bench loaders keep working unchanged.
+        """
+        call_model = getattr(self, "call_model", None)
+        if call_model is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no call_model"
+            )
+        out: list = []
+        for item in items:
+            try:
+                out.append(call_model(
+                    item.model_id, item.method, item.payload,
+                    item.headers, cancel_event=cancel_event,
+                ))
+            except Exception as e:  # noqa: BLE001 — per-item isolation
+                out.append(e)
+        return out
+
+    def batch_group_key(self, model_id: str) -> str:
+        """Micro-batch grouping key: requests whose models share a key
+        may ride one dispatch. Default = the model id (per-model
+        batching only); a fused-dispatch-capable loader returns a shared
+        architecture key for co-located same-family models so
+        cross-model requests fuse into one kernel."""
+        return model_id
 
     # -- weight streaming (optional capability; transfer/ subsystem) -------
 

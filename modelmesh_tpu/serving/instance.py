@@ -51,7 +51,9 @@ from modelmesh_tpu.runtime.spi import (
     ModelInfo,
     ModelLoader,
     ModelLoadException,
+    ModelNotLoadedError,
 )
+from modelmesh_tpu.serving.batching import BatchCancelled, RequestBatcher
 from modelmesh_tpu.serving.entry import (
     CacheEntry,
     EntryState,
@@ -172,6 +174,8 @@ class InstanceConfig:
         drain_timeout_ms: Optional[int] = None,
         trace_sample: Optional[int] = None,
         slo_spec: Optional[str] = None,
+        batch_max: Optional[int] = None,
+        batch_window_us: Optional[int] = None,
     ):
         self.instance_id = instance_id or f"i-{uuid.uuid4().hex[:8]}"  # analysis-ok: det-entropy — deliberately unique process identity; every replay-bearing path (sim, scenarios) passes an explicit instance_id
         self.kv_prefix = kv_prefix.rstrip("/")
@@ -249,6 +253,19 @@ class InstanceConfig:
         if slo_spec is None:
             slo_spec = _envs.get("MM_SLO_SPEC")
         self.slo_spec = slo_spec
+        # Batched data plane (serving/batching.py): continuous-batching
+        # micro-batch queue in front of the runtime call. batch_max <= 1
+        # disables the queue; the window (µs) bounds how long a batch
+        # leader waits for the batch to fill (0 = dispatch immediately —
+        # batches still form behind in-flight dispatches). Only engaged
+        # when the loader really batches (supports_batched_dispatch) or
+        # a batched runtime call is injected.
+        if batch_max is None:
+            batch_max = _envs.get_int("MM_BATCH_MAX")
+        self.batch_max = batch_max
+        if batch_window_us is None:
+            batch_window_us = _envs.get_int("MM_BATCH_WINDOW_US")
+        self.batch_window_us = batch_window_us
 
 
 class ModelMeshInstance:
@@ -265,6 +282,7 @@ class ModelMeshInstance:
         upgrade_tracker=None,
         probation=None,
         peer_fetch=None,
+        runtime_call_batch=None,
     ):
         """``peer_call(endpoint, model_id, method, payload, headers, ctx)``
         forwards to a peer (gRPC in production, direct-call in tests).
@@ -273,7 +291,12 @@ class ModelMeshInstance:
         SidecarRuntime.call_model when the loader is a SidecarRuntime); a
         callable without the cancel_event parameter is still accepted —
         cancellation then can't interrupt the call itself, only the waits
-        around it. ``peer_fetch(endpoint, model_id, chunk_index,
+        around it. ``runtime_call_batch(items, cancel_event=None) ->
+        list[bytes | Exception]`` executes a whole micro-batch (aligned
+        results; Exception entries fail individual items) — when given,
+        or when the loader declares ``supports_batched_dispatch``, the
+        continuous-batching queue (serving/batching.py) engages in front
+        of the runtime call. ``peer_fetch(endpoint, model_id, chunk_index,
         fingerprint) -> FetchReply`` pulls one weight chunk from a peer
         (the mesh-internal FetchWeights channel; gRPC in production,
         direct-call in the sim/bench) — None disables peer streaming on
@@ -414,6 +437,30 @@ class ModelMeshInstance:
         )
         self.peer_fetch_transport = peer_fetch
         self.transfer = WeightTransferManager(self)
+
+        # Batched data plane (serving/batching.py): engaged only when
+        # there is a REAL batched dispatch to gain from — an injected
+        # runtime_call_batch (sim/bench twins) or a loader whose
+        # call_model_batch executes the micro-batch as one kernel
+        # (models/server.py). A loader whose batch path merely loops
+        # over singles would SERIALIZE what used to run concurrently,
+        # so it keeps the classic one-at-a-time path.
+        self._runtime_call_batch = runtime_call_batch or (
+            loader.call_model_batch
+            if getattr(loader, "supports_batched_dispatch", False)
+            else None
+        )
+        self.batcher: Optional[RequestBatcher] = None
+        if self._runtime_call_batch is not None and self.config.batch_max > 1:
+            self.batcher = RequestBatcher(
+                self._batch_call_one,
+                self._batch_call_many,
+                group_key=getattr(loader, "batch_group_key", None),
+                batch_max=self.config.batch_max,
+                window_us=self.config.batch_window_us,
+                metrics=self.metrics,
+                flightrec=self.flightrec,
+            )
 
         prefix = self.config.kv_prefix
         # Live registry-migration fence (kv/migrate.py): while an
@@ -1182,7 +1229,22 @@ class ModelMeshInstance:
         try:
             t0 = _time.perf_counter()  #: wall-clock: perf_counter latency metric (runtime invoke)
             with self.tracer.span("runtime-call", model=ce.model_id):
-                if self._runtime_call_cancellable:
+                if self.batcher is not None:
+                    # Batched data plane: ride (or lead) a micro-batch.
+                    # The span stays open on THIS thread for the whole
+                    # submit, so a request executed by a batch leader
+                    # still assembles its own span tree. A PARTIAL
+                    # streamed copy is batchable only solo.
+                    try:
+                        out = self.batcher.submit(
+                            ce.model_id, method, payload, headers,
+                            cancel_event=cancel_event,
+                            solo_only=ce.state is EntryState.PARTIAL,
+                            ctx=ce,
+                        )
+                    except BatchCancelled:
+                        raise RequestCancelledError(ce.model_id) from None
+                elif self._runtime_call_cancellable:
                     out = self._runtime_call(
                         ce, method, payload, headers,
                         cancel_event=cancel_event,
@@ -1204,15 +1266,28 @@ class ModelMeshInstance:
         finally:
             ce.after_invoke()
 
+    def _map_runtime_error(self, exc: Exception, model_id: str):
+        """THE runtime-error-to-serving-exception mapping, shared by the
+        single-call and batched data planes (per-item and collective):
+        NOT_FOUND — as ModelNotLoadedError or a gRPC status — becomes
+        ModelNotHereError (the purge-and-retry trigger), other gRPC
+        errors become ApplierError, anything else passes through."""
+        import grpc
+
+        from modelmesh_tpu.serving.errors import ApplierError
+
+        if isinstance(exc, ModelNotLoadedError):
+            return ModelNotHereError(self.instance_id, model_id)
+        if isinstance(exc, grpc.RpcError):
+            if exc.code() == grpc.StatusCode.NOT_FOUND:
+                return ModelNotHereError(self.instance_id, model_id)
+            return ApplierError(exc.code().name, exc.details() or "")
+        return exc
+
     def _default_runtime_call(
         self, ce: CacheEntry, method: str, payload: bytes,
         headers: list[tuple[str, str]], cancel_event=None,
     ) -> bytes:
-        import grpc
-
-        from modelmesh_tpu.runtime.spi import ModelNotLoadedError
-        from modelmesh_tpu.serving.errors import ApplierError
-
         call_model = getattr(self.loader, "call_model", None)
         if call_model is None:
             raise NotImplementedError(
@@ -1223,12 +1298,44 @@ class ModelMeshInstance:
                 ce.model_id, method, payload, headers,
                 cancel_event=cancel_event,
             )
-        except ModelNotLoadedError as e:
-            raise ModelNotHereError(self.instance_id, ce.model_id) from e
-        except grpc.RpcError as e:
-            if e.code() == grpc.StatusCode.NOT_FOUND:
-                raise ModelNotHereError(self.instance_id, ce.model_id) from e
-            raise ApplierError(e.code().name, e.details() or "") from e
+        except Exception as e:
+            mapped = self._map_runtime_error(e, ce.model_id)
+            if mapped is e:
+                raise
+            raise mapped from e
+
+    # -- batched dispatch plumbing (serving/batching.py) ----------------- #
+
+    def _batch_call_one(self, req) -> bytes:
+        """Zero-copy passthrough for an uncontended request: the exact
+        single-call runtime path the unbatched data plane takes."""
+        ce = req.ctx
+        if self._runtime_call_cancellable:
+            return self._runtime_call(
+                ce, req.method, req.payload, req.headers,
+                cancel_event=req.cancel_event,
+            )
+        return self._runtime_call(ce, req.method, req.payload, req.headers)
+
+    def _batch_call_many(self, items, cancel_event=None) -> list:
+        """Batched dispatch: hand the micro-batch to the loader (or the
+        injected batched runtime call) and run _map_runtime_error over
+        the outcome — per-item entries and collectively-raised failures
+        alike — so the batched and unbatched data planes can never
+        diverge in retry vocabulary (NOT_FOUND triggers purge-and-retry
+        for every affected member)."""
+        try:
+            outs = self._runtime_call_batch(items, cancel_event=cancel_event)
+        except Exception as e:
+            mapped = self._map_runtime_error(e, items[0].model_id)
+            if mapped is e:
+                raise
+            raise mapped from e
+        return [
+            self._map_runtime_error(out, item.model_id)
+            if isinstance(out, Exception) else out
+            for item, out in zip(items, outs)
+        ]
 
     def _trigger_chained_load(self, ce: CacheEntry) -> None:
         """Chained copy loads: each instance that completes a chained load
@@ -1891,6 +1998,13 @@ class ModelMeshInstance:
 
         def post_evict():
             try:
+                # Flush the batch queue before the runtime copy drops:
+                # parked requests ride a final (drain-flagged) dispatch
+                # against the still-live handle instead of racing the
+                # unload below. Runs on the unload pool — never under
+                # the eviction lock.
+                if self.batcher is not None:
+                    self.batcher.flush(model_id, timeout_s=2.0)
                 # Demote-to-host ahead of the full drop: export the
                 # weights into the host tier BEFORE the runtime unload
                 # releases the handle, so a re-warm is a device copy and
@@ -2027,6 +2141,12 @@ class ModelMeshInstance:
             if not demote:
                 self.transfer.drop_host_copy(model_id)
             return False
+        # Batch-queue drain integration (PR 7): flush parked requests
+        # through a final dispatch BEFORE the copy drops, so a drain's
+        # zero-gap guarantee extends to requests already queued behind
+        # an in-flight micro-batch.
+        if self.batcher is not None and ce.state.is_servable:
+            self.batcher.flush(model_id, timeout_s=2.0)
         demoted = False
         if demote:
             demoted = ce.state is EntryState.ACTIVE and (

@@ -15,6 +15,7 @@ direct-tick tests in tests/test_sim_cluster.py.
 from __future__ import annotations
 
 import contextlib
+import functools
 import logging
 import threading
 from typing import Optional
@@ -280,6 +281,11 @@ class SimCluster:
         # that never triggered (stream never started) fails loudly
         # instead of passing vacuously.
         self.transfer_faults_fired: list[tuple[str, str, str]] = []
+        # Batched data plane observability: one row per batched runtime
+        # dispatch — (virtual_ms, instance_id, batch_size, distinct
+        # models). Scenario checks assert the queue/flush state machine
+        # coalesced concurrent requests under virtual time.
+        self.batch_dispatches: list[tuple[int, str, int, int]] = []
         self._n = 0
         for _ in range(n):
             self.add_instance(
@@ -325,6 +331,13 @@ class SimCluster:
             peer_call=self._peer_call,
             peer_fetch=self._peer_fetch,
             runtime_call=self._runtime_call,
+            # Deterministic batched twin: sim instances run the full
+            # continuous-batching queue (serving/batching.py) under
+            # virtual time, dispatching through the same per-pod checks
+            # as the single-call path.
+            runtime_call_batch=functools.partial(
+                self._runtime_call_batch, iid
+            ),
         )
         tasks = BackgroundTasks(inst, self.task_config)
         pod = SimPod(inst, tasks, loader)
@@ -448,6 +461,31 @@ class SimCluster:
                     raise ModelNotHereError(pod.iid, mid)
                 return f"{mid}:sim".encode()
         raise ModelNotHereError("?", mid)
+
+    def _runtime_call_batch(
+        self, iid: str, items, cancel_event=None
+    ) -> list:
+        """Batched twin of ``_runtime_call``: per-item results are
+        byte-identical to N solo calls (the batched-vs-sequential
+        identity), with per-item isolation — a model the pod's loader
+        lost fails only its own slot. Each dispatch is recorded with
+        its virtual timestamp for scenario assertions."""
+        from modelmesh_tpu.cache.lru import now_ms
+        from modelmesh_tpu.runtime.spi import ModelNotLoadedError
+
+        pod = self._find(iid)
+        self.batch_dispatches.append((
+            now_ms(), iid, len(items),
+            len({item.model_id for item in items}),
+        ))
+        out: list = []
+        for item in items:
+            mid = item.model_id
+            if pod is None or not pod.alive or not pod.loader.is_loaded(mid):
+                out.append(ModelNotLoadedError(mid))
+            else:
+                out.append(f"{mid}:sim".encode())
+        return out
 
     # -- faults ------------------------------------------------------------
 

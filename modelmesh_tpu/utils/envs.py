@@ -137,6 +137,31 @@ REGISTRY: dict[str, EnvVar] = {
                "rolling-upgrade wave width (reconfig/rolling.py): at most "
                "this many instances drain concurrently per wave",
                "reconfig/rolling.py"),
+        EnvVar("MM_BATCH_MAX", "int", "8",
+               "continuous-batching micro-batch ceiling on the runtime "
+               "data plane (serving/batching.py): concurrent requests "
+               "for one model (or one fused family group) ride a single "
+               "batched dispatch of up to this many requests; <= 1 "
+               "disables the batch queue entirely. Engaged only for "
+               "loaders with a real batched dispatch "
+               "(supports_batched_dispatch) or an injected batched "
+               "runtime call — an uncontended request always takes the "
+               "zero-copy passthrough", "serving/instance.py"),
+        EnvVar("MM_BATCH_WINDOW_US", "int", "0",
+               "micro-batch fill window (microseconds): how long a batch "
+               "leader waits for parked requests to fill the batch "
+               "before dispatching below MM_BATCH_MAX. 0 (default) "
+               "dispatches immediately — batches still form behind "
+               "in-flight dispatches (continuous batching), with no "
+               "timer on the uncontended path", "serving/instance.py"),
+        EnvVar("MM_FUSED_DISPATCH", "bool", "1",
+               "fused cross-model dispatch on the JAX runtime "
+               "(models/server.py): co-located same-architecture models "
+               "of a layer-streamable family share one batch group and "
+               "execute a multi-model micro-batch as ONE stacked kernel "
+               "(parameter pytrees stacked along a leading expert axis, "
+               "per-request model-index route), falling back per-model "
+               "when shapes diverge", "models/server.py"),
         EnvVar("MM_ROUTE_CACHE", "bool", "1",
                "memoize the per-model serve-route decision on the request "
                "hot path (invalidated by registry version, instances-view "

@@ -1,0 +1,760 @@
+"""Batched multi-model data plane (serving/batching.py + the batched SPI).
+
+Three layers of coverage:
+
+- **Queue state machine** (stub dispatchers, deterministic contention):
+  single-request zero-copy passthrough, coalescing behind an in-flight
+  dispatch, PARTIAL/solo-only isolation, flush-on-drain ordering,
+  per-item vs collective failure, parked-request cancellation.
+
+- **Numerical parity** (the tier-1 gate the acceptance criteria pin):
+  batched and sequential execution of the REAL JAX runtime produce
+  bit-for-bit identical outputs on CPU f32 — same-model row-concat
+  batching, fused cross-model dispatch, and the shape-bucketing padding
+  all included; plus the mixed-architecture fallback.
+
+- **Sim integration** (seeded, virtual time): batched invokes through a
+  SimCluster still assemble ONE span tree per request, and the
+  deterministic batched twin records the dispatches the queue coalesced.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from modelmesh_tpu.runtime.spi import BatchItem, ModelInfo
+from modelmesh_tpu.serving.batching import BatchCancelled, RequestBatcher
+
+
+class _Recorder:
+    """Minimal flightrec stand-in capturing batch-flush events."""
+
+    def __init__(self):
+        self.events = []
+
+    def record(self, kind, **attrs):
+        self.events.append((kind, attrs))
+
+
+def _echo_one(req):
+    return b"one:" + req.payload
+
+
+def _echo_many(items, cancel_event=None):
+    return [b"many:" + item.payload for item in items]
+
+
+class TestBatchQueue:
+    def test_single_request_passthrough_identity(self):
+        """An uncontended request takes the zero-copy passthrough: the
+        single-call path runs, no batch forms, no window is waited."""
+        b = RequestBatcher(_echo_one, _echo_many, batch_max=8,
+                           window_us=500_000)
+        t0 = time.perf_counter()
+        out = b.submit("m", "p", b"x", [])
+        elapsed = time.perf_counter() - t0
+        assert out == b"one:x"
+        assert b.solo_count == 1 and b.batch_count == 0
+        # The 500ms window must NOT apply to the passthrough.
+        assert elapsed < 0.25
+
+    def test_concurrent_requests_coalesce_into_one_dispatch(self):
+        """Requests arriving while a dispatch is in flight park and ride
+        ONE batched dispatch when it completes."""
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def slow_one(req):
+            entered.set()
+            gate.wait(5)
+            return b"one:" + req.payload
+
+        batches = []
+
+        def many(items, cancel_event=None):
+            batches.append([item.model_id for item in items])
+            return [b"many:" + item.payload for item in items]
+
+        b = RequestBatcher(slow_one, many, batch_max=8)
+        results = {}
+
+        def run(k):
+            results[k] = b.submit("m", "p", b"r%d" % k, [])
+
+        ts = [threading.Thread(target=run, args=(k,)) for k in range(4)]
+        ts[0].start()
+        assert entered.wait(5)
+        for t in ts[1:]:
+            t.start()
+        # Followers must be parked before the leader completes.
+        deadline = time.monotonic() + 5
+        while b.depth("m") < 4 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert b.depth("m") == 4  # 1 in flight + 3 parked
+        gate.set()
+        for t in ts:
+            t.join(5)
+        assert results[0] == b"one:r0"
+        assert all(results[k] == b"many:r%d" % k for k in (1, 2, 3))
+        assert batches == [["m", "m", "m"]]
+        assert b.solo_count == 1 and b.batch_count == 1
+        assert b.batched_requests == 3
+
+    def test_batch_max_bounds_dispatch_size(self):
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def slow_one(req):
+            entered.set()
+            gate.wait(5)
+            return b"s"
+
+        sizes = []
+
+        def many(items, cancel_event=None):
+            sizes.append(len(items))
+            return [b"b" for _ in items]
+
+        b = RequestBatcher(slow_one, many, batch_max=2)
+        ts = [threading.Thread(target=lambda: b.submit("m", "p", b"x", []))
+              for _ in range(6)]
+        ts[0].start()
+        assert entered.wait(5)
+        for t in ts[1:]:
+            t.start()
+        deadline = time.monotonic() + 5
+        while b.depth("m") < 6 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        gate.set()
+        for t in ts:
+            t.join(5)
+        assert sizes and max(sizes) <= 2
+        assert sum(sizes) == 5  # 1 passthrough + 5 batched
+
+    def test_partial_entries_batch_only_solo(self):
+        """solo_only requests (PARTIAL copies) never share a dispatch —
+        neither leading a batch nor being collected into one."""
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def slow_one(req):
+            entered.set()
+            gate.wait(5)
+            return b"s"
+
+        batches = []
+
+        def many(items, cancel_event=None):
+            batches.append(len(items))
+            return [b"b" for _ in items]
+
+        b = RequestBatcher(slow_one, many, batch_max=8)
+
+        def run(solo):
+            b.submit("m", "p", b"x", [], solo_only=solo)
+
+        # in-flight, then parked: [solo, normal, normal, solo, normal]
+        plan = [False, True, False, False, True, False]
+        ts = [threading.Thread(target=run, args=(s,)) for s in plan]
+        ts[0].start()
+        assert entered.wait(5)
+        for t in ts[1:]:
+            t.start()
+        deadline = time.monotonic() + 5
+        while b.depth("m") < len(plan) and time.monotonic() < deadline:
+            time.sleep(0.005)
+        gate.set()
+        for t in ts:
+            t.join(5)
+        # Parked: solo(1) | normal+normal(2) | solo(1) | normal(1) —
+        # solo_only requests always dispatch alone, and never absorb
+        # followers.
+        assert sorted(batches) == [1, 1, 1, 2]
+
+    def test_flush_on_drain_ordering(self):
+        """flush() completes every parked request through a final
+        dispatch BEFORE returning, records the drain flush reason, and
+        preserves FIFO order."""
+        gate = threading.Event()
+        entered = threading.Event()
+        order = []
+
+        def slow_one(req):
+            entered.set()
+            gate.wait(5)
+            order.append(req.payload)
+            return b"s"
+
+        def many(items, cancel_event=None):
+            order.extend(item.payload for item in items)
+            return [b"b" for _ in items]
+
+        rec = _Recorder()
+        # A huge fill window that drain must SKIP: with the queue
+        # draining, leaders dispatch immediately.
+        b = RequestBatcher(slow_one, many, batch_max=8,
+                           window_us=10_000_000, flightrec=rec)
+        done = []
+
+        def run(k):
+            b.submit("m", "p", b"r%d" % k, [])
+            done.append(k)
+
+        ts = [threading.Thread(target=run, args=(k,)) for k in range(5)]
+        ts[0].start()
+        assert entered.wait(5)
+        for t in ts[1:]:
+            t.start()
+        deadline = time.monotonic() + 5
+        while b.depth("m") < 5 and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+        flushed = []
+
+        def flush():
+            flushed.append(b.flush("m", timeout_s=10.0))
+
+        ft = threading.Thread(target=flush)
+        ft.start()
+        time.sleep(0.05)
+        assert not flushed  # flush waits while requests are in flight
+        gate.set()
+        ft.join(10)
+        for t in ts:
+            t.join(5)
+        assert flushed == [True]
+        # Every parked request executed before flush returned, in FIFO
+        # order, and the post-drain batches carried the drain reason.
+        assert order == [b"r0", b"r1", b"r2", b"r3", b"r4"]
+        assert len(done) == 5
+        reasons = [a["reason"] for k, a in rec.events if k == "batch-flush"]
+        assert "drain" in reasons
+
+    def test_flush_waits_only_for_its_model_in_fused_group(self):
+        """A fused group's flush must track ITS model's requests, not
+        whole-queue idleness: flushing model A while sibling B keeps the
+        shared queue busy returns promptly instead of burning the
+        timeout (the zero-gap drain would otherwise drop A's copy with
+        the flush unfinished whenever a sibling has steady traffic)."""
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def slow_one(req):
+            entered.set()
+            gate.wait(5)
+            return b"s"
+
+        b = RequestBatcher(slow_one, _echo_many, batch_max=8,
+                           group_key=lambda mid: "fam")
+        ts = [
+            threading.Thread(target=lambda: b.submit("b", "p", b"x", []))
+            for _ in range(3)
+        ]
+        ts[0].start()
+        assert entered.wait(5)
+        for t in ts[1:]:
+            t.start()
+        deadline = time.monotonic() + 5
+        while b.depth("b") < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # Queue busy with B only: flushing A is instant and True.
+        t0 = time.perf_counter()
+        assert b.flush("a", timeout_s=5.0) is True
+        assert time.perf_counter() - t0 < 1.0
+        gate.set()
+        for t in ts:
+            t.join(5)
+
+    def test_flush_of_idle_model_is_instant(self):
+        b = RequestBatcher(_echo_one, _echo_many, batch_max=8)
+        t0 = time.perf_counter()
+        assert b.flush("never-seen") is True
+        assert time.perf_counter() - t0 < 0.1
+
+    def test_idle_queues_retained_below_bound_pruned_above(self):
+        """Steady traffic reuses its queue object (no per-request
+        registry churn); model churn past the bound prunes."""
+        b = RequestBatcher(_echo_one, _echo_many, batch_max=8)
+        b.submit("m", "p", b"x", [])
+        q = b._queues.get("m")
+        assert q is not None  # retained while idle
+        b.submit("m", "p", b"x", [])
+        assert b._queues.get("m") is q  # reused, not reallocated
+        b.max_idle_queues = 2
+        for k in range(6):
+            b.submit(f"churn-{k}", "p", b"x", [])
+        # Each completion past the bound prunes its own idle queue.
+        assert len(b._queues) <= 3
+
+    def test_per_item_error_isolation(self):
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def slow_one(req):
+            entered.set()
+            gate.wait(5)
+            return b"s"
+
+        def many(items, cancel_event=None):
+            return [
+                ValueError("bad") if item.payload == b"poison"
+                else b"ok" for item in items
+            ]
+
+        b = RequestBatcher(slow_one, many, batch_max=8)
+        results = {}
+
+        def run(k, payload):
+            try:
+                results[k] = b.submit("m", "p", payload, [])
+            except Exception as e:  # noqa: BLE001
+                results[k] = e
+
+        ts = [threading.Thread(target=run, args=(k, p)) for k, p in
+              enumerate([b"x", b"good", b"poison", b"good2"])]
+        ts[0].start()
+        assert entered.wait(5)
+        for t in ts[1:]:
+            t.start()
+        deadline = time.monotonic() + 5
+        while b.depth("m") < 4 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        gate.set()
+        for t in ts:
+            t.join(5)
+        assert results[1] == b"ok" and results[3] == b"ok"
+        assert isinstance(results[2], ValueError)
+
+    def test_raising_instrumentation_sink_cannot_strand_followers(self):
+        """An exception escaping the dispatch BEFORE the runtime call
+        (e.g. a raising metrics sink) must still mark every batch
+        member done — followers would otherwise spin forever on their
+        already-set events."""
+
+        class _RaisingMetrics:
+            def observe(self, *a, **k):
+                raise RuntimeError("sink died")
+
+            def inc(self, *a, **k):
+                raise RuntimeError("sink died")
+
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def slow_one(req):
+            entered.set()
+            gate.wait(5)
+            return b"s"
+
+        b = RequestBatcher(slow_one, _echo_many, batch_max=8,
+                           metrics=_RaisingMetrics())
+        results = {}
+
+        def run(k):
+            try:
+                results[k] = b.submit("m", "p", b"x", [])
+            except Exception as e:  # noqa: BLE001
+                results[k] = e
+
+        ts = [threading.Thread(target=run, args=(k,)) for k in range(3)]
+        ts[0].start()
+        assert entered.wait(5)
+        for t in ts[1:]:
+            t.start()
+        deadline = time.monotonic() + 5
+        while b.depth("m") < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        gate.set()
+        for t in ts:
+            t.join(10)
+            assert not t.is_alive(), "follower stranded by raising sink"
+        assert results[0] == b"s"  # passthrough never hits the batch path
+        assert isinstance(results[1], RuntimeError)
+        assert isinstance(results[2], RuntimeError)
+
+    def test_collective_failure_fails_whole_batch(self):
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def slow_one(req):
+            entered.set()
+            gate.wait(5)
+            return b"s"
+
+        def many(items, cancel_event=None):
+            raise RuntimeError("kernel died")
+
+        b = RequestBatcher(slow_one, many, batch_max=8)
+        results = {}
+
+        def run(k):
+            try:
+                results[k] = b.submit("m", "p", b"x", [])
+            except Exception as e:  # noqa: BLE001
+                results[k] = e
+
+        ts = [threading.Thread(target=run, args=(k,)) for k in range(3)]
+        ts[0].start()
+        assert entered.wait(5)
+        for t in ts[1:]:
+            t.start()
+        deadline = time.monotonic() + 5
+        while b.depth("m") < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        gate.set()
+        for t in ts:
+            t.join(5)
+        assert results[0] == b"s"
+        assert isinstance(results[1], RuntimeError)
+        assert isinstance(results[2], RuntimeError)
+
+    def test_parked_request_cancellation(self):
+        """A parked request whose client disconnects withdraws cleanly
+        (BatchCancelled) without wedging the queue."""
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def slow_one(req):
+            entered.set()
+            gate.wait(5)
+            return b"s"
+
+        b = RequestBatcher(slow_one, _echo_many, batch_max=8)
+        cancel = threading.Event()
+        outcome = []
+
+        def cancelled_run():
+            try:
+                b.submit("m", "p", b"x", [], cancel_event=cancel)
+                outcome.append("served")
+            except BatchCancelled:
+                outcome.append("cancelled")
+
+        t0 = threading.Thread(target=lambda: b.submit("m", "p", b"x", []))
+        t0.start()
+        assert entered.wait(5)
+        t1 = threading.Thread(target=cancelled_run)
+        t1.start()
+        deadline = time.monotonic() + 5
+        while b.depth("m") < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        cancel.set()
+        t1.join(5)
+        assert outcome == ["cancelled"]
+        gate.set()
+        t0.join(5)
+        # Queue fully drained afterwards.
+        assert b.depth("m") == 0
+
+    def test_fused_group_key_shares_one_queue(self):
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def slow_one(req):
+            entered.set()
+            gate.wait(5)
+            return b"s"
+
+        batches = []
+
+        def many(items, cancel_event=None):
+            batches.append(sorted({item.model_id for item in items}))
+            return [b"b" for _ in items]
+
+        b = RequestBatcher(slow_one, many, batch_max=8,
+                           group_key=lambda mid: "fam")
+        ts = [
+            threading.Thread(
+                target=lambda m=m: b.submit(m, "p", b"x", [])
+            )
+            for m in ("a", "b", "c")
+        ]
+        ts[0].start()
+        assert entered.wait(5)
+        for t in ts[1:]:
+            t.start()
+        deadline = time.monotonic() + 5
+        while b.depth("a") < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        gate.set()
+        for t in ts:
+            t.join(5)
+        assert batches == [["b", "c"]]  # cross-MODEL batch, one dispatch
+
+
+@pytest.fixture(scope="module")
+def jax_loader():
+    from modelmesh_tpu.models.server import InProcessJaxLoader
+
+    loader = InProcessJaxLoader(capacity_bytes=1 << 30)
+    mlp = ModelInfo("mlp", "mlp://in=16,hidden=32,out=4,depth=2")
+    for i in range(3):
+        loader.load(f"p-{i}", mlp)
+    loader.load("p-linear", ModelInfo("linear", "linear://in=16,out=4"))
+    return loader
+
+
+def _payloads(counts=(1, 3, 2)):
+    rng = np.random.default_rng(42)
+    return [
+        rng.standard_normal((n, 16)).astype(np.float32).tobytes()
+        for n in counts
+    ]
+
+
+class TestJaxBatchParity:
+    """The acceptance-criteria parity gate: batched output ==
+    sequential output, bit-for-bit, CPU f32."""
+
+    def test_same_model_batch_bitwise_parity(self, jax_loader):
+        pls = _payloads()
+        sequential = [jax_loader.call_model("p-0", "", p) for p in pls]
+        batched = jax_loader.call_model_batch(
+            [BatchItem("p-0", payload=p) for p in pls]
+        )
+        assert batched == sequential  # bytes equality == bitwise f32
+
+    def test_fused_cross_model_bitwise_parity(self, jax_loader):
+        pls = _payloads()
+        mids = [f"p-{i}" for i in range(3)]
+        sequential = [
+            jax_loader.call_model(m, "", p) for m, p in zip(mids, pls)
+        ]
+        batched = jax_loader.call_model_batch(
+            [BatchItem(m, payload=p) for m, p in zip(mids, pls)]
+        )
+        assert batched == sequential
+        # And the fused path really fused: same-arch streamable models
+        # share a group key.
+        keys = {jax_loader.batch_group_key(m) for m in mids}
+        assert len(keys) == 1 and next(iter(keys)).startswith("fuse:")
+
+    def test_mixed_architecture_falls_back_per_model(self, jax_loader):
+        pls = _payloads((2, 2))
+        items = [
+            BatchItem("p-0", payload=pls[0]),
+            BatchItem("p-linear", payload=pls[1]),
+        ]
+        batched = jax_loader.call_model_batch(items)
+        assert batched[0] == jax_loader.call_model("p-0", "", pls[0])
+        assert batched[1] == jax_loader.call_model("p-linear", "", pls[1])
+        # Different architectures never share a group.
+        assert (
+            jax_loader.batch_group_key("p-0")
+            != jax_loader.batch_group_key("p-linear")
+        )
+
+    def test_missing_model_isolated_in_batch(self, jax_loader):
+        from modelmesh_tpu.runtime.spi import ModelNotLoadedError
+
+        pls = _payloads((1, 1))
+        out = jax_loader.call_model_batch([
+            BatchItem("no-such-model", payload=pls[0]),
+            BatchItem("p-0", payload=pls[1]),
+        ])
+        assert isinstance(out[0], ModelNotLoadedError)
+        assert out[1] == jax_loader.call_model("p-0", "", pls[1])
+
+    def test_moe_transformer_batches_per_request_bitwise(self, jax_loader):
+        """MoE transformers are batch-COUPLED: capacity-based top-1
+        routing makes every token's slot depend on the whole batch, so
+        concatenating requests or zero-row padding would change real
+        outputs. They must dispatch per-request inside a batch — and
+        the results must stay bit-for-bit equal to solo calls."""
+        moe = ModelInfo(
+            "transformer",
+            "transformer://vocab=64,d=32,layers=1,heads=2,seq=8,experts=4",
+        )
+        jax_loader.load("p-moe-a", moe)
+        jax_loader.load("p-moe-b", moe)
+        model = jax_loader.store.get("p-moe-a")
+        assert model.batch_safe is False
+        # Never fused, despite transformer being a streamable family.
+        assert jax_loader.batch_group_key("p-moe-a") == "p-moe-a"
+        rng = np.random.default_rng(3)
+        pls = [
+            rng.integers(0, 64, (n, 8)).astype(np.int32).tobytes()
+            for n in (1, 3, 2)
+        ]
+        # Same-model multi-request batch == solo calls, bitwise.
+        sequential = [jax_loader.call_model("p-moe-a", "", p) for p in pls]
+        batched = jax_loader.call_model_batch(
+            [BatchItem("p-moe-a", payload=p) for p in pls]
+        )
+        assert batched == sequential
+        # Cross-model batch of two MoE models: per-model, still bitwise.
+        out = jax_loader.call_model_batch([
+            BatchItem("p-moe-a", payload=pls[0]),
+            BatchItem("p-moe-b", payload=pls[1]),
+        ])
+        assert out[0] == jax_loader.call_model("p-moe-a", "", pls[0])
+        assert out[1] == jax_loader.call_model("p-moe-b", "", pls[1])
+
+    def test_stacked_cache_counted_in_used_bytes(self, jax_loader):
+        """The fused stack is a real weights duplicate — capacity
+        accounting must see it."""
+        base = sum(
+            m.size_bytes for m in jax_loader.store._models.values()
+        )
+        pls = _payloads()
+        jax_loader.call_model_batch(
+            [BatchItem(f"p-{i}", payload=pls[i]) for i in range(3)]
+        )
+        assert jax_loader.store._stacked  # cached
+        assert jax_loader.store.used_bytes > base
+
+    def test_fused_disabled_keeps_per_model_groups(self, jax_loader):
+        jax_loader.store.fused_enabled = False
+        try:
+            assert jax_loader.batch_group_key("p-0") == "p-0"
+        finally:
+            jax_loader.store.fused_enabled = True
+
+    def test_instance_concurrency_parity(self):
+        """Through the full serving stack under real concurrency:
+        batched results match the sequential baseline byte-for-byte."""
+        from modelmesh_tpu.kv import InMemoryKV
+        from modelmesh_tpu.models.server import InProcessJaxLoader
+        from modelmesh_tpu.serving.instance import (
+            InstanceConfig,
+            ModelMeshInstance,
+            RoutingContext,
+        )
+
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        inst = ModelMeshInstance(
+            kv, InProcessJaxLoader(capacity_bytes=1 << 30),
+            InstanceConfig(instance_id="i-par", load_timeout_s=60,
+                           min_churn_age_ms=0),
+        )
+        try:
+            assert inst.batcher is not None  # real batched loader
+            info = ModelInfo("mlp", "mlp://in=16,hidden=32,out=4")
+            mids = [f"c-{i}" for i in range(3)]
+            for mid in mids:
+                inst.register_model(mid, info)
+                inst.invoke_model(
+                    mid, None, b"", [],
+                    RoutingContext(hop=RoutingContext.LOAD_LOCAL_ONLY),
+                    sync=True,
+                )
+            payload = np.ones((1, 16), np.float32).tobytes()
+            expect = {
+                mid: inst.invoke_model(mid, "predict", payload, []).payload
+                for mid in mids
+            }
+            results, errors = {}, []
+
+            def hit(mid, k):
+                try:
+                    r = inst.invoke_model(mid, "predict", payload, [])
+                    results[(mid, k)] = r.payload
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            ts = [
+                threading.Thread(target=hit, args=(mids[k % 3], k))
+                for k in range(24)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(30)
+            assert not errors
+            assert all(v == expect[mid] for (mid, _), v in results.items())
+        finally:
+            inst.shutdown()
+            kv.close()
+
+
+class TestSimBatching:
+    """Seeded sim scenario: the queue/flush state machine under virtual
+    time, with per-request span-tree integrity."""
+
+    def test_batched_invokes_assemble_one_span_tree_per_request(self):
+        from modelmesh_tpu.sim.harness import SimCluster
+        from modelmesh_tpu.sim.tracing import TraceCollector
+        from modelmesh_tpu.utils import clock as clock_mod
+        from modelmesh_tpu.utils.clock import VirtualClock
+
+        clock = VirtualClock()
+        prev = clock_mod.install(clock)
+        cluster = None
+        try:
+            cluster = SimCluster(n=2, seed=7, start_tasks=False,
+                                 load_delay_ms=0.0)
+            pod = cluster.pods[0]
+            inst = pod.instance
+            assert inst.batcher is not None  # sim twin injected
+            inst.register_model("bm", ModelInfo("example", "mem://bm"))
+            from modelmesh_tpu.serving.instance import RoutingContext
+
+            inst.invoke_model(
+                "bm", None, b"", [],
+                RoutingContext(hop=RoutingContext.LOAD_LOCAL_ONLY),
+                sync=True,
+            )
+            # Deterministic contention: hold the passthrough dispatch
+            # open until followers are parked, so a real batch forms.
+            gate = threading.Event()
+            entered = threading.Event()
+            real_one = inst._runtime_call
+
+            def gated_one(ce, method, payload, headers, cancel_event=None):
+                if not entered.is_set():
+                    entered.set()
+                    gate.wait(10)
+                return real_one(ce, method, payload, headers,
+                                cancel_event=cancel_event)
+
+            inst._runtime_call = gated_one
+            trace_ids, results = [], []
+            lock = threading.Lock()
+
+            def request(k):
+                from modelmesh_tpu.observability.tracing import Tracer
+
+                with inst.tracer.trace("", "bm", "predict"):
+                    tid = Tracer.current_trace_id()
+                    out = inst.invoke_model("bm", "predict", b"x", [])
+                with lock:
+                    trace_ids.append(tid)
+                    results.append(out.payload)
+
+            ts = [threading.Thread(target=request, args=(k,))
+                  for k in range(5)]
+            ts[0].start()
+            assert entered.wait(10)
+            for t in ts[1:]:
+                t.start()
+            deadline = time.monotonic() + 10
+            while inst.batcher.depth("bm") < 5 and (
+                time.monotonic() < deadline
+            ):
+                time.sleep(0.005)
+            gate.set()
+            for t in ts:
+                t.join(30)
+            assert len(results) == 5
+            assert all(r == b"bm:sim" for r in results)
+            # The deterministic twin really coalesced: one dispatch
+            # carried multiple requests, recorded with virtual time.
+            sizes = [size for _, _, size, _ in cluster.batch_dispatches]
+            assert sizes and max(sizes) >= 2
+            # Span-tree integrity: every request assembles its OWN
+            # single tree, each containing exactly one runtime-call
+            # span — batch-mates never collapse into one tree.
+            collector = TraceCollector(cluster)
+            assert len(set(trace_ids)) == 5
+            for tid in trace_ids:
+                root = collector.tree(tid)
+                assert root is not None
+                names = [n.name for n in root.walk()]
+                assert names.count("runtime-call") == 1
+        finally:
+            if cluster is not None:
+                cluster.close()
+            clock_mod.install(prev)
+            clock.close()

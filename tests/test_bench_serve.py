@@ -10,6 +10,8 @@ core are noise; structure and correctness are the contract.
 import sys
 from pathlib import Path
 
+import pytest
+
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import bench_serve
@@ -17,7 +19,12 @@ import bench_serve
 
 class TestBenchServeSmoke:
     def test_tiny_run_produces_all_scenarios(self):
-        out = bench_serve.run(tiers=(1, 4), reps=25, select_iters=200)
+        out = bench_serve.run(
+            tiers=(1, 4), reps=25, select_iters=200,
+            throughput_kwargs=dict(
+                n_models=2, threads=4, reps_per_thread=10
+            ),
+        )
         assert out["route_cache_enabled"] in (True, False)
         assert out["route_cache_ttl_ms"] >= 1
         tiers = {t["instances"]: t for t in out["tiers"]}
@@ -52,6 +59,48 @@ class TestBenchServeSmoke:
         assert tr["sample_n"] >= 1
         assert tr["local_invoke_off_us"] > 0 and tr["local_invoke_on_us"] > 0
         assert tr["route_forward_off_us"] > 0
+
+        tpd = out["throughput_per_device"]
+        assert tpd["devices"] >= 1
+        if tpd.get("batching_disabled"):
+            pytest.skip("MM_BATCH_MAX<=1: no batched mode to smoke")
+        assert tpd["sequential"]["rps"] > 0 and tpd["batched"]["rps"] > 0
+
+
+class TestThroughputPerDeviceSmoke:
+    """Tier-1 smoke for the batched-data-plane headline scenario
+    (the PR-11 smoke-floor convention: a compressed run on a contended
+    shared core must still clear a conservative floor, with retries so
+    one scheduler hiccup can't fake a regression)."""
+
+    FLOOR = 1.3
+
+    def test_field_contract_and_batched_floor(self):
+        out = None
+        for attempt in range(3):
+            out = bench_serve.throughput_per_device(
+                n_models=3, threads=12, reps_per_thread=30 + 20 * attempt
+            )
+            if out.get("batching_disabled"):
+                pytest.skip("MM_BATCH_MAX<=1: no batched mode to smoke")
+            # Field contract first — it must hold on every attempt.
+            for mode in ("sequential", "batched"):
+                stats = out[mode]
+                assert stats["reps"] == 12 * (30 + 20 * attempt)
+                assert stats["rps"] > 0
+                assert stats["p99_us"] >= stats["p50_us"] > 0
+            assert out["devices"] >= 1
+            assert out["batched_rps_per_device"] > 0
+            assert out["speedup"] is not None
+            # Non-vacuity: the batched mode really batched.
+            assert out["batches_dispatched"] > 0
+            assert out["mean_batch_occupancy"] > 1.0
+            if out["speedup"] >= self.FLOOR:
+                break
+        assert out["speedup"] >= self.FLOOR, (
+            f"batched throughput only {out['speedup']}x sequential "
+            f"(floor {self.FLOOR}x): {out}"
+        )
 
 
 class TestTracingOverheadGate:

@@ -21,6 +21,13 @@ Scenarios per tier:
                    (µs/op + speedup): the number the route cache exists
                    to improve, isolated from invoke plumbing.
 
+Plus ``tail_latency_under_skew`` (the load-aware-routing headline):
+Zipf traffic from concurrent requester threads over models replicated
+on every synthetic peer, with a queueing service model on the stub
+transport — cached single winner (MM_ROUTE_D=1) vs power-of-d choices
+driven by piggybacked load feedback, reporting p50/p99 and per-instance
+load spread (max/mean peak in-flight and served counts).
+
 Plus ``throughput_per_device`` (the batched-data-plane headline): one
 real instance over the in-process JAX runtime, concurrent requester
 threads over co-located same-family models, one-at-a-time baseline vs
@@ -226,6 +233,181 @@ def _bench_tier(n_instances: int, reps: int, select_iters: int) -> dict:
             )
         out["forwards_observed"] = len(forwards)
         return out
+    finally:
+        inst.shutdown()
+        kv.close()
+
+
+def tail_latency_under_skew(
+    n_peers: int = 8,
+    n_models: int = 8,
+    threads: int = 16,
+    reps_per_thread: int = 60,
+    zipf_s: float = 1.2,
+    base_ms: float = 1.0,
+    per_inflight_ms: float = 1.5,
+) -> dict:
+    """The load-aware-routing headline: Zipf traffic over N peer copies,
+    cached single winner (MM_ROUTE_D=1 — the PR-2 behavior) vs
+    power-of-d choices + piggybacked feedback (MM_ROUTE_D=2).
+
+    Every model holds a copy on EVERY peer; the stub peer transport
+    models queueing (service time grows with the peer's concurrent
+    in-flight) and returns the mm-load feedback the d-choices pick
+    consumes, exactly like the wire trailer. The instance records are
+    static for the whole run — deliberately: instance rpm republishes on
+    an 8 s cadence while queues build in milliseconds, so the
+    single-winner cache CANNOT react on the timescale that matters and
+    herds every request at one ranked winner. Both modes replay the
+    identical seeded offered load; reported per mode: p50/p99 latency
+    and the per-instance load spread (max/mean of peak concurrent
+    in-flight and of requests served)."""
+    import threading as _threading
+
+    from modelmesh_tpu.serving.route_cache import LoadFeedback
+
+    kv = InMemoryKV(sweep_interval_s=3600.0)
+    peers = [f"p-{k:04d}" for k in range(n_peers)]
+    peer_idx = {p: k for k, p in enumerate(peers)}
+    lock = _threading.Lock()
+    inflight = [0] * n_peers
+    peak = [0] * n_peers
+    served = [0] * n_peers
+
+    def peer_call(endpoint, model_id, method, payload, headers, ctx):
+        k = peer_idx[ctx.dest_instance]
+        with lock:
+            inflight[k] += 1
+            depth = inflight[k]
+            peak[k] = max(peak[k], depth)
+            served[k] += 1
+        try:
+            time.sleep((base_ms + per_inflight_ms * (depth - 1)) / 1000.0)
+        finally:
+            with lock:
+                inflight[k] -= 1
+                remaining = inflight[k]
+        # Feedback mirrors the wire servicer: the responder reports its
+        # load as of RESPONSE time, after releasing this request's slot.
+        return InvokeResult(
+            b"ok", ctx.dest_instance, "LOADED",
+            feedback=LoadFeedback(ctx.dest_instance, remaining, 0),
+        )
+
+    inst = ModelMeshInstance(
+        kv,
+        _BenchLoader(),
+        InstanceConfig(instance_id="i-skew", load_timeout_s=10,
+                       min_churn_age_ms=0),
+        peer_call=peer_call,
+    )
+    try:
+        old = now_ms() - 3_600_000
+        for k, p in enumerate(peers):
+            inst.instances.put(p, InstanceRecord(
+                start_ts=old, lru_ts=old, capacity_units=1 << 20,
+                used_units=1000, endpoint=f"ep-{p}",
+            ))
+        inst.instances_view.wait_for(
+            lambda v: len(v) >= n_peers + 1, timeout=30
+        )
+        models = [f"skew-{i}" for i in range(n_models)]
+        for mid in models:
+            inst.register_model(mid, INFO)
+
+            def place(cur):
+                for p in peers:
+                    cur.promote_loaded(p, old)
+                return cur
+
+            inst.registry.update_or_create(mid, place)
+        inst.registry_view.wait_for(
+            lambda v: all(
+                (mr := v.get(m)) is not None
+                and len(mr.instance_ids) >= n_peers
+                for m in models
+            ),
+            timeout=10,
+        )
+        import random as _random
+
+        weights = [1.0 / (i + 1) ** zipf_s for i in range(n_models)]
+
+        def drive(reps: int, seed_base: int):
+            samples: list[list[float]] = [[] for _ in range(threads)]
+            start = _threading.Barrier(threads + 1)
+
+            def worker(w: int) -> None:
+                # Per-thread seeded draw, identical across modes: both
+                # modes face the SAME offered load.
+                rng = _random.Random(seed_base + w)
+                my = samples[w]
+                start.wait()
+                for _ in range(reps):
+                    mid = rng.choices(models, weights)[0]
+                    t0 = time.perf_counter()
+                    inst.invoke_model(mid, "predict", b"x" * 256, [])
+                    my.append((time.perf_counter() - t0) * 1e3)
+
+            ts = [
+                _threading.Thread(target=worker, args=(w,), daemon=True)
+                for w in range(threads)
+            ]
+            for t in ts:
+                t.start()
+            start.wait()
+            t_wall = time.perf_counter()
+            for t in ts:
+                t.join()
+            return samples, time.perf_counter() - t_wall
+
+        def run_mode(route_d: int) -> tuple[dict, dict]:
+            inst.route_cache.route_d = route_d
+            inst.route_cache.clear()
+            # Warmup pass: primes the memo AND (for d>1) seeds the
+            # LoadView — measuring from an empty view would charge the
+            # d-choices mode a cold-start herd (every pick is the
+            # greedy prior until the first feedback returns) that the
+            # steady state never pays.
+            drive(max(reps_per_thread // 10, 3), 500)
+            for i in range(n_peers):
+                peak[i] = served[i] = 0
+            samples, wall = drive(reps_per_thread, 1000)
+            flat = [s for per in samples for s in per]
+            spread = {
+                "peak_inflight_max": max(peak),
+                "peak_inflight_mean": round(sum(peak) / n_peers, 2),
+                "served_max": max(served),
+                "served_mean": round(sum(served) / n_peers, 2),
+                "peers_used": sum(1 for s in served if s),
+            }
+            return _percentiles(flat, wall), spread
+
+        # Warm both paths once (registry/view settles, memo primed).
+        inst.invoke_model(models[0], "predict", b"x", [])
+        single, single_spread = run_mode(1)
+        dchoices, d_spread = run_mode(2)
+        return {
+            "peers": n_peers,
+            "models": n_models,
+            "threads": threads,
+            "zipf_s": zipf_s,
+            "service_base_ms": base_ms,
+            "service_per_inflight_ms": per_inflight_ms,
+            "single_winner": single,
+            "single_winner_spread": single_spread,
+            "d_choices": dchoices,
+            "d_choices_spread": d_spread,
+            "p99_ratio": (
+                round(single["p99_us"] / dchoices["p99_us"], 2)
+                if dchoices["p99_us"] else None
+            ),
+            "p50_ratio": (
+                round(single["p50_us"] / dchoices["p50_us"], 2)
+                if dchoices["p50_us"] else None
+            ),
+            "route_feedback_notes": inst.route_cache.load_view.notes,
+        }
     finally:
         inst.shutdown()
         kv.close()
@@ -461,15 +643,20 @@ def throughput_per_device(
 
 
 def run(tiers=(1, 100, 1000), reps: int = 2000, select_iters: int = 20_000,
-        throughput_kwargs: dict | None = None) -> dict:
+        throughput_kwargs: dict | None = None,
+        skew_kwargs: dict | None = None) -> dict:
     from modelmesh_tpu.serving.route_cache import RouteCache
 
     probe = RouteCache()
     return {
         "route_cache_enabled": probe.enabled,
         "route_cache_ttl_ms": probe.ttl_ms,
+        "route_d": probe.route_d,
         "payload_bytes": 1024,
         "tiers": [_bench_tier(n, reps, select_iters) for n in tiers],
+        "tail_latency_under_skew": tail_latency_under_skew(
+            **(skew_kwargs or {})
+        ),
         "tracing_overhead": tracing_overhead(
             reps=max(reps // 2, 200), batches=5
         ),
@@ -487,9 +674,15 @@ def main() -> int:
     ap.add_argument("--throughput-only", action="store_true",
                     help="run only the batched-data-plane "
                          "throughput-per-device scenario")
+    ap.add_argument("--skew-only", action="store_true",
+                    help="run only the tail-latency-under-skew routing "
+                         "scenario (single winner vs d-choices)")
     args = ap.parse_args()
     if args.throughput_only:
         print(json.dumps(throughput_per_device()))
+        return 0
+    if args.skew_only:
+        print(json.dumps(tail_latency_under_skew()))
         return 0
     tiers = [int(t) for t in args.tiers.split(",") if t.strip()]
     print(json.dumps(run(tiers, args.reps, args.select_iters)))

@@ -71,6 +71,15 @@ class Metric(enum.Enum):
     BATCH_FLUSH_DRAIN_COUNT = ("mm_batch_flush_drain_count", "counter",
                                "micro-batches flushed by a drain before "
                                "the copy dropped")
+    # Load-aware routing + admission control (serving/route_cache.py,
+    # serving/admission.py)
+    ROUTE_DEMOTE_COUNT = ("mm_route_demote_count", "counter",
+                          "forward failures demoted within a cached "
+                          "candidate set")
+    ADMISSION_SHED_COUNT = ("mm_admission_shed_count", "counter",
+                            "requests shed at the admission edge "
+                            "(per-class token bucket empty past the "
+                            "queue window)")
     # histograms (ms)
     API_REQUEST_TIME = ("mm_api_request_time_ms", "histogram", "request latency")
     # Per-stage latency decomposition: closed tracing spans export here
@@ -125,6 +134,12 @@ class Metric(enum.Enum):
                       "fraction of windowed requests meeting the class SLO")
     SLO_BURN_RATE = ("mm_slo_burn_rate", "gauge",
                      "error-budget burn rate (1 = burning exactly at budget)")
+    # Load-feedback view (serving/route_cache.LoadView): per-peer decayed
+    # load score (labeled instance="...") and worst feedback staleness.
+    ROUTE_LOAD_SCORE = ("mm_route_load_score", "gauge",
+                        "decayed piggybacked load score per peer instance")
+    ROUTE_FEEDBACK_AGE_MS = ("mm_route_feedback_age_ms", "gauge",
+                             "age of the OLDEST live load-feedback slot")
 
     def __init__(self, metric_name: str, kind: str, help_: str):
         self.metric_name = metric_name
@@ -150,6 +165,13 @@ class Metrics:
         """``label`` is an optional pre-formatted extra label pair
         (e.g. 'slo_class="default"') for gauges that carry one series
         per key; empty keeps the classic unlabeled gauge."""
+        pass
+
+    def clear_gauge(self, metric: Metric, label: str = "") -> None:
+        """Drop one (metric, label) gauge series — the retirement hook
+        for per-entity series whose entity is gone (a churned peer's
+        `mm_route_load_score`). No-op for push backends (StatsD): a
+        series that stops being pushed simply ages out server-side."""
         pass
 
     def close(self) -> None:
@@ -251,6 +273,10 @@ class PrometheusMetrics(Metrics):
     def set_gauge(self, metric: Metric, value: float, label: str = "") -> None:
         with self._lock:
             self._gauges[(metric.metric_name, label)] = value
+
+    def clear_gauge(self, metric: Metric, label: str = "") -> None:
+        with self._lock:
+            self._gauges.pop((metric.metric_name, label), None)
 
     # -- exposition ----------------------------------------------------------
 

@@ -27,6 +27,7 @@ from modelmesh_tpu.placement.strategy import (
 )
 from modelmesh_tpu.records import InstanceRecord, ModelRecord
 from modelmesh_tpu.reconfig.rolling import upversion_shortlist
+from modelmesh_tpu.serving.route_cache import ServeCandidate
 
 # Shortlist thresholds (tunable analogs of the reference's proximity rules).
 FREE_SPACE_SHORTLIST_RATIO = 0.75   # candidates with >= 75% of best free
@@ -160,3 +161,49 @@ class GreedyStrategy(PlacementStrategy):
                 if best_load is None or cand > best_load:
                     best_load = cand
         return best_load[1] if best_load is not None else None
+
+    def rank_serve_candidates(
+        self, model: ModelRecord, view: ClusterView, exclude: frozenset[str]
+    ) -> list[ServeCandidate]:
+        """The serve-target ranking as a SET: every eligible ready copy
+        in exactly ``choose_serve_target``'s preference order (draining
+        behind healthy, warming behind settled, then least busy), with a
+        capability weight per candidate — advertised capacity normalized
+        against the set's mean, so mixed hardware generations draw
+        proportional traffic from the d-choices pick. When no ready copy
+        exists, the wait-vs-reroute loading pick (if any) is returned as
+        a single ``loading=True`` candidate: the route cache memoizes it
+        like the old single-winner cache did but never load-balances it.
+        ``rank[0]`` always equals ``choose_serve_target`` on the same
+        inputs (parity-pinned in tests/test_route_cache.py) — the two
+        must not fork."""
+        live = view.live_map
+        now = now_ms()
+        expect = self._expect_ms(model.model_type)
+        ranked: list[tuple[tuple, str, InstanceRecord]] = []
+        for iid, load_ts in model.instance_ids.items():
+            if iid in exclude:
+                continue
+            rec = live.get(iid)
+            if rec is None:
+                continue
+            key = (
+                rec.draining, now - load_ts < expect,
+                rec.req_per_minute, iid,
+            )
+            ranked.append((key, iid, rec))
+        if ranked:
+            ranked.sort(key=lambda t: t[0])
+            caps = [max(rec.capacity_units, 1) for _, _, rec in ranked]
+            mean_cap = sum(caps) / len(caps)
+            return [
+                ServeCandidate(
+                    iid, draining=rec.draining,
+                    weight=max(rec.capacity_units, 1) / mean_cap,
+                )
+                for _, iid, rec in ranked
+            ]
+        loading = self.choose_serve_target(model, view, exclude)
+        if loading is None:
+            return []
+        return [ServeCandidate(loading, loading=True)]

@@ -1735,3 +1735,10 @@ class JaxPlacementStrategy(PlacementStrategy):
         # Serve balancing stays local/greedy: it needs fresh busyness, not a
         # global solve.
         return self.fallback.choose_serve_target(model, view, exclude)
+
+    def rank_serve_candidates(
+        self, model: ModelRecord, view: ClusterView, exclude: frozenset[str]
+    ):
+        # Candidate-set export for the d-choices route cache: same
+        # local/greedy delegation as choose_serve_target.
+        return self.fallback.rank_serve_candidates(model, view, exclude)

@@ -136,6 +136,12 @@ class ShadowStrategy(PlacementStrategy):
         # signal.
         return self.primary.choose_serve_target(model, view, exclude)
 
+    def rank_serve_candidates(
+        self, model: ModelRecord, view: ClusterView, exclude: frozenset[str]
+    ):
+        # Unscored pass-through, same rationale as choose_serve_target.
+        return self.primary.rank_serve_candidates(model, view, exclude)
+
     # -- reporting ----------------------------------------------------------
 
     def shadow_stats(self) -> dict:

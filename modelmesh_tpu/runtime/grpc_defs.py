@@ -145,12 +145,21 @@ class RawFallbackHandler(grpc.GenericRpcHandler):
 
 
 def call_cancellable(callable_, request, timeout=None, metadata=None,
-                     cancel_event=None):
+                     cancel_event=None, with_trailers=False):
     """Invoke a unary-unary multicallable, aborting early when
     ``cancel_event`` fires (client disconnect): the in-flight RPC is
     cancelled so the remote side's context deactivates too, and the local
-    concurrency slot frees immediately instead of riding out the call."""
+    concurrency slot frees immediately instead of riding out the call.
+
+    ``with_trailers=True`` returns ``(response, trailing_metadata)`` so
+    callers can read piggybacked response trailers (the Forward path's
+    mm-load feedback) without a second RPC surface."""
     if cancel_event is None:
+        if with_trailers:
+            resp, call = callable_.with_call(
+                request, timeout=timeout, metadata=metadata
+            )
+            return resp, call.trailing_metadata() or ()
         return callable_(request, timeout=timeout, metadata=metadata)
     import threading
 
@@ -163,7 +172,12 @@ def call_cancellable(callable_, request, timeout=None, metadata=None,
         if cancel_event.is_set():
             fut.cancel()
             raise RequestCancelledError("client disconnected")
-    return fut.result()
+    result = fut.result()
+    if with_trailers:
+        # The rendezvous future is also the Call: trailers are available
+        # once the result is.
+        return result, fut.trailing_metadata() or ()
+    return result
 
 
 def bind_server(server, port: int = 0, bind_host: str = "127.0.0.1",

@@ -48,6 +48,7 @@ from modelmesh_tpu.serving.errors import (
     ModelNotFoundError,
     ModelNotHereError,
     NoCapacityError,
+    OverloadShedError,
     ReadOnlyModeError,
     ServiceUnavailableError,
 )
@@ -56,6 +57,7 @@ from modelmesh_tpu.serving.instance import (
     ModelMeshInstance,
     RoutingContext,
 )
+from modelmesh_tpu.serving.route_cache import LoadFeedback
 
 log = logging.getLogger(__name__)
 
@@ -63,6 +65,15 @@ ERROR_HEADER = "mm-error"
 _ERR_NOT_HERE = "model-not-here"
 _ERR_NO_CAPACITY = "no-capacity"
 _ERR_LOAD_FAILED = "load-failed"
+# Piggybacked load feedback on Forward responses (the responder's
+# in-flight count, batch-queue depth, drain flag — route_cache.
+# LoadFeedback wire form). A trailer, not a message field: zero bytes
+# on requests, and older peers simply don't send it.
+LOAD_HEADER = "mm-load"
+# Typed overload marker on admission sheds, beside RESOURCE_EXHAUSTED:
+# lets clients (and tests) tell a deliberate edge shed from a fleet
+# genuinely out of placement capacity.
+OVERLOAD_HEADER = "mm-overload"
 
 _STATUS_MAP = {
     "NOT_FOUND": apb.NOT_FOUND,
@@ -305,6 +316,16 @@ class MeshInternalServicer:
             context.abort(grpc.StatusCode.UNKNOWN, str(e))
         except RequestCancelledError:
             context.abort(grpc.StatusCode.CANCELLED, "upstream cancelled")
+        # Piggybacked load feedback: OUR current load rides every
+        # successful Forward response as a trailer, feeding the
+        # sender's LoadView (d-choices routing). Best-effort — a
+        # context that can't take trailers must not fail the response.
+        try:
+            context.set_trailing_metadata(
+                ((LOAD_HEADER, self.instance.load_feedback().encode()),)
+            )
+        except Exception:  # noqa: BLE001 — advisory signal only
+            pass
         return ipb.ForwardResponse(
             payload=result.payload,
             served_by=result.served_by,
@@ -514,6 +535,18 @@ class InferenceFallback:
                 req_id, model_id, method, "response", b"", "NOT_FOUND"
             )
             context.abort(grpc.StatusCode.NOT_FOUND, f"model {model_id}")
+        except OverloadShedError as e:
+            # Deliberate edge shed (serving/admission.py): typed via the
+            # mm-overload trailer so clients back off instead of
+            # retrying into the same overload.
+            metrics.inc(MX.API_REQUEST_FAILED, model_id=model_id)
+            try:
+                context.set_trailing_metadata(
+                    ((OVERLOAD_HEADER, e.model_class),)
+                )
+            except Exception:  # noqa: BLE001 — marker only
+                pass
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except NoCapacityError as e:
             metrics.inc(MX.API_REQUEST_FAILED, model_id=model_id)
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
@@ -589,6 +622,9 @@ class InferenceFallback:
                 ModelNotFoundError: (grpc.StatusCode.NOT_FOUND, "NOT_FOUND"),
                 NoCapacityError: (
                     grpc.StatusCode.RESOURCE_EXHAUSTED, "NO_CAPACITY"
+                ),
+                OverloadShedError: (
+                    grpc.StatusCode.RESOURCE_EXHAUSTED, "OVERLOAD"
                 ),
                 ServiceUnavailableError: (
                     grpc.StatusCode.UNAVAILABLE, "UNAVAILABLE"
@@ -789,9 +825,9 @@ def make_grpc_peer_call(channels: Optional[PeerChannels] = None,
             ctx=_ctx_to_proto(ctx),
         )
         try:
-            resp = grpc_defs.call_cancellable(
+            resp, trailers = grpc_defs.call_cancellable(
                 stub.Forward, req, timeout=timeout_s,
-                cancel_event=ctx.cancel_event,
+                cancel_event=ctx.cancel_event, with_trailers=True,
             )
         except grpc.RpcError as e:
             detail = ""
@@ -814,7 +850,17 @@ def make_grpc_peer_call(channels: Optional[PeerChannels] = None,
         status_name = {v: k for k, v in _STATUS_MAP.items()}.get(
             resp.model_status, "UNKNOWN"
         )
-        return InvokeResult(resp.payload, resp.served_by, status_name)
+        # The mm-load trailer is the IMMEDIATE peer's report, so it is
+        # attributed to the dialed instance (served_by may be a further
+        # hop — not who our next pick would queue behind).
+        feedback = None
+        for k, v in trailers:
+            if k == LOAD_HEADER:
+                feedback = LoadFeedback.decode(ctx.dest_instance, v)
+                break
+        return InvokeResult(
+            resp.payload, resp.served_by, status_name, feedback=feedback
+        )
 
     peer_call.channels = channels  # for cleanup
     return peer_call

@@ -196,6 +196,15 @@ class RequestBatcher:
         self.solo_count = 0
         self.batch_count = 0
         self.batched_requests = 0
+        # Requests currently parked behind an in-flight dispatch across
+        # ALL groups — the queue-depth surface the load-feedback trailer
+        # (serving/route_cache.LoadFeedback) reports to routing peers.
+        # Parks/claims/withdrawals run under different GROUP locks, so a
+        # plain += would drift permanently; the dedicated lock costs one
+        # acquire per parked (already-contended-path) request. Read
+        # lock-free — it is a point-in-time load signal, not accounting.
+        self._depth_lock = mm_lock("RequestBatcher._depth_lock")
+        self.parked_total = 0  #: guarded-by: _depth_lock
 
     # ------------------------------------------------------------------ #
     # submission                                                         #
@@ -231,6 +240,11 @@ class RequestBatcher:
                 else:
                     q.pending.append(req)
                     passthrough = False
+                    # Nested inside q.lock by convention (every
+                    # parked_total adjustment is) so the acquisition
+                    # order can never invert.
+                    with self._depth_lock:
+                        self.parked_total += 1
             break
         if passthrough:
             self.solo_count += 1
@@ -315,6 +329,8 @@ class RequestBatcher:
                     # timeout.
                     q.pending.remove(req)
                     q.idle_cv.notify_all()
+                    with self._depth_lock:
+                        self.parked_total -= 1
                     raise BatchCancelled(req.model_id)
             if req.done:
                 return self._finish(req)
@@ -345,6 +361,8 @@ class RequestBatcher:
                     batch.append(q.pending.pop(0))
             q.in_flight = True
             q.in_flight_ids = [r.model_id for r in batch]
+            with self._depth_lock:
+                self.parked_total -= len(batch)
             if len(batch) >= self.batch_max:
                 reason = "full"
             elif q.drain_flush:
@@ -444,6 +462,13 @@ class RequestBatcher:
         if q is None:
             return True
         return q.await_drained(model_id, timeout_s)
+
+    def queue_depth(self) -> int:
+        """Parked requests across ALL groups right now — the batch-queue
+        component of the piggybacked load feedback. Lock-free read of a
+        lock-maintained counter: a point-in-time signal for routing
+        peers, momentarily stale by design."""
+        return self.parked_total
 
     def depth(self, model_id: str) -> int:
         """Parked requests for the model's group (tests/diagnostics)."""

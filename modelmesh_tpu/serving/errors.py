@@ -15,6 +15,7 @@ __all__ = [
     "ModelNotHereError",
     "NoCapacityError",
     "ApplierError",
+    "OverloadShedError",
     "RequestCancelledError",
     "ServiceUnavailableError",
 ]
@@ -59,3 +60,21 @@ class ServiceUnavailableError(Exception):
 class RequestCancelledError(Exception):
     """Client cancelled the request; abort in-flight work and free slots
     (reference cancellation propagation, ModelMeshApi.java:709-729)."""
+
+
+class OverloadShedError(Exception):
+    """Request deliberately shed by the admission controller
+    (serving/admission.py): the class's token bucket was empty and the
+    bounded queue window expired while higher-priority classes burn SLO
+    budget. Typed so clients can distinguish 'the fleet chose not to
+    serve you right now' (back off / retry elsewhere) from a failure —
+    mapped to RESOURCE_EXHAUSTED with an mm-overload trailer at the API
+    edge ("Load Balanced Demand Distribution under Overload Penalties",
+    PAPERS.md: explicit shed penalties at the edge beat queue collapse
+    fleet-wide)."""
+
+    def __init__(self, model_class: str, message: str = ""):
+        super().__init__(
+            message or f"overload: class {model_class!r} shed at admission"
+        )
+        self.model_class = model_class

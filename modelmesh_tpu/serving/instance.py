@@ -72,7 +72,7 @@ from modelmesh_tpu.serving.errors import (
 from modelmesh_tpu.observability.metrics import Metric as MX
 from modelmesh_tpu.observability.tracing import Tracer, outgoing_headers
 from modelmesh_tpu.serving.rate import RateTracker
-from modelmesh_tpu.serving.route_cache import RouteCache
+from modelmesh_tpu.serving.route_cache import LoadFeedback, RouteCache
 from modelmesh_tpu.utils.clock import get_clock
 from modelmesh_tpu.utils.lockdebug import mm_lock
 from modelmesh_tpu.utils.pool import BoundedDaemonPool
@@ -139,12 +139,18 @@ class RoutingContext:
 
 
 class InvokeResult:
-    __slots__ = ("payload", "served_by", "status")
+    __slots__ = ("payload", "served_by", "status", "feedback")
 
-    def __init__(self, payload: bytes, served_by: str, status: str):
+    def __init__(self, payload: bytes, served_by: str, status: str,
+                 feedback=None):
         self.payload = payload
         self.served_by = served_by
         self.status = status
+        # Piggybacked load feedback (route_cache.LoadFeedback) from the
+        # IMMEDIATE peer a Forward was sent to — the mm-load response
+        # trailer on the wire, attached directly by the sim/bench
+        # transports. None on local results and feedback-less peers.
+        self.feedback = feedback
 
 
 # peer_call(instance_record.endpoint, model_id, method, payload, headers, ctx)
@@ -176,6 +182,10 @@ class InstanceConfig:
         slo_spec: Optional[str] = None,
         batch_max: Optional[int] = None,
         batch_window_us: Optional[int] = None,
+        route_d: Optional[int] = None,
+        feedback_decay_ms: Optional[int] = None,
+        admission: Optional[bool] = None,
+        admission_queue_ms: Optional[int] = None,
     ):
         self.instance_id = instance_id or f"i-{uuid.uuid4().hex[:8]}"  # analysis-ok: det-entropy — deliberately unique process identity; every replay-bearing path (sim, scenarios) passes an explicit instance_id
         self.kv_prefix = kv_prefix.rstrip("/")
@@ -266,6 +276,25 @@ class InstanceConfig:
         if batch_window_us is None:
             batch_window_us = _envs.get_int("MM_BATCH_WINDOW_US")
         self.batch_window_us = batch_window_us
+        # Load-aware fused routing (serving/route_cache.py): candidate
+        # sampled per pick (MM_ROUTE_D; 1 = the old single-winner cache,
+        # regression-pinned) and the staleness horizon for piggybacked
+        # load feedback (MM_FEEDBACK_DECAY_MS). Admission control
+        # (serving/admission.py): SLO-burn-modulated per-class shedding
+        # at the external edge (MM_ADMISSION, default off) with a
+        # bounded pre-shed queue window (MM_ADMISSION_QUEUE_MS).
+        if route_d is None:
+            route_d = _envs.get_int("MM_ROUTE_D")
+        self.route_d = route_d
+        if feedback_decay_ms is None:
+            feedback_decay_ms = _envs.get_int("MM_FEEDBACK_DECAY_MS")
+        self.feedback_decay_ms = feedback_decay_ms
+        if admission is None:
+            admission = _envs.get_bool("MM_ADMISSION")
+        self.admission = admission
+        if admission_queue_ms is None:
+            admission_queue_ms = _envs.get_int("MM_ADMISSION_QUEUE_MS")
+        self.admission_queue_ms = admission_queue_ms
 
 
 class ModelMeshInstance:
@@ -411,11 +440,39 @@ class ModelMeshInstance:
         self._kv_failfast: dict[str, int] = {}
         # Request-path fast path: the epoch-keyed ClusterView snapshot
         # (rebuilt only when the instances view moves) and the per-model
-        # serve-route memo (serving/route_cache.py). Created before the
-        # registry listener below is registered — it invalidates through
-        # this cache.
-        self.route_cache = RouteCache()
+        # candidate-set route memo (serving/route_cache.py) with its
+        # load-feedback view. Created before the registry listener below
+        # is registered — it invalidates through this cache. The
+        # d-choices sampler seed derives from the instance id:
+        # deterministic per pod (sim replay) but spread across a fleet.
+        import zlib as _zlib
+
+        self.route_cache = RouteCache(
+            route_d=self.config.route_d,
+            feedback_decay_ms=self.config.feedback_decay_ms,
+            seed=_zlib.crc32(self.instance_id.encode()),
+        )
         self._cluster_view_cache: Optional[ClusterView] = None
+        # Local in-flight gauge for the piggybacked feedback trailer:
+        # requests currently executing against THIS runtime (between the
+        # concurrency-gate acquire and release in _invoke_local). A
+        # dedicated lock, not a racy int — feedback drift would
+        # permanently skew peers' view of us.
+        self._inflight = 0  #: guarded-by: _inflight_lock
+        self._inflight_lock = mm_lock("ModelMeshInstance._inflight_lock")
+        # Admission controller at the external edge (serving/
+        # admission.py): priorities and burn rates come from THIS
+        # instance's SLO tracker; sheds are typed, counted, and flight-
+        # recorded. Off (the default) it is a single attribute check.
+        from modelmesh_tpu.serving.admission import AdmissionController
+
+        self.admission_controller = AdmissionController(
+            self.slo,
+            enabled=self.config.admission,
+            queue_ms=self.config.admission_queue_ms,
+            metrics=sink,
+            flightrec=self.flightrec,
+        )
 
         # Weight-transfer subsystem (transfer/): host-RAM staging tier +
         # peer-to-peer streaming manager. The host-tier eviction listener
@@ -784,6 +841,27 @@ class ModelMeshInstance:
         self.metrics.set_gauge(
             MX.LRU_AGE_SECONDS, (now_ms() - oldest) / 1000.0 if oldest else 0
         )
+        # Load-feedback view (route_cache.LoadView): per-peer decayed
+        # scores + worst staleness, exported on the publisher cadence —
+        # never from the request path. Prune fully-decayed slots first
+        # AND retire their gauge series: rolling restarts mint fresh
+        # instance ids, and either the map or the exported series would
+        # otherwise grow without bound.
+        lv = self.route_cache.load_view
+        now = now_ms()
+        for iid in lv.prune(now):
+            self.metrics.clear_gauge(
+                MX.ROUTE_LOAD_SCORE, label=f'instance="{iid}"'
+            )
+        for iid in list(lv._slots):
+            self.metrics.set_gauge(
+                MX.ROUTE_LOAD_SCORE, round(lv.score(iid, now), 3),
+                label=f'instance="{iid}"',
+            )
+        stale = lv.staleness_ms(now)
+        self.metrics.set_gauge(
+            MX.ROUTE_FEEDBACK_AGE_MS, stale if stale is not None else 0
+        )
 
     # ------------------------------------------------------------------ #
     # management API                                                     #
@@ -925,6 +1003,14 @@ class ModelMeshInstance:
             # External completion feeds the SLO attainment window (one
             # sample per request, never per hop). Latency through the
             # injectable clock so the sim's windows carry virtual time.
+            # The admission gate runs BEFORE the window opens and a shed
+            # never records into it: the controller's burn signal must
+            # judge the health of SERVED traffic — counting its own
+            # sheds as breach would latch the throttle on forever.
+            cls = self._model_class(model_id)
+            self.admission_controller.admit(
+                cls, cancel_event=ctx.cancel_event
+            )
             clock = get_clock()
             t0 = clock.monotonic()
             ok = False
@@ -935,10 +1021,7 @@ class ModelMeshInstance:
                 ok = True
                 return result
             finally:
-                self.slo.record(
-                    self._model_class(model_id),
-                    (clock.monotonic() - t0) * 1e3, ok,
-                )
+                self.slo.record(cls, (clock.monotonic() - t0) * 1e3, ok)
         finally:
             _thread.name = _prev_name
 
@@ -1047,17 +1130,21 @@ class ModelMeshInstance:
                         hop=RoutingContext.INTERNAL,
                     )
                 except (ModelNotHereError, ServiceUnavailableError) as e:
-                    # The memoized route just failed in practice — drop it
-                    # so concurrent/subsequent requests re-decide instead
-                    # of replaying the failure until a version/epoch bump.
-                    self.route_cache.invalidate(model_id)
+                    # The routed candidate just failed in practice —
+                    # demote it WITHIN the cached set (d>1: survivors
+                    # keep their ranking, so the thundering retry
+                    # spreads over them instead of re-herding at one
+                    # recomputed winner; d=1 keeps the old invalidate)
+                    # and stamp the decaying LoadView penalty so every
+                    # model's picks avoid the instance while fresh.
+                    self._demote_route(model_id, target, type(e).__name__)
                     ctx.exclude_serve.add(target)
                     last_exc = e
                     continue
                 except ModelLoadException as e:
                     # Serve target was a LOADING copy whose load failed (or
                     # timed out) — exclude it on both axes and re-route.
-                    self.route_cache.invalidate(model_id)
+                    self._demote_route(model_id, target, "ModelLoadException")
                     ctx.exclude_serve.add(target)
                     ctx.exclude_load.add(target)
                     last_exc = e
@@ -1134,39 +1221,53 @@ class ModelMeshInstance:
             f"{model_id}: routing iterations exhausted"
         )
 
+    def _demote_route(self, model_id: str, target: str, err: str) -> None:
+        """Failed-forward demotion bookkeeping (ONE funnel for both
+        except branches above: cache demotion + metric + flightrec)."""
+        self.route_cache.demote(model_id, target)
+        self.metrics.inc(MX.ROUTE_DEMOTE_COUNT, model_id=model_id)
+        self.flightrec.record(
+            "route-demote", model=model_id, target=target, err=err,
+        )
+
     def _choose_serve_target(
         self, model_id: str, mr: ModelRecord, ctx: RoutingContext
     ) -> Optional[str]:
-        """Serve-target selection with the per-model route memo.
+        """Serve-target selection: candidate-set memo + d-choices pick.
 
         The memo is consulted only when the request carries no serve
         exclusions — the forward-failure retry loop must always re-decide
-        (and it also invalidates, see the except branches above). A hit is
+        (and it also demotes, see the except branches above). A hit is
         valid only while the registry record version, the instances-view
-        epoch, and the warming-clock bucket all match what the decision
+        epoch, and the warming-clock bucket all match what the ranking
         was derived from; the exclusion signature is the cache key, so a
-        hit can never return an excluded instance.
+        hit can never return an excluded instance. The pick samples
+        MM_ROUTE_D candidates against the piggybacked LoadView scores
+        (route_cache.pick); strategies without a candidate-set export
+        keep the old single-winner flow.
         """
         exclude = ctx.exclude_serve | ctx.visited | {self.instance_id}
         cache = self.route_cache
-        if not cache.enabled or ctx.exclude_serve:
+        rank = getattr(self.strategy, "rank_serve_candidates", None)
+        if not cache.enabled or ctx.exclude_serve or rank is None:
             return self.strategy.choose_serve_target(
                 mr, self.cluster_view(), frozenset(exclude)
             )
         sig = frozenset(exclude)
-        target = cache.lookup(
+        cands = cache.lookup(
             model_id, sig, mr.version, self.instances_view.epoch
         )
-        if target is not None:
-            return target
+        if cands is not None:
+            return cache.pick(cands)
         view = self.cluster_view()
-        target = self.strategy.choose_serve_target(mr, view, sig)
-        if target is not None:
-            # Keyed on the snapshot actually used (view.epoch), not the
-            # live epoch — if the view moved mid-decision the entry is
-            # already stale and the next lookup recomputes.
-            cache.store(model_id, sig, mr.version, view.epoch, target)
-        return target
+        cands = rank(mr, view, sig)
+        if not cands:
+            return None
+        # Keyed on the snapshot actually used (view.epoch), not the
+        # live epoch — if the view moved mid-decision the entry is
+        # already stale and the next lookup recomputes.
+        cache.store(model_id, sig, mr.version, view.epoch, cands)
+        return cache.pick(cands)
 
     # ------------------------------------------------------------------ #
     # local invocation                                                   #
@@ -1226,6 +1327,8 @@ class ModelMeshInstance:
             if cancel_event is not None and cancel_event.is_set():
                 raise RequestCancelledError(ce.model_id)
             raise ModelLoadException(f"{ce.model_id}: concurrency gate timeout")
+        with self._inflight_lock:
+            self._inflight += 1
         try:
             t0 = _time.perf_counter()  #: wall-clock: perf_counter latency metric (runtime invoke)
             with self.tracer.span("runtime-call", model=ce.model_id):
@@ -1264,7 +1367,23 @@ class ModelMeshInstance:
             self._remove_local(ce.model_id)
             raise
         finally:
+            with self._inflight_lock:
+                self._inflight -= 1
             ce.after_invoke()
+
+    def load_feedback(self) -> "LoadFeedback":
+        """This instance's current load, in the shape peers piggyback on
+        Forward responses (route_cache.LoadFeedback): locally-executing
+        request count, batch-queue depth (PR-13's RequestBatcher), and
+        the drain flag. Cheap enough for every response — two attribute
+        reads and a lock-free counter."""
+        with self._inflight_lock:
+            inflight = self._inflight
+        depth = self.batcher.queue_depth() if self.batcher is not None else 0
+        return LoadFeedback(
+            self.instance_id, inflight, depth,
+            draining=self.draining or self.shutting_down,
+        )
 
     def _map_runtime_error(self, exc: Exception, model_id: str):
         """THE runtime-error-to-serving-exception mapping, shared by the
@@ -2307,11 +2426,29 @@ class ModelMeshInstance:
             cancel_event=ctx.cancel_event,
         )
         self.metrics.inc(MX.INVOKE_FORWARD_COUNT, model_id=model_id)
-        with self.tracer.span("forward", target=target, hop=hop):
-            return self._peer_call(
-                rec.endpoint or target, model_id, method, payload,
-                outgoing_headers(headers), fwd_ctx,
-            )
+        # Own-outstanding accounting brackets the dispatch: the sender's
+        # zero-staleness half of the load score (concurrent picks from
+        # THIS instance spread immediately instead of herding on the
+        # last piggybacked report).
+        lv = self.route_cache.load_view
+        lv.begin(target)
+        try:
+            with self.tracer.span("forward", target=target, hop=hop):
+                result = self._peer_call(
+                    rec.endpoint or target, model_id, method, payload,
+                    outgoing_headers(headers), fwd_ctx,
+                )
+        finally:
+            lv.end(target)
+        # Piggybacked load feedback from the IMMEDIATE peer (the one we
+        # route to — served_by may be a further hop, but the queue we
+        # would join is the peer's): decays into the LoadView driving
+        # every subsequent d-choices pick. getattr: stub transports in
+        # older tests return bare InvokeResult-shaped objects.
+        fb = getattr(result, "feedback", None)
+        if fb is not None:
+            lv.note(fb)
+        return result
 
     # ------------------------------------------------------------------ #
     # shutdown                                                           #

@@ -237,8 +237,21 @@ class SimCluster:
         start_tasks: bool = True,
         load_delay_ms: float = 50.0,
         instance_kwargs: Optional[dict] = None,
+        service_base_ms: float = 0.0,
+        service_congestion_ms: float = 0.0,
     ):
         self.seed = seed
+        # Virtual-time service-cost model for runtime calls: each
+        # dispatch costs base + congestion * (concurrent dispatches - 1)
+        # virtual ms, with the concurrency counted FLEET-GLOBAL (one
+        # shared accelerator domain — overload scenarios test admission
+        # control, not placement spread). Zero (default) keeps the
+        # historical instantaneous runtime; without a congestion term
+        # there is no tail for admission control to protect.
+        self.service_base_ms = service_base_ms
+        self.service_congestion_ms = service_congestion_ms
+        self._service_inflight = 0  #: guarded-by: _service_lock
+        self._service_lock = threading.Lock()
         self.kv = SimKV(seed=seed, config=kv_config)
         self.task_config = task_config or TaskConfig()
         self.pods: list[SimPod] = []
@@ -378,9 +391,15 @@ class SimCluster:
         with pod.instance.tracer.trace(
             tid, model_id, method or "", parent_span=parent,
         ) if tid else contextlib.nullcontext():
-            return pod.instance.invoke_model(
+            result = pod.instance.invoke_model(
                 model_id, method, payload, headers, ctx, sync=True
             )
+        # The wire piggybacks the responder's load on every Forward
+        # response (mm-load trailer); the direct-call transport carries
+        # the SAME feedback so scenarios exercise the real LoadView
+        # decay/staleness machinery under virtual time.
+        result.feedback = pod.instance.load_feedback()
+        return result
 
     def _peer_fetch(self, endpoint: str, model_id: str, chunk_index: int,
                     fingerprint: str):
@@ -447,6 +466,24 @@ class SimCluster:
 
         self.add_transfer_hook(hook)
 
+    def _service_delay(self, iid: str) -> None:
+        """Charge one runtime dispatch its virtual service cost under
+        the congestion model (no-op when unconfigured)."""
+        if not self.service_base_ms and not self.service_congestion_ms:
+            return
+        with self._service_lock:
+            self._service_inflight += 1
+            inflight = self._service_inflight
+        try:
+            delay_ms = self.service_base_ms + self.service_congestion_ms * (
+                inflight - 1
+            )
+            if delay_ms > 0:
+                _clock.sleep(delay_ms / 1000.0)
+        finally:
+            with self._service_lock:
+                self._service_inflight -= 1
+
     def _runtime_call(
         self, ce, method, payload: bytes, headers, cancel_event=None
     ) -> bytes:
@@ -459,6 +496,7 @@ class SimCluster:
             if pod.alive and pod.instance.cache.get_quietly(mid) is ce:
                 if not pod.loader.is_loaded(mid):
                     raise ModelNotHereError(pod.iid, mid)
+                self._service_delay(pod.iid)
                 return f"{mid}:sim".encode()
         raise ModelNotHereError("?", mid)
 
@@ -478,6 +516,11 @@ class SimCluster:
             now_ms(), iid, len(items),
             len({item.model_id for item in items}),
         ))
+        # One batched dispatch = one service charge (that is the point
+        # of batching); congestion still scales with concurrent
+        # dispatches — fleet-global, like every service charge (see the
+        # constructor comment).
+        self._service_delay(iid)
         out: list = []
         for item in items:
             mid = item.model_id

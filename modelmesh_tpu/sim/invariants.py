@@ -248,28 +248,41 @@ def draining_deregistered(cluster: "SimCluster") -> list[str]:
     return out
 
 
-def slo_attained(spec: str, window_ms: int = 10_000, min_requests: int = 1):
+def slo_attained(spec: str, window_ms: int = 10_000, min_requests: int = 1,
+                 model_filter=None, slo_class: str = ""):
     """Machine-checked SLO attainment over the scenario's observed probe
     traffic (``SimCluster.request_log``: virtual ts, model, ok, error,
     virtual latency). The run's virtual timeline is cut into
     ``window_ms`` checkpoints; every checkpoint with at least
     ``min_requests`` completions must meet the spec's objectives
-    (observability/slo.py grammar — the 'default' class judges all sim
-    traffic). Returns the standard checker shape: one violation string
-    per failing checkpoint; a run with NO evaluated checkpoint fails as
-    vacuous."""
+    (observability/slo.py grammar). Returns the standard checker shape:
+    one violation string per failing checkpoint; a run with NO evaluated
+    checkpoint fails as vacuous.
+
+    ``model_filter`` restricts the judged traffic (e.g. one model-class
+    prefix — how the overload scenario asserts per-class divergence);
+    ``slo_class`` names both the spec clause to judge by and the class
+    tag in violation strings (default: the spec's 'default' clause
+    judging everything the filter admits)."""
     from modelmesh_tpu.observability.slo import (
         _percentile,
         parse_slo_spec,
     )
 
     objectives = parse_slo_spec(spec)
-    obj = objectives.get("default") or next(iter(objectives.values()))
+    obj = (
+        objectives.get(slo_class)
+        or objectives.get("default")
+        or next(iter(objectives.values()))
+    )
+    tag = f"[{slo_class}] " if slo_class else ""
 
     def check(cluster: "SimCluster") -> list[str]:
         log_ = list(cluster.request_log)
+        if model_filter is not None:
+            log_ = [row for row in log_ if model_filter(row[1])]
         if not log_:
-            return ["no probe requests observed (vacuous SLO run)"]
+            return [f"{tag}no probe requests observed (vacuous SLO run)"]
         out: list[str] = []
         base = min(t for t, *_ in log_)
         windows: dict[int, list[tuple[float, bool]]] = {}
@@ -283,7 +296,7 @@ def slo_attained(spec: str, window_ms: int = 10_000, min_requests: int = 1):
             if len(samples) < min_requests:
                 continue
             evaluated += 1
-            at = f"checkpoint @{base + idx * window_ms}ms"
+            at = f"{tag}checkpoint @{base + idx * window_ms}ms"
             lat = sorted(v for v, _ in samples)
             n = len(samples)
             avail = sum(1 for _, ok in samples if ok) / n
@@ -306,7 +319,7 @@ def slo_attained(spec: str, window_ms: int = 10_000, min_requests: int = 1):
                 )
         if not evaluated:
             out.append(
-                f"no checkpoint reached {min_requests} requests "
+                f"{tag}no checkpoint reached {min_requests} requests "
                 "(vacuous SLO run)"
             )
         return out

@@ -50,7 +50,10 @@ Event kinds:
                           turns on dual-read before its workload starts
   migrate_live            run the fenced live registry migration
                           (kv/migrate.py) against the serving cluster
-  register/ensure/invoke/unregister <model>   workload
+  register <model> [type] register a model (type = model_type = SLO
+                          class, default "sim" — admission scenarios
+                          register typed classes)
+  ensure/unregister <model>   workload
 """
 
 from __future__ import annotations
@@ -111,6 +114,11 @@ class Scenario:
     # Override the runner's virtual step for timing-sensitive scenarios
     # (observed timestamps quantize onto the step grid).
     step_ms: Optional[int] = None
+    # Virtual-time runtime service-cost model (SimCluster): per dispatch,
+    # base + congestion * (concurrent dispatches - 1) ms. Overload
+    # scenarios need a congestion term or latency never degrades.
+    service_base_ms: float = 0.0
+    service_congestion_ms: float = 0.0
     # Quiesce hygiene: release hold gates, drain pending async
     # deregisters/unloads, and run one inline janitor cycle before the
     # invariant read (the registry_cache_convergence flake fix). Off
@@ -255,7 +263,10 @@ class ScenarioRunner:
                 (),
             )
         elif kind == "register":
-            target, targs = cluster.register, (args[0],)
+            # Optional second arg: the model_type ("register m hi") —
+            # model_type is the SLO class, so admission scenarios need
+            # typed registrations.
+            target, targs = cluster.register, tuple(args[:2])
         elif kind == "unregister":
             target, targs = cluster.unregister, (args[0],)
         elif kind == "ensure":
@@ -299,6 +310,8 @@ class ScenarioRunner:
                     task_config=sc.task_config,
                     load_delay_ms=sc.load_delay_ms,
                     instance_kwargs=sc.instance_kwargs,
+                    service_base_ms=sc.service_base_ms,
+                    service_congestion_ms=sc.service_congestion_ms,
                 )
                 if sc.kv_config is not None:
                     cluster.kv.config = sc.kv_config

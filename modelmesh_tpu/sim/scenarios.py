@@ -47,6 +47,13 @@ Catalog (race -> origin):
   quiesce's async-drain + inline janitor cycle must repair the record
   before invariants read (fails with quiesce_async reverted, see
   tests/test_sim_scenarios.py meta-test).
+- overload_shed_protects_slo — the admission-control tentpole proof:
+  a lo-class flood under a virtual-time congestion service model
+  overloads the fleet; with MM_ADMISSION on, sim-0's burn-rate-driven
+  controller floor-throttles the lo class (typed OverloadShed failures
+  in the request log — non-vacuity checked) and the judged hi-class
+  probes hold p99<1200ms at every 10 s checkpoint; the admission-off
+  variant breaches (meta-test, non-vacuity both ways).
 - slo_under_flash_crowd — the observability tentpole proof: seeded Zipf
   probes (entered via rotating pods, forcing forward hops) with a
   flash-crowd overlay on a slow-loading cold model, judged by the
@@ -871,6 +878,133 @@ def slo_under_flash_crowd(p99_ms: float = 8_000.0) -> Scenario:
     )
 
 
+# ------------------------------------------------------------------ #
+# 13. overload: burn-rate admission shedding protects the top class    #
+# ------------------------------------------------------------------ #
+
+# The pods' SLO spec IS the admission priority order: 'hi' (first
+# clause) is never shed; 'lo'-typed traffic resolves to 'default' and
+# gets throttled when 'hi' burns budget. Bounds live on the runner's
+# 500 ms step grid (a virtual sleep completes at the next advance, so
+# every observed latency is a step multiple): 1200 ms admits up to two
+# quantized steps (a judged hi probe overlapping a couple of floored lo
+# dispatches) and rejects three or more — which under the flood's
+# compounding backlog is where every unthrottled request lands.
+_OVERLOAD_SPEC = "hi:p99<1200ms;default:p99<30000ms"
+_LO_MODELS = [f"lo-{i}" for i in range(4)]
+# Judged vs warmup hi traffic: admission control REACTS to breach, so
+# the detection ramp (hi-warm breaching while the burn signal
+# accumulates) is driven by a sibling model of the same class and only
+# the post-ramp hi-0 probes are judged — the property under test is
+# "the protected class HOLDS once the controller engages", not "no
+# breach ever" (no reactive controller can promise that).
+_HI_JUDGED = "hi-0"
+_HI_WARM = "hi-warm"
+
+
+def _check_hi_never_failed(cluster: SimCluster):
+    """The protected class is never shed and never fails — its priority
+    index is 0, which the admission controller exempts by construction."""
+    bad = [
+        f"@{t}ms {mid}: {err}"
+        for t, mid, ok, err, _lat in cluster.request_log
+        if not ok and mid in (_HI_JUDGED, _HI_WARM)
+    ]
+    if bad:
+        return [f"hi-class failures: {'; '.join(bad[:5])}"]
+    return []
+
+
+def _check_sheds_fired(cluster: SimCluster):
+    """Non-vacuity (admission ON): the overload really tripped the
+    controller — some lo-class probes were shed with the typed error."""
+    sheds = [
+        1 for _t, mid, ok, err, _lat in cluster.request_log
+        if not ok and mid.startswith("lo-") and "OverloadShed" in err
+    ]
+    if not sheds:
+        return [
+            "no lo-class request was shed — admission never engaged "
+            "(vacuous overload run)"
+        ]
+    return []
+
+
+def overload_shed_protects_slo(admission: bool = True) -> Scenario:
+    """Deliberate overload under a virtual-time congestion service
+    model (each runtime dispatch costs 5 + 300*(concurrent-1) ms —
+    deliberately fleet-global: the scenario tests admission, not
+    placement). A sustained lo-class flood drives latency far past the
+    hi class's p99<1200ms objective; with MM_ADMISSION on, sim-0's
+    controller reads the hi burn rate, floor-throttles the default
+    class, and the judged hi probes HOLD their SLO at every 10 s
+    checkpoint; with it off the same traffic breaches (the meta-test in
+    tests/test_sim_scenarios.py proves non-vacuity both ways, and the
+    passing variant is replay-pinned bit-for-bit)."""
+    from modelmesh_tpu.sim import invariants
+
+    events = [
+        Event(0, "register", (_HI_JUDGED, "hi")),
+        Event(0, "register", (_HI_WARM, "hi")),
+    ]
+    events += [Event(0, "register", (mid, "lo")) for mid in _LO_MODELS]
+    events += [
+        Event(400 + 150 * i, "ensure", (mid,))
+        for i, mid in enumerate([_HI_JUDGED, _HI_WARM] + _LO_MODELS)
+    ]
+    # The flood: lo-class probes arriving ~5 per runner step; with each
+    # dispatch costing 300*(concurrent-1) ms and requests spanning
+    # steps, the unthrottled backlog compounds into multi-second
+    # latencies — genuine overload, not a fixed delay.
+    events += [
+        Event(t, "invoke", (_LO_MODELS[(t // 80) % len(_LO_MODELS)],))
+        for t in range(4_000, 54_000, 80)
+    ]
+    # Burn-detection ramp: hi-warm probes breach while the window
+    # accumulates evidence (unjudged).
+    events += [
+        Event(t, "invoke", (_HI_WARM,)) for t in range(4_000, 20_000, 300)
+    ]
+    # Judged hi probes: by 20 s the controller (refresh cadence 250 ms,
+    # MIN_BURN_SAMPLES reached within seconds of the ramp) has floored
+    # the lo class — these must meet p99<1200ms at every checkpoint.
+    events += [
+        Event(t, "invoke", (_HI_JUDGED,))
+        for t in range(20_000, 54_000, 1_000)
+    ]
+    checks = {
+        "hi_slo_attained": invariants.slo_attained(
+            _OVERLOAD_SPEC, window_ms=10_000, min_requests=3,
+            model_filter=lambda m: m == _HI_JUDGED, slo_class="hi",
+        ),
+        "hi_never_failed": _check_hi_never_failed,
+    }
+    if admission:
+        checks["sheds_fired"] = _check_sheds_fired
+    return Scenario(
+        name="overload-shed-protects-slo"
+        + ("" if admission else "-admission-off"),
+        seed=113,
+        n_instances=3,
+        horizon_ms=56_000,
+        task_config=_tasks(),
+        step_ms=500,
+        # base > 0 is load-bearing: every dispatch must BLOCK (wake at
+        # the next virtual advance) or workers serialize through a
+        # zero-cost runtime and concurrency — hence congestion — never
+        # accumulates at all.
+        service_base_ms=5.0,
+        service_congestion_ms=300.0,
+        instance_kwargs={
+            "slo_spec": _OVERLOAD_SPEC,
+            "admission": admission,
+            "admission_queue_ms": 20,
+        },
+        events=events,
+        extra_checks=checks,
+    )
+
+
 ALL = (
     fanout_budget_under_first_load_failure,
     promote_publish_suppression,
@@ -884,6 +1018,7 @@ ALL = (
     live_registry_migration_under_load,
     late_eviction_deregister_quiesce,
     slo_under_flash_crowd,
+    overload_shed_protects_slo,
 )
 
 

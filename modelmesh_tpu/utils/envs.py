@@ -163,14 +163,42 @@ REGISTRY: dict[str, EnvVar] = {
                "per-request model-index route), falling back per-model "
                "when shapes diverge", "models/server.py"),
         EnvVar("MM_ROUTE_CACHE", "bool", "1",
-               "memoize the per-model serve-route decision on the request "
-               "hot path (invalidated by registry version, instances-view "
-               "epoch, warming-clock bucket, and forward failures)",
+               "memoize the per-model serve-route candidate set on the "
+               "request hot path (invalidated by registry version, "
+               "instances-view epoch, warming-clock bucket; failed "
+               "candidates demoted in place)",
                "serving/route_cache.py"),
         EnvVar("MM_ROUTE_CACHE_TTL_MS", "int", "1000",
                "route-cache warming-clock bucket width: bounds how long a "
                "time-dependent (warming/ride-the-load) routing decision "
                "can be served from cache", "serving/route_cache.py"),
+        EnvVar("MM_ROUTE_D", "int", "2",
+               "power-of-d-choices width for the serve pick: each request "
+               "samples the greedy winner plus d-1 random cached "
+               "candidates and takes the lowest capability-weighted load "
+               "score (piggybacked feedback). 1 = the old single-winner "
+               "route cache, bit-identical (regression-pinned parity "
+               "mode)", "serving/route_cache.py"),
+        EnvVar("MM_FEEDBACK_DECAY_MS", "int", "5000",
+               "staleness horizon for piggybacked load feedback: a "
+               "peer's reported in-flight/queue-depth score decays "
+               "linearly to zero over this window, so silence degrades "
+               "the pick gracefully toward the greedy prior instead of "
+               "acting on stale load", "serving/route_cache.py"),
+        EnvVar("MM_ADMISSION", "bool", "0",
+               "SLO-burn-rate admission control at the external-API "
+               "edge (serving/admission.py): when a class burns error "
+               "budget at/above 1x, lower-priority classes (MM_SLO_SPEC "
+               "order; the first clause is never shed) are token-bucket "
+               "throttled — briefly queued, then shed with a typed "
+               "overload error (RESOURCE_EXHAUSTED + mm-overload "
+               "trailer). Off (default): zero request-path cost",
+               "serving/admission.py"),
+        EnvVar("MM_ADMISSION_QUEUE_MS", "int", "50",
+               "bounded wait for a token before a throttled request is "
+               "shed: absorbs bursts without letting sustained overload "
+               "build a real queue; 0 sheds immediately",
+               "serving/admission.py"),
         EnvVar("MM_LOCK_DEBUG", "bool", "0",
                "instrumented Lock/Condition wrappers: record per-thread "
                "acquisition stacks and assert lock-acquisition order "

@@ -1,0 +1,138 @@
+"""SLO-burn-rate admission control (serving/admission.py) unit tests.
+
+The end-to-end property (overload sheds the lo class, the hi class's
+SLO holds) lives in the overload_shed_protects_slo sim scenario; these
+pin the controller's mechanics in isolation: spec-order priorities,
+burn-driven throttle/recovery transitions, the bounded queue window,
+typed sheds vs cancellations, and the never-shed top class.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from modelmesh_tpu.observability.slo import SloTracker
+from modelmesh_tpu.serving import admission as adm
+from modelmesh_tpu.serving.admission import AdmissionController
+from modelmesh_tpu.serving.errors import (
+    OverloadShedError,
+    RequestCancelledError,
+)
+from modelmesh_tpu.utils.clock import VirtualClock, installed
+
+SPEC = "hi:p99<100ms;default:p99<1000ms"
+
+
+def _controller(clock, queue_ms=0, spec=SPEC):
+    slo = SloTracker(spec=spec, window_ms=60_000)
+    ctl = AdmissionController(slo, enabled=True, queue_ms=queue_ms)
+    return ctl, slo
+
+
+def _burn(slo, cls, n=20, latency_ms=5_000.0):
+    """Feed enough breaching completions that the class burns >= 1x."""
+    for _ in range(n):
+        slo.record(cls, latency_ms, True)
+
+
+class TestAdmissionController:
+    def test_disabled_is_a_noop(self):
+        slo = SloTracker(spec=SPEC, window_ms=60_000)
+        ctl = AdmissionController(slo, enabled=False, queue_ms=0)
+        for _ in range(100):
+            ctl.admit("default")
+        assert ctl.shed_count == 0 and not ctl.throttled_classes()
+
+    def test_priority_is_spec_order(self):
+        clock = VirtualClock()
+        with installed(clock):
+            ctl, _ = _controller(clock)
+            assert ctl._priority == {"hi": 0, "default": 1}
+
+    def test_hi_burn_throttles_default_but_never_hi(self):
+        clock = VirtualClock()
+        with installed(clock):
+            ctl, slo = _controller(clock)
+            _burn(slo, "hi")
+            clock.advance(adm.BURN_REFRESH_MS + 1)
+            # One refresh cycle: default throttled, hi untouched.
+            ctl.admit("hi")
+            assert ctl.throttled_classes() == ["default"]
+            # hi is NEVER shed, bucket or not.
+            for _ in range(50):
+                ctl.admit("hi")
+            assert ctl.shed_count == 0
+
+    def test_throttled_class_sheds_typed_after_bucket_drains(self):
+        clock = VirtualClock()
+        with installed(clock):
+            ctl, slo = _controller(clock, queue_ms=0)
+            _burn(slo, "hi")
+            clock.advance(adm.BURN_REFRESH_MS + 1)
+            ctl.admit("default")  # triggers the refresh + bucket install
+            sheds = 0
+            for _ in range(20):
+                try:
+                    ctl.admit("default")
+                except OverloadShedError as e:
+                    assert e.model_class == "default"
+                    sheds += 1
+            assert sheds > 0
+            assert ctl.shed_count == sheds
+
+    def test_no_burn_means_no_buckets(self):
+        clock = VirtualClock()
+        with installed(clock):
+            ctl, slo = _controller(clock)
+            for _ in range(10):
+                slo.record("hi", 1.0, True)       # healthy
+                slo.record("default", 1.0, True)
+            clock.advance(adm.BURN_REFRESH_MS + 1)
+            for _ in range(20):
+                ctl.admit("default")
+            assert not ctl.throttled_classes() and ctl.shed_count == 0
+
+    def test_recovery_uncaps_when_pressure_clears(self):
+        clock = VirtualClock()
+        with installed(clock):
+            ctl, slo = _controller(clock)
+            _burn(slo, "hi")
+            clock.advance(adm.BURN_REFRESH_MS + 1)
+            ctl.admit("default")
+            assert ctl.throttled_classes() == ["default"]
+            # The breaching window ages out entirely; calm refreshes
+            # multiply the rate back up until the bucket uncaps.
+            clock.advance(slo.window_ms + 1)
+            for _ in range(60):
+                clock.advance(adm.BURN_REFRESH_MS + 1)
+                try:
+                    ctl.admit("default")
+                except OverloadShedError:
+                    pass
+                if not ctl.throttled_classes():
+                    break
+            assert not ctl.throttled_classes(), "bucket never uncapped"
+
+    def test_queued_cancel_raises_cancelled_not_shed(self):
+        """A client disconnect while queued for a token is a
+        CANCELLATION: no shed accounting, no OverloadShedError (the
+        shed metrics are what operators alert on)."""
+        clock = VirtualClock()
+        with installed(clock):
+            ctl, slo = _controller(clock, queue_ms=10_000)
+            _burn(slo, "hi")
+            clock.advance(adm.BURN_REFRESH_MS + 1)
+            ctl.admit("default")  # installs the bucket
+            # Drain the bucket DIRECTLY (an un-cancelled admit would
+            # queue on virtual time with nobody advancing it).
+            bucket = ctl._buckets["default"]
+            while bucket.try_take(clock.now_ms()):
+                pass
+            cancel = threading.Event()
+            cancel.set()
+            shed0 = ctl.shed_count
+            with pytest.raises(RequestCancelledError):
+                ctl.admit("default", cancel_event=cancel)
+            assert ctl.shed_count == shed0

@@ -34,7 +34,12 @@ from modelmesh_tpu.serving.instance import (
     ModelMeshInstance,
     RoutingContext,
 )
-from modelmesh_tpu.serving.route_cache import RouteCache
+from modelmesh_tpu.serving.route_cache import (
+    LoadFeedback,
+    LoadView,
+    RouteCache,
+    ServeCandidate,
+)
 
 INFO = ModelInfo(model_type="example", model_path="mem://m")
 HOUR = 3_600_000
@@ -265,13 +270,18 @@ class TestClusterViewSnapshot:
         assert dict(view.instances)[inst.instance_id] is inst._self_record
 
 
+def _cands(*iids, **flags):
+    return tuple(ServeCandidate(iid, **flags) for iid in iids)
+
+
 class TestRouteCacheUnit:
     def test_hit_requires_every_validity_input(self):
         rc = RouteCache(enabled=True, ttl_ms=60_000)
         sig = frozenset({"i-self"})
         now = 120_000
-        rc.store("m", sig, 3, 7, "p-1", now=now)
-        assert rc.lookup("m", sig, 3, 7, now=now) == "p-1"
+        entry = _cands("p-1")
+        rc.store("m", sig, 3, 7, entry, now=now)
+        assert rc.lookup("m", sig, 3, 7, now=now) == entry
         assert rc.lookup("m", sig, 4, 7, now=now) is None        # version
         assert rc.lookup("m", sig, 3, 8, now=now) is None        # epoch
         assert rc.lookup("m", frozenset(), 3, 7, now=now) is None  # sig
@@ -280,8 +290,8 @@ class TestRouteCacheUnit:
 
     def test_invalidate_drops_all_signatures(self):
         rc = RouteCache(enabled=True, ttl_ms=60_000)
-        rc.store("m", frozenset({"a"}), 1, 1, "p-1", now=0)
-        rc.store("m", frozenset({"a", "b"}), 1, 1, "p-2", now=0)
+        rc.store("m", frozenset({"a"}), 1, 1, _cands("p-1"), now=0)
+        rc.store("m", frozenset({"a", "b"}), 1, 1, _cands("p-2"), now=0)
         assert len(rc) == 1
         rc.invalidate("m")
         assert rc.lookup("m", frozenset({"a"}), 1, 1, now=0) is None
@@ -290,8 +300,158 @@ class TestRouteCacheUnit:
     def test_size_cap_resets(self):
         rc = RouteCache(enabled=True, ttl_ms=60_000, max_models=4)
         for i in range(10):
-            rc.store(f"m{i}", frozenset(), 1, 1, "p", now=0)
+            rc.store(f"m{i}", frozenset(), 1, 1, _cands("p"), now=0)
         assert len(rc) <= 4
+
+
+class TestDChoicesPick:
+    """The power-of-d pick over a ranked candidate set: greedy prior
+    with no (or decayed) feedback, load-directed deviation with it."""
+
+    def _rc(self, d=2, decay_ms=5_000):
+        return RouteCache(
+            enabled=True, ttl_ms=60_000, route_d=d,
+            feedback_decay_ms=decay_ms, seed=7,
+        )
+
+    def test_no_feedback_is_the_greedy_prior(self):
+        rc = self._rc()
+        cands = _cands("a", "b", "c", "d")
+        assert {rc.pick(cands) for _ in range(50)} == {"a"}
+
+    def test_d1_always_rank0_even_under_load(self):
+        rc = self._rc(d=1)
+        rc.load_view.note(LoadFeedback("a", 50, 50), now=1_000)
+        assert {rc.pick(_cands("a", "b"), now=1_000)} == {"a"}
+
+    def test_skewed_load_spreads_over_siblings(self):
+        """THE tentpole distribution property: with the greedy winner
+        visibly loaded, picks spread over the sampled siblings instead
+        of herding — and every sibling gets traffic (the sample is
+        uniform over the non-anchor ranks)."""
+        rc = self._rc()
+        cands = _cands("a", "b", "c", "d")
+        rc.load_view.note(LoadFeedback("a", 20, 10), now=1_000)
+        picked = [rc.pick(cands, now=1_000) for _ in range(300)]
+        assert "a" not in picked
+        counts = {iid: picked.count(iid) for iid in ("b", "c", "d")}
+        assert all(c > 30 for c in counts.values()), counts
+
+    def test_least_loaded_of_sample_wins(self):
+        rc = self._rc(d=4)  # whole set sampled: pure least-loaded
+        cands = _cands("a", "b", "c")
+        now = 1_000
+        rc.load_view.note(LoadFeedback("a", 9, 0), now=now)
+        rc.load_view.note(LoadFeedback("b", 3, 0), now=now)
+        rc.load_view.note(LoadFeedback("c", 6, 0), now=now)
+        assert rc.pick(cands, now=now) == "b"
+
+    def test_staleness_decays_to_greedy_prior(self):
+        """Silence degrades toward greedy: the same loaded report stops
+        mattering once it ages past MM_FEEDBACK_DECAY_MS."""
+        rc = self._rc(decay_ms=1_000)
+        cands = _cands("a", "b", "c")
+        rc.load_view.note(LoadFeedback("a", 10, 0), now=1_000)
+        assert rc.pick(cands, now=1_100) != "a"      # fresh: avoid a
+        assert rc.load_view.score("a", 2_100) == 0.0  # fully decayed
+        assert {rc.pick(cands, now=2_100) for _ in range(50)} == {"a"}
+
+    def test_capability_weight_normalizes_load(self):
+        """A 2x-capacity candidate at 2x the reported load scores the
+        same; at slightly less it wins the sample."""
+        rc = self._rc(d=2)
+        big = ServeCandidate("big", weight=2.0)
+        small = ServeCandidate("small", weight=1.0)
+        now = 1_000
+        rc.load_view.note(LoadFeedback("small", 4, 0), now=now)
+        rc.load_view.note(LoadFeedback("big", 7, 0), now=now)  # 3.5 weighted
+        assert rc.pick((small, big), now=now) == "big"
+
+    def test_draining_ranks_behind_healthy_in_the_pick(self):
+        """The reconfig/ rank-behind-healthy semantics hold INSIDE the
+        sampled set: an idle draining candidate never beats a loaded
+        healthy one, however favorable its load score — and an
+        all-draining set still serves (the zero-gap drain window)."""
+        rc = self._rc(d=3)
+        cands = (
+            ServeCandidate("h1"),
+            ServeCandidate("h2"),
+            ServeCandidate("d1", draining=True),  # ranked last by greedy
+        )
+        now = 1_000
+        # Healthy candidates visibly loaded, the draining one idle:
+        # still a healthy pick.
+        rc.load_view.note(LoadFeedback("h1", 8, 0), now=now)
+        rc.load_view.note(LoadFeedback("h2", 6, 0), now=now)
+        assert rc.pick(cands, now=now) in ("h1", "h2")
+        only = (ServeCandidate("d1", draining=True),)
+        assert rc.pick(only, now=now) == "d1"
+
+    def test_loading_pick_never_balanced(self):
+        rc = self._rc(d=4)
+        loading = (ServeCandidate("l1", loading=True),)
+        rc.load_view.note(LoadFeedback("l1", 50, 0), now=1_000)
+        assert rc.pick(loading, now=1_000) == "l1"
+
+    def test_demote_reorders_set_and_penalizes(self):
+        """Failed-forward demotion: the entry SURVIVES (no re-herd
+        recompute), the failed candidate moves behind the survivors,
+        and the LoadView penalty makes d-choices avoid it everywhere
+        while fresh."""
+        rc = self._rc()
+        sig = frozenset()
+        rc.store("m", sig, 1, 1, _cands("a", "b", "c"), now=0)
+        rc.demote("m", "a", )
+        rc.load_view.demote("a", now=1_000)
+        entry = rc.lookup("m", sig, 1, 1, now=0)
+        assert entry is not None, "demotion must keep the cached set"
+        assert [c.iid for c in entry] == ["b", "c", "a"]
+        assert rc.invalidations == 0
+        picked = {rc.pick(entry, now=1_000) for _ in range(100)}
+        assert "a" not in picked and picked <= {"b", "c"}
+
+    def test_demote_with_d1_keeps_invalidate_parity(self):
+        rc = self._rc(d=1)
+        rc.store("m", frozenset(), 1, 1, _cands("a", "b"), now=0)
+        rc.demote("m", "a")
+        assert rc.lookup("m", frozenset(), 1, 1, now=0) is None
+        assert rc.invalidations == 1
+
+
+class TestLoadFeedbackWire:
+    def test_encode_decode_roundtrip(self):
+        fb = LoadFeedback("p-3", 7, 12, True)
+        got = LoadFeedback.decode("p-3", fb.encode())
+        assert (got.instance_id, got.in_flight, got.queue_depth,
+                got.draining) == ("p-3", 7, 12, True)
+
+    def test_malformed_trailer_is_advisory(self):
+        assert LoadFeedback.decode("p", "garbage") is None
+        assert LoadFeedback.decode("p", "1,2") is None
+        assert LoadFeedback.decode("p", "") is None
+
+    def test_drain_flag_biases_score(self):
+        lv = LoadView(decay_ms=5_000)
+        lv.note(LoadFeedback("d", 1, 0, True), now=1_000)
+        lv.note(LoadFeedback("h", 1, 0, False), now=1_000)
+        assert lv.score("d", 1_000) > lv.score("h", 1_000)
+
+    def test_prune_drops_fully_decayed_slots_only(self):
+        """Churned/replaced peers (fresh instance ids every rolling
+        restart) must not grow the view — and the gauge series — without
+        bound: fully-decayed slots are pruned on the publisher cadence;
+        fresh slots and slots with our own forwards outstanding stay."""
+        lv = LoadView(decay_ms=1_000)
+        horizon = 1_000 * LoadView.PRUNE_AFTER_DECAYS
+        lv.note(LoadFeedback("dead", 3, 0), now=0)
+        lv.note(LoadFeedback("fresh", 3, 0), now=horizon - 1)
+        lv.note(LoadFeedback("held", 3, 0), now=0)
+        lv.begin("held")  # our forward still in flight
+        assert lv.prune(now=horizon) == ["dead"]
+        assert set(lv._slots) == {"fresh", "held"}
+        lv.end("held")
+        assert lv.prune(now=horizon) == ["held"]
+        assert set(lv._slots) == {"fresh"}
 
 
 class TestRouteCacheCoherence:
@@ -339,19 +499,30 @@ class TestRouteCacheCoherence:
         )
         assert harness.invoke("m").served_by == "p-1"
 
-    def test_forward_failure_bypasses_and_invalidates(self, harness):
+    def test_forward_failure_demotes_within_set(self, harness):
+        """Failed-candidate demotion (the re-herd fix): the forward
+        failure keeps the cached candidate set — the failed target
+        drops to the back and the LoadView penalty steers every pick
+        to the survivor until the penalty decays."""
         harness.place_on("m", "p-0", "p-1")
         assert harness.invoke("m").served_by == "p-0"
         # Next forward to p-0 dies; the same request must retry (cache
         # bypassed via exclude_serve) and land on p-1...
         harness.fail_next["p-0"] = ServiceUnavailableError("ep-p-0")
         assert harness.invoke("m").served_by == "p-1"
-        # ...and the failure evicted the memo: nothing cached routes to
-        # p-0 without a fresh decision (which re-picks p-0 only because
-        # it is genuinely live again and least busy — that's correct).
-        assert "m" not in harness.inst.route_cache._by_model or (
-            harness.inst.route_cache._by_model["m"] == {}
-        )
+        # ...and the memo SURVIVED with p-0 demoted within it (the old
+        # cache dropped the whole entry, re-herding concurrent retries
+        # at one recomputed winner).
+        sigs = harness.inst.route_cache._by_model.get("m")
+        assert sigs, "demotion must not drop the candidate-set entry"
+        for entry in sigs.values():
+            assert entry[0][-1].iid == "p-0"
+        # Subsequent requests avoid the penalized candidate without any
+        # view movement.
+        harness.forwards.clear()
+        for _ in range(10):
+            assert harness.invoke("m").served_by == "p-1"
+        assert "p-0" not in harness.forwards
 
     def test_disabled_cache_still_serves(self, harness):
         harness.inst.route_cache.enabled = False
@@ -432,10 +603,89 @@ class TestSelectionParity:
             assert got == want, (mr.instance_ids, mr.loading_instances,
                                  exclude, instances)
 
+    def test_rank_head_matches_choose_serve_target(self):
+        """rank_serve_candidates[0] must equal choose_serve_target on
+        the same inputs — the candidate-set export and the single-pass
+        selection share their ranking rule and must never fork (same
+        random-view sweep as the sort-oracle parity above)."""
+        rng = random.Random(0xBEEF)
+        strat = GreedyStrategy()
+        expect = strat._expect_ms("t")
+        for _ in range(300):
+            now = now_ms()
+            n = rng.randint(0, 12)
+            ids = [f"i-{k}" for k in range(n)]
+            instances = []
+            for iid in ids:
+                instances.append((iid, InstanceRecord(
+                    capacity_units=rng.choice([50, 100, 400]),
+                    used_units=rng.randint(0, 50),
+                    req_per_minute=rng.choice([0, 5, 5, 50, 500]),
+                    shutting_down=rng.random() < 0.2,
+                    draining=rng.random() < 0.2,
+                )))
+            view = ClusterView(instances=tuple(instances))
+            mr = ModelRecord(model_type="t")
+            for iid in ids:
+                r = rng.random()
+                if r < 0.4:
+                    mr.instance_ids[iid] = now - int(
+                        rng.choice([0.1, 10.0]) * expect
+                    )
+                elif r < 0.6:
+                    mr.loading_instances[iid] = now - int(
+                        rng.choice([0.1, 10.0]) * expect
+                    )
+            exclude = frozenset(iid for iid in ids if rng.random() < 0.3)
+            ranked = strat.rank_serve_candidates(mr, view, exclude)
+            single = strat.choose_serve_target(mr, view, exclude)
+            head = ranked[0].iid if ranked else None
+            assert head == single, (mr.instance_ids, exclude, instances)
+            # The ranked set lists every eligible ready copy exactly
+            # once, in rank order with no duplicates.
+            ready = [c for c in ranked if not c.loading]
+            assert len({c.iid for c in ready}) == len(ready)
+
+    def test_rank_weights_follow_advertised_capacity(self):
+        strat = GreedyStrategy()
+        now = now_ms()
+        view = ClusterView(instances=(
+            ("big", InstanceRecord(capacity_units=300)),
+            ("small", InstanceRecord(capacity_units=100)),
+        ))
+        mr = ModelRecord(model_type="t")
+        mr.instance_ids = {"big": now - HOUR, "small": now - HOUR}
+        by_id = {
+            c.iid: c
+            for c in strat.rank_serve_candidates(mr, view, frozenset())
+        }
+        # Normalized against the set mean (200): 1.5 vs 0.5.
+        assert by_id["big"].weight == pytest.approx(1.5)
+        assert by_id["small"].weight == pytest.approx(0.5)
+
+    def test_route_d1_parity_with_single_winner(self, harness):
+        """MM_ROUTE_D=1 regression pin: the candidate-set cache must
+        route exactly like the old single-winner memo — the pick is
+        rank 0 always, even with live load feedback against it."""
+        inst = harness.inst
+        inst.route_cache.route_d = 1
+        harness.place_on("m", "p-0", "p-1", "p-2")
+        # Heavy reported load on the greedy winner: d=1 must ignore it.
+        inst.route_cache.load_view.note(LoadFeedback("p-0", 50, 50))
+        sig = frozenset({inst.instance_id})
+        for _ in range(10):
+            mr = inst.registry_view.get("m")
+            cached = inst._choose_serve_target("m", mr, RoutingContext())
+            direct = inst.strategy.choose_serve_target(
+                mr, inst.cluster_view(), sig
+            )
+            assert cached == direct == "p-0"
+
     def test_cached_and_uncached_agree_under_random_churn(self, harness):
         """Drive the instance-level cached selection against the direct
         strategy call across random registry/instance mutations; after
-        every quiesced mutation the two must agree."""
+        every quiesced mutation the two must agree (no feedback is
+        installed, so the d-choices pick reduces to the greedy prior)."""
         rng = random.Random(7)
         inst = harness.inst
         peers = ["p-0", "p-1", "p-2"]

@@ -80,6 +80,36 @@ def test_slo_flash_crowd_replays_bit_for_bit():
     assert first.trace_lines() == second.trace_lines()
 
 
+def test_overload_without_admission_breaches_hi_slo():
+    """Non-vacuity of the overload scenario, the other way: the SAME
+    flood with MM_ADMISSION off must breach the judged hi-class SLO
+    (every unthrottled request rides the compounding backlog) and shed
+    nothing — proving the passing variant's verdict is the admission
+    controller's doing, not a lenient bound."""
+    result = run_scenario(
+        scenarios.overload_shed_protects_slo(admission=False)
+    )
+    assert not result.ok
+    assert result.verdicts["hi_slo_attained"], (
+        "hi SLO held without admission control — the overload scenario "
+        "is vacuous"
+    )
+    assert any("p99" in v for v in result.verdicts["hi_slo_attained"])
+    # The sheds_fired non-vacuity check only exists on the admission-on
+    # variant (the off variant sheds nothing by construction).
+    assert "sheds_fired" not in result.verdicts
+
+
+def test_overload_shed_scenario_replays_bit_for_bit():
+    """The admission tentpole's acceptance property: the passing
+    (admission-on) overload run replays identically from its seed —
+    same trace, same verdict lines."""
+    first = run_scenario(scenarios.overload_shed_protects_slo())
+    second = run_scenario(scenarios.overload_shed_protects_slo())
+    assert first.ok, first.render()
+    assert first.trace_lines() == second.trace_lines()
+
+
 def test_late_eviction_quiesce_catches_reverted_fix():
     """With the quiesce's async-deregister drain reverted
     (quiesce_async=False — the pre-fix runner behavior), the held

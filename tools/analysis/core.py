@@ -117,6 +117,10 @@ class ModuleInfo:
     # lazily-built shared walk: every node paired with its innermost
     # enclosing function qualname (see walked())
     _walked: Optional[list] = field(default=None, repr=False)
+    # lazily-built (class_qualname, def) list shared by iter_functions —
+    # rule families call it dozens of times per module, and re-walking
+    # the whole tree each call dominated the analyzer's runtime budget
+    _functions: Optional[list] = field(default=None, repr=False)
 
     def walked(self) -> list[tuple[ast.AST, str]]:
         """Every AST node paired with the qualname of its innermost
@@ -409,17 +413,22 @@ def qualname_at(mod: ModuleInfo, func: ast.AST, cls: str) -> str:
 def iter_functions(mod: ModuleInfo):
     """Yield (class_qualname, function_node) for every def in the module,
     including methods (class name attached) and nested functions (with
-    the outer function's class)."""
-    def walk(node: ast.AST, cls: str):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.ClassDef):
-                yield from walk(child, child.name)
-            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                yield cls, child
-                yield from walk(child, cls)
-            else:
-                yield from walk(child, cls)
-    yield from walk(mod.tree, "")
+    the outer function's class). Cached per module: every rule family
+    calls this for every scope it checks — one walk, shared."""
+    if mod._functions is None:
+        def walk(node: ast.AST, cls: str):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    yield from walk(child, child.name)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield cls, child
+                    yield from walk(child, cls)
+                else:
+                    yield from walk(child, cls)
+        mod._functions = list(walk(mod.tree, ""))
+    return iter(mod._functions)
 
 
 # --------------------------------------------------------------------- #

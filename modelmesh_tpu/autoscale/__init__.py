@@ -1,0 +1,18 @@
+"""Autoscale subsystem: burn-rate-driven copy scaling + predictive
+pre-warming (see controller.py for the full design note)."""
+
+from modelmesh_tpu.autoscale.controller import (
+    AutoscaleConfig,
+    AutoscaleController,
+    MODES,
+    prewarm_plan_key,
+)
+from modelmesh_tpu.autoscale.forecast import DemandForecaster
+
+__all__ = [
+    "AutoscaleConfig",
+    "AutoscaleController",
+    "DemandForecaster",
+    "MODES",
+    "prewarm_plan_key",
+]

@@ -362,6 +362,17 @@ class HostTier(Generic[K, V]):
     def keys(self) -> list[K]:
         return list(self._copies.keys())
 
+    def _insert_locked(self, key: K, value: V, size_bytes: int) -> None:
+        """Reclaim any same-key entry, then insert with fresh recency.
+        ONE implementation of the entry layout/accounting shared by
+        both insert policies below. Caller holds the lock."""
+        prev = self._copies.pop(key, None)
+        if prev is not None:
+            self._used -= prev[1]
+        self._seq += 1
+        self._copies[key] = [value, size_bytes, now_ms(), self._seq]
+        self._used += size_bytes
+
     def put(self, key: K, value: V, size_bytes: int) -> bool:
         """Insert/replace a host copy; False when the tier is disabled or
         the copy alone exceeds the host budget (caller falls back to a
@@ -371,13 +382,26 @@ class HostTier(Generic[K, V]):
         if size_bytes <= 0 or size_bytes > self._capacity:
             return False
         with self._lock:
-            prev = self._copies.pop(key, None)
-            if prev is not None:
-                self._used -= prev[1]
-            self._seq += 1
-            self._copies[key] = [value, size_bytes, now_ms(), self._seq]
-            self._used += size_bytes
+            self._insert_locked(key, value, size_bytes)
             self._evict_over_capacity_locked(exclude=key)
+            return True
+
+    def put_if_room(self, key: K, value: V, size_bytes: int) -> bool:
+        """Speculative insert (the autoscale pre-warm hook): accepted
+        only when the copy fits the FREE budget — a forecast-driven
+        pre-warm must never evict a demoted snapshot, whose presence is
+        a certainty (that copy existed) rather than a prediction.
+        Replacing an existing snapshot for the same key is allowed (its
+        bytes are reclaimed first, so no third copy is displaced)."""
+        size_bytes = int(size_bytes)
+        if size_bytes <= 0 or size_bytes > self._capacity:
+            return False
+        with self._lock:
+            prev = self._copies.get(key)
+            freed = prev[1] if prev is not None else 0
+            if self._used - freed + size_bytes > self._capacity:
+                return False
+            self._insert_locked(key, value, size_bytes)
             return True
 
     def get(self, key: K) -> Optional[V]:

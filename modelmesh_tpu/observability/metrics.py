@@ -80,6 +80,18 @@ class Metric(enum.Enum):
                             "requests shed at the admission edge "
                             "(per-class token bucket empty past the "
                             "queue window)")
+    # Autoscale controller (autoscale/controller.py): decision counters —
+    # one increment per recorded decision, mirrored in the flight
+    # recorder's autoscale-* events.
+    AUTOSCALE_UP_COUNT = ("mm_autoscale_up_count", "counter",
+                          "burn-driven copy adds issued by the "
+                          "autoscale controller")
+    AUTOSCALE_DOWN_COUNT = ("mm_autoscale_down_count", "counter",
+                            "surplus copies demoted to the host tier "
+                            "by the autoscale controller")
+    AUTOSCALE_PREWARM_COUNT = ("mm_autoscale_prewarm_count", "counter",
+                               "host-tier snapshots staged ahead of "
+                               "forecast demand")
     # histograms (ms)
     API_REQUEST_TIME = ("mm_api_request_time_ms", "histogram", "request latency")
     # Per-stage latency decomposition: closed tracing spans export here

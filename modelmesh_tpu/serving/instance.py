@@ -180,6 +180,7 @@ class InstanceConfig:
         drain_timeout_ms: Optional[int] = None,
         trace_sample: Optional[int] = None,
         slo_spec: Optional[str] = None,
+        slo_window_ms: Optional[int] = None,
         batch_max: Optional[int] = None,
         batch_window_us: Optional[int] = None,
         route_d: Optional[int] = None,
@@ -263,6 +264,12 @@ class InstanceConfig:
         if slo_spec is None:
             slo_spec = _envs.get("MM_SLO_SPEC")
         self.slo_spec = slo_spec
+        # Sliding attainment window (MM_SLO_WINDOW_MS). Overridable per
+        # instance so sims/benches can judge burn over their own (much
+        # shorter) timelines without touching process env state.
+        if slo_window_ms is None:
+            slo_window_ms = _envs.get_int("MM_SLO_WINDOW_MS")
+        self.slo_window_ms = slo_window_ms
         # Batched data plane (serving/batching.py): continuous-batching
         # micro-batch queue in front of the runtime call. batch_max <= 1
         # disables the queue; the window (µs) bounds how long a batch
@@ -402,7 +409,10 @@ class ModelMeshInstance:
             sample_n=self.config.trace_sample,
         )
         self.flightrec = FlightRecorder(instance_id=self.instance_id)
-        self.slo = SloTracker(spec=self.config.slo_spec, metrics=sink)
+        self.slo = SloTracker(
+            spec=self.config.slo_spec, metrics=sink,
+            window_ms=self.config.slo_window_ms,
+        )
         self.time_stats = TimeStats()
         # Strategies that accept per-type load-time stats (greedy's warming
         # penalty and wait-vs-reroute bound) get this instance's tracker.
@@ -2165,6 +2175,53 @@ class ModelMeshInstance:
             log.warning("host-claim drop CAS gave up for %s", model_id)
         except Exception:  # noqa: BLE001 — stale claims are reaper-pruned
             pass
+
+    def _claim_host_copy(self, model_id: str) -> bool:
+        """Advertise this instance as a host-tier holder (the pre-warm
+        twin of _drop_host_claim): receivers rank advertised holders as
+        peer-fetch sources and re-warm targets."""
+        def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
+            if cur is None:
+                return None
+            cur.claim_host_copy(self.instance_id)
+            return cur
+
+        try:
+            self.registry.update_or_create(model_id, mutate)
+            return True
+        except CasFailed:
+            log.warning("host-claim CAS gave up for %s", model_id)
+            return False
+        except Exception:  # noqa: BLE001 — an unadvertised snapshot is
+            # harmless; the next pre-warm pass (or demote) re-claims
+            return False
+
+    def demote_surplus_copy(self, model_id: str) -> bool:
+        """Autoscale scale-down actuation (autoscale/controller.py):
+        drop the local device copy but demote its weights into the host
+        tier first, so a demand reversal re-warms with a host->device
+        copy (~9 ms) instead of re-paying the cold store load (~82 ms).
+        The host claim is advertised with the deregistration, exactly
+        like a drain's cold-copy demotion."""
+        ce = self.cache.get_quietly(model_id)
+        if ce is None or ce.state is not EntryState.ACTIVE:
+            return False
+        if not self._remove_local(model_id, demote=True):
+            return False
+        self.metrics.inc(MX.SCALE_DOWN_COUNT, model_id=model_id)
+        return True
+
+    def prewarm_host_copy(self, model_id: str) -> bool:
+        """Predictive pre-warm actuation (autoscale/controller.py):
+        stage a host-tier snapshot of ``model_id`` streamed from a live
+        holder (never the store) and advertise the host claim, so the
+        forecast ramp is absorbed by the re-warm path. Best-effort; the
+        snapshot is speculative and never evicts demoted copies
+        (HostTier.put_if_room)."""
+        if not self.transfer.prewarm_host(model_id):
+            return False
+        self._claim_host_copy(model_id)
+        return True
 
     def handle_weight_fetch(
         self, model_id: str, chunk_index: int, fingerprint: str = "",

@@ -23,6 +23,7 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import weakref
 from typing import Optional
 
 from modelmesh_tpu.cache.lru import now_ms
@@ -49,6 +50,57 @@ SURPLUS_COPY_MAX_AGE_MS = 10 * 3600_000
 PROACTIVE_RESERVE_FRACTION = 0.125      # keep 12.5% free (reference :6616)
 
 
+def cluster_fullness(inst, model_type: Optional[str] = None) -> float:
+    """Fullness over the candidate subset for ``model_type`` (per-label
+    subset stats, InstanceSetStatsTracker.java:17-40) — global fullness
+    is wrong in heterogeneous clusters: a full GPU-labeled pool must
+    trigger scale-down of GPU models even while CPU pools sit empty,
+    and vice versa. Shared by the legacy janitor and the autoscale
+    controller's capacity valve."""
+    views = list(inst.instances_view.items())
+    constraints = inst.constraints
+    if model_type is not None and constraints is not None:
+        subset = [
+            (i, r) for i, r in views
+            if constraints.is_candidate(model_type, r.labels)
+        ]
+        views = subset or views
+    cap = sum(r.capacity_units for _, r in views) or 1
+    used = sum(r.used_units for _, r in views)
+    return used / cap
+
+
+def surplus_shed_eligible(
+    inst, model_id: str, mr: ModelRecord, now: int, min_age_ms: int,
+    scale_up_rpm: int,
+) -> bool:
+    """The surplus-copy predicate BOTH scaling authorities share (the
+    legacy janitor's cluster-full scale-down and the autoscale
+    controller's calm-class demotion — one definition so their
+    eligibility rules cannot fork): this instance holds one of >= 2
+    READY copies (a copy still loading elsewhere must not license
+    dropping the sole active one), the copy is past the anti-thrash
+    minimum age, and OUR local traffic is well under the per-copy
+    threshold (< 2/3 of it, reference :6197-6379 — symmetric with
+    scale-up)."""
+    if mr is None or len(mr.instance_ids) < 2:
+        return False
+    our_ts = mr.instance_ids.get(inst.instance_id)
+    if our_ts is None:
+        return False
+    if now - our_ts < min_age_ms:
+        return False
+    return inst.model_rpm(model_id) < scale_up_rpm * 2 // 3
+
+
+def elected_shedder(mr: ModelRecord) -> str:
+    """Shedder election shared by both scaling authorities: the NEWEST
+    copy's holder (tie-break id) sheds — keeps the established copy and
+    rotates fairly as newest changes, unlike highest-id-always-sheds
+    which skews one instance forever."""
+    return max(mr.instance_ids.items(), key=lambda kv: (kv[1], kv[0]))[0]
+
+
 class TaskConfig:
     def __init__(
         self,
@@ -62,6 +114,9 @@ class TaskConfig:
         assume_gone_ms: int = ASSUME_INSTANCE_GONE_MS,
         max_copies: int = 8,
         jitter_frac: float = 0.1,
+        autoscale_mode: Optional[str] = None,
+        autoscale_interval_s: float = 10.0,
+        autoscale=None,
     ):
         self.publish_interval_s = publish_interval_s
         self.rate_interval_s = rate_interval_s
@@ -78,6 +133,27 @@ class TaskConfig:
         # task) seeded RNG, so a mass-restarted fleet spreads its
         # publisher/janitor KV load instead of thundering in lockstep.
         self.jitter_frac = jitter_frac
+        # The ONE copy-scaling authority (MM_AUTOSCALE): "legacy" keeps
+        # the rate-task scale-up + janitor cluster-full scale-down
+        # exactly as before; "burn" replaces BOTH with the autoscale/
+        # controller (its tick rides the same task machinery); "off"
+        # disables scaling entirely. Exactly one authority ever runs.
+        if autoscale_mode is None:
+            from modelmesh_tpu.utils import envs
+
+            autoscale_mode = envs.get("MM_AUTOSCALE") or "legacy"
+        from modelmesh_tpu.autoscale.controller import MODES
+
+        if autoscale_mode not in MODES:
+            raise ValueError(
+                f"MM_AUTOSCALE={autoscale_mode!r} — expected one of {MODES}"
+            )
+        self.autoscale_mode = autoscale_mode
+        self.autoscale_interval_s = autoscale_interval_s
+        # Optional AutoscaleConfig override (tests/benches/scenarios);
+        # None builds the env-resolved defaults, sharing this config's
+        # max_copies and per-copy rate threshold.
+        self.autoscale = autoscale
 
 
 class BackgroundTasks:
@@ -94,22 +170,73 @@ class BackgroundTasks:
         # to assert a mass-restarted fleet doesn't fire in lockstep; each
         # list is appended only by its own task thread.
         self.tick_times: dict[str, list[int]] = {}
-        # model_id -> previous-use timestamp at last rate tick (drives the
-        # 1->2 "used, idle, used again" heuristic).
-        self._prev_use: dict[str, int] = {}
+        # model_id -> (previous-use timestamp at last rate tick, a
+        # WEAK ref to the CacheEntry it was observed on). The entry
+        # identity pins the prev-use sample to one model INCARNATION: a
+        # delete→re-register inside a rate interval mints a fresh entry,
+        # and comparing identities makes the stale timestamp read as "no
+        # previous use" instead of fabricating a used-again age that
+        # trips a spurious 1->2 scale-up (the serving/tasks.py:184
+        # leak). Weak, not strong: a strong ref would pin the dead
+        # incarnation's entry (and its loaded-weights handle) until the
+        # model is next used; a dead ref simply reads as a fresh
+        # incarnation, which is the correct answer anyway.
+        self._prev_use: dict[str, tuple[int, object]] = {}
         self._last_rate_tick = now_ms()
         # leader state: instance_id -> first time we noticed it missing.
         self._missing_since: dict[str, int] = {}
+        # Autoscale controller (autoscale/controller.py), present only in
+        # burn mode — the single non-legacy scaling authority.
+        self.autoscaler = None
+        if self.config.autoscale_mode == "burn":
+            from modelmesh_tpu.autoscale.controller import (
+                AutoscaleConfig,
+                AutoscaleController,
+            )
+
+            import copy as _copy
+
+            asc = self.config.autoscale
+            if asc is None:
+                asc = AutoscaleConfig()
+            # Unpinned controller bounds inherit THIS task config's, so
+            # the ceiling the controller enforces and the one the sim's
+            # copy_bounds invariant checks are the same number even for
+            # scenarios passing an explicit AutoscaleConfig. Resolved on
+            # a COPY: the caller's config object may be shared across
+            # fleets, and writing through it would make two clusters'
+            # controllers last-writer-wins on each other's ceilings.
+            asc = _copy.copy(asc)
+            if not asc._max_copies_pinned:
+                asc.max_copies = self.config.max_copies
+            if not asc._scale_up_rpm_pinned:
+                asc.scale_up_rpm = self.config.scale_up_rpm
+            self.autoscaler = AutoscaleController(instance, asc)
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
         specs = [
             ("publisher", self.config.publish_interval_s, self._publish_tick),
-            ("rate", self.config.rate_interval_s, self._rate_tick),
             ("janitor", self.config.janitor_interval_s, self._janitor_tick),
             ("reaper", self.config.reaper_interval_s, self._reaper_tick),
         ]
+        # Exactly one scaling authority: the legacy rate-task scaler OR
+        # the burn-rate autoscale controller (or neither, mode "off").
+        # The janitor's cluster-full scale-down is gated the same way in
+        # _janitor_tick — reconciliation always runs, scale-down only
+        # under the legacy authority.
+        mode = self.config.autoscale_mode
+        if mode == "legacy":
+            specs.insert(
+                1, ("rate", self.config.rate_interval_s, self._rate_tick)
+            )
+        elif mode == "burn":
+            specs.insert(
+                1,
+                ("autoscale", self.config.autoscale_interval_s,
+                 self._autoscale_tick),
+            )
         for name, interval, fn in specs:
             t = threading.Thread(
                 target=self._loop, args=(name, interval, fn),
@@ -126,8 +253,10 @@ class BackgroundTasks:
     # Tasks that mutate the registry skip their cycle when the KV store is
     # unreachable (reference janitor/reaper guard, ModelMesh.java:5886,
     # 6449) — half-applied reconciliation against a flapping store does
-    # more harm than a skipped cycle.
-    _NEEDS_KV = frozenset({"janitor", "reaper"})
+    # more harm than a skipped cycle. The autoscale tick qualifies: its
+    # decisions CAS the registry (copy adds/demotions) and read/write the
+    # pre-warm plan key.
+    _NEEDS_KV = frozenset({"janitor", "reaper", "autoscale"})
 
     def _kv_reachable(self) -> bool:
         try:
@@ -172,6 +301,11 @@ class BackgroundTasks:
     def _publish_tick(self) -> None:
         self.instance.publish_instance_record()
 
+    # -- autoscale controller (burn mode) ----------------------------------
+
+    def _autoscale_tick(self) -> None:
+        self.autoscaler.tick()
+
     # -- rate task: scale UP ----------------------------------------------
 
     def _rate_tick(self) -> None:
@@ -181,8 +315,11 @@ class BackgroundTasks:
         cutoff = self._last_rate_tick
         self._last_rate_tick = tick_start
         # Prune usage history for models no longer cached here (stale
-        # entries both leak and can trigger spurious 1->2 scale-ups when a
-        # model id is re-registered later).
+        # entries leak). Pruning alone cannot catch a model deleted AND
+        # re-registered between two ticks (the id is back in the cache by
+        # the time we look), so each sample below also carries the
+        # CacheEntry it was observed on — a fresh incarnation never
+        # inherits the dead one's timestamp.
         cached = set(inst.cache.keys())
         for gone in [k for k in self._prev_use if k not in cached]:
             del self._prev_use[gone]
@@ -193,8 +330,13 @@ class BackgroundTasks:
             if mr is None:
                 continue
             copies = mr.copy_count
-            prev = self._prev_use.get(model_id, 0)
-            self._prev_use[model_id] = last_used
+            prev_sample = self._prev_use.get(model_id)
+            prev = (
+                prev_sample[0]
+                if prev_sample is not None and prev_sample[1]() is ce
+                else 0
+            )
+            self._prev_use[model_id] = (last_used, weakref.ref(ce))
             if copies >= cfg.max_copies:
                 continue
             if copies <= 1:
@@ -276,26 +418,14 @@ class BackgroundTasks:
                     inst.registry.update_or_create(model_id, fix)
                 except CasFailed:
                     pass
-        # (c) scale-down when the cluster is nearly full.
-        self._maybe_scale_down()
+        # (c) scale-down when the cluster is nearly full — LEGACY scaling
+        # authority only: in burn mode the autoscale controller owns
+        # scale-down (demote-to-host); in off mode nothing scales.
+        if self.config.autoscale_mode == "legacy":
+            self._maybe_scale_down()
 
     def _cluster_fullness(self, model_type: Optional[str] = None) -> float:
-        """Fullness over the candidate subset for ``model_type`` (per-label
-        subset stats, InstanceSetStatsTracker.java:17-40) — global fullness
-        is wrong in heterogeneous clusters: a full GPU-labeled pool must
-        trigger scale-down of GPU models even while CPU pools sit empty,
-        and vice versa."""
-        views = list(self.instance.instances_view.items())
-        constraints = self.instance.constraints
-        if model_type is not None and constraints is not None:
-            subset = [
-                (i, r) for i, r in views
-                if constraints.is_candidate(model_type, r.labels)
-            ]
-            views = subset or views
-        cap = sum(r.capacity_units for _, r in views) or 1
-        used = sum(r.used_units for _, r in views)
-        return used / cap
+        return cluster_fullness(self.instance, model_type)
 
     def _maybe_scale_down(self) -> None:
         inst = self.instance
@@ -314,35 +444,33 @@ class BackgroundTasks:
         now = now_ms()
         for model_id in inst.cache.keys():
             mr = inst.registry_view.get(model_id)
-            # Count only READY copies: a copy still loading elsewhere must
-            # not license dropping the sole active one.
-            if mr is None or len(mr.instance_ids) < 2:
+            # Shared eligibility (surplus_shed_eligible): >= 2 READY
+            # copies, ours past the anti-thrash minimum age, local rate
+            # under 2/3 of the per-copy threshold.
+            if not surplus_shed_eligible(
+                inst, model_id, mr, now,
+                SURPLUS_COPY_MIN_AGE_MS, cfg.scale_up_rpm,
+            ):
                 continue
-            our_ts = mr.instance_ids.get(inst.instance_id)
-            if our_ts is None:
-                continue
-            age = now - our_ts
-            if age < SURPLUS_COPY_MIN_AGE_MS:
-                continue  # anti-thrash: too young to shed
-            rpm = inst.model_rpm(model_id)
-            # Our copy is surplus if OUR traffic is well under the per-copy
-            # threshold (reference: < 2/3 of it, :6197-6379) — local rate vs
-            # per-copy threshold, symmetric with scale-up.
-            if rpm >= cfg.scale_up_rpm * 2 // 3:
-                continue
+            age = now - mr.instance_ids[inst.instance_id]
             # Fullness gates ordinary scale-down; a surplus copy past the
             # 10 h cap sheds regardless (reference :257).
             if not subset_full(mr.model_type) and age < SURPLUS_COPY_MAX_AGE_MS:
                 continue
-            # Shedder: the NEWEST copy's holder (tie-break id) — keeps the
-            # established copy and rotates fairly as newest changes, unlike
-            # highest-id-always-sheds which skews one instance forever.
-            shedder = max(
-                mr.instance_ids.items(), key=lambda kv: (kv[1], kv[0])
-            )[0]
-            if shedder == inst.instance_id:
+            if elected_shedder(mr) == inst.instance_id:
                 log.info("scale-down: dropping surplus copy of %s", model_id)
-                inst._remove_local(model_id)
+                if inst._remove_local(model_id):
+                    # mm_scale_down_count counts surplus copies removed
+                    # by WHICHEVER scaling authority is active (the
+                    # burn-mode demote path increments it too);
+                    # mm_autoscale_down_count is the controller's
+                    # decision counter on top.
+                    from modelmesh_tpu.observability.metrics import (
+                        Metric as _MX,
+                    )
+
+                    inst.metrics.inc(_MX.SCALE_DOWN_COUNT,
+                                     model_id=model_id)
 
     # -- reaper (leader only) ---------------------------------------------
 

@@ -239,18 +239,36 @@ class SimCluster:
         instance_kwargs: Optional[dict] = None,
         service_base_ms: float = 0.0,
         service_congestion_ms: float = 0.0,
+        service_scope: str = "fleet",
+        service_congestion_cap: int = 0,
     ):
         self.seed = seed
         # Virtual-time service-cost model for runtime calls: each
         # dispatch costs base + congestion * (concurrent dispatches - 1)
-        # virtual ms, with the concurrency counted FLEET-GLOBAL (one
-        # shared accelerator domain — overload scenarios test admission
-        # control, not placement spread). Zero (default) keeps the
-        # historical instantaneous runtime; without a congestion term
-        # there is no tail for admission control to protect.
+        # virtual ms. ``service_scope`` picks what "concurrent" means:
+        # "fleet" (default) counts dispatches FLEET-GLOBAL (one shared
+        # accelerator domain — overload scenarios test admission
+        # control, not placement spread); "instance" prices each pod's
+        # dispatches independently, so COPY COUNT and placement spread
+        # change latency — the model the autoscale scenarios need (more
+        # copies = less per-pod concurrency = lower tail). Zero costs
+        # keep the historical instantaneous runtime; without a
+        # congestion term there is no tail for either controller to
+        # protect.
+        if service_scope not in ("fleet", "instance"):
+            raise ValueError(f"unknown service_scope {service_scope!r}")
         self.service_base_ms = service_base_ms
         self.service_congestion_ms = service_congestion_ms
-        self._service_inflight = 0  #: guarded-by: _service_lock
+        self.service_scope = service_scope
+        # Congestion ceiling (concurrent dispatches counted beyond the
+        # first; 0 = uncapped). A real runtime bounds its admission
+        # queue, so per-dispatch cost saturates instead of growing with
+        # an unbounded backlog — without the cap, one deep pre-recovery
+        # backlog prices NEW requests for as long as its slowest sleeper
+        # lives, and no scaling action can ever look recovered.
+        self.service_congestion_cap = int(service_congestion_cap)
+        # scope key ("" fleet-global, else instance id) -> in-flight count
+        self._service_inflight: dict[str, int] = {}  #: guarded-by: _service_lock
         self._service_lock = threading.Lock()
         self.kv = SimKV(seed=seed, config=kv_config)
         self.task_config = task_config or TaskConfig()
@@ -468,21 +486,27 @@ class SimCluster:
 
     def _service_delay(self, iid: str) -> None:
         """Charge one runtime dispatch its virtual service cost under
-        the congestion model (no-op when unconfigured)."""
+        the congestion model (no-op when unconfigured). The concurrency
+        key is the serving pod under scope="instance", fleet-global
+        otherwise."""
         if not self.service_base_ms and not self.service_congestion_ms:
             return
+        key = iid if self.service_scope == "instance" else ""
         with self._service_lock:
-            self._service_inflight += 1
-            inflight = self._service_inflight
+            inflight = self._service_inflight.get(key, 0) + 1
+            self._service_inflight[key] = inflight
         try:
-            delay_ms = self.service_base_ms + self.service_congestion_ms * (
-                inflight - 1
+            queued = inflight - 1
+            if self.service_congestion_cap > 0:
+                queued = min(queued, self.service_congestion_cap)
+            delay_ms = self.service_base_ms + (
+                self.service_congestion_ms * queued
             )
             if delay_ms > 0:
                 _clock.sleep(delay_ms / 1000.0)
         finally:
             with self._service_lock:
-                self._service_inflight -= 1
+                self._service_inflight[key] -= 1
 
     def _runtime_call(
         self, ce, method, payload: bytes, headers, cancel_event=None

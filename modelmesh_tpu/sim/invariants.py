@@ -30,6 +30,10 @@ Catalog (see docs/testing.md for the rationale of each):
   placements as some other checker's problem (it is neither a dead
   placement — the pod is alive — nor a cache mismatch once the local
   copy is gone).
+- ``copy_bounds`` — no record holds more placements than the fleet's
+  per-model ceiling (``TaskConfig.max_copies``): the autoscale
+  controller's hard cap, and the first place a runaway scale-up loop
+  would show.
 
 ``slo_attained(spec)`` is a FACTORY, not part of the standard suite:
 scenarios attach it via ``extra_checks`` with their own objective spec.
@@ -248,8 +252,27 @@ def draining_deregistered(cluster: "SimCluster") -> list[str]:
     return out
 
 
+def copy_bounds(cluster: "SimCluster") -> list[str]:
+    """No registry record may hold more placements than the fleet's
+    configured per-model ceiling (``TaskConfig.max_copies``) — the
+    autoscale controller's hard cap, and a sanity bound every scenario
+    should respect (a runaway scale-up loop shows here before it shows
+    anywhere else)."""
+    out: list[str] = []
+    cap = cluster.task_config.max_copies
+    inst = cluster.first_live().instance
+    for mid, mr in inst.registry.items():
+        if mr.copy_count > cap:
+            out.append(
+                f"record {mid} holds {mr.copy_count} copies "
+                f"(> max_copies {cap}): {sorted(mr.all_placements)}"
+            )
+    return out
+
+
 def slo_attained(spec: str, window_ms: int = 10_000, min_requests: int = 1,
-                 model_filter=None, slo_class: str = ""):
+                 model_filter=None, slo_class: str = "",
+                 judge_after_ms: int = 0):
     """Machine-checked SLO attainment over the scenario's observed probe
     traffic (``SimCluster.request_log``: virtual ts, model, ok, error,
     virtual latency). The run's virtual timeline is cut into
@@ -263,7 +286,16 @@ def slo_attained(spec: str, window_ms: int = 10_000, min_requests: int = 1,
     prefix — how the overload scenario asserts per-class divergence);
     ``slo_class`` names both the spec clause to judge by and the class
     tag in violation strings (default: the spec's 'default' clause
-    judging everything the filter admits)."""
+    judging everything the filter admits).
+
+    ``judge_after_ms`` drops samples earlier than that many virtual ms
+    after the FIRST filtered sample — the detection-ramp allowance for
+    REACTIVE controllers (PR-14 house style): an autoscaler or admission
+    throttle cannot promise no-breach while its burn window is still
+    accumulating evidence, so the judged property is "the SLO holds once
+    the controller has had its detection window", with the ramp's
+    duration pinned explicitly in the scenario. The vacuity guard still
+    applies to what remains."""
     from modelmesh_tpu.observability.slo import (
         _percentile,
         parse_slo_spec,
@@ -284,6 +316,14 @@ def slo_attained(spec: str, window_ms: int = 10_000, min_requests: int = 1,
         if not log_:
             return [f"{tag}no probe requests observed (vacuous SLO run)"]
         out: list[str] = []
+        if judge_after_ms:
+            ramp_end = min(t for t, *_ in log_) + judge_after_ms
+            log_ = [row for row in log_ if row[0] >= ramp_end]
+            if not log_:
+                return [
+                    f"{tag}no probe requests after the {judge_after_ms}ms "
+                    "detection ramp (vacuous SLO run)"
+                ]
         base = min(t for t, *_ in log_)
         windows: dict[int, list[tuple[float, bool]]] = {}
         for t, _mid, ok, _err, latency_ms in log_:
@@ -344,4 +384,5 @@ def check_all(
         "cache_weight_consistent": cache_weight_consistent(cluster),
         "host_claims_converged": host_claims_converged(cluster),
         "draining_deregistered": draining_deregistered(cluster),
+        "copy_bounds": copy_bounds(cluster),
     }

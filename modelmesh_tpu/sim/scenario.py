@@ -117,8 +117,16 @@ class Scenario:
     # Virtual-time runtime service-cost model (SimCluster): per dispatch,
     # base + congestion * (concurrent dispatches - 1) ms. Overload
     # scenarios need a congestion term or latency never degrades.
+    # ``service_scope``: "fleet" prices concurrency fleet-global (one
+    # accelerator domain — admission scenarios), "instance" per serving
+    # pod (copy count/spread changes latency — autoscale scenarios).
+    # ``service_congestion_cap`` bounds the priced concurrency (0 =
+    # uncapped): the bounded-admission-queue model, without which one
+    # deep backlog prices new dispatches long after a recovery action.
     service_base_ms: float = 0.0
     service_congestion_ms: float = 0.0
+    service_scope: str = "fleet"
+    service_congestion_cap: int = 0
     # Quiesce hygiene: release hold gates, drain pending async
     # deregisters/unloads, and run one inline janitor cycle before the
     # invariant read (the registry_cache_convergence flake fix). Off
@@ -312,6 +320,8 @@ class ScenarioRunner:
                     instance_kwargs=sc.instance_kwargs,
                     service_base_ms=sc.service_base_ms,
                     service_congestion_ms=sc.service_congestion_ms,
+                    service_scope=sc.service_scope,
+                    service_congestion_cap=sc.service_congestion_cap,
                 )
                 if sc.kv_config is not None:
                     cluster.kv.config = sc.kv_config

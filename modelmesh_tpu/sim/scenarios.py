@@ -54,6 +54,15 @@ Catalog (race -> origin):
   in the request log — non-vacuity checked) and the judged hi-class
   probes hold p99<1200ms at every 10 s checkpoint; the admission-off
   variant breaches (meta-test, non-vacuity both ways).
+- flash_crowd_autoscaled — the autoscale/ tentpole proof: a flash crowd
+  on a single-copy hot model under PER-INSTANCE congestion pricing;
+  with MM_AUTOSCALE=burn the leader's controller converts the hot
+  class's burn rate into peer-streamed copy adds and the judged
+  post-ramp probes hold p99<2500ms at every 10 s checkpoint, with the
+  decisions flight-recorded; the legacy twin never reacts and breaches
+  (meta-tests prove non-vacuity both ways; a deliberately violated
+  judged spec fails WITH the controller's decisions visible in the
+  attached flight-recorder dump).
 - slo_under_flash_crowd — the observability tentpole proof: seeded Zipf
   probes (entered via rotating pods, forcing forward hops) with a
   flash-crowd overlay on a slow-loading cold model, judged by the
@@ -1005,6 +1014,174 @@ def overload_shed_protects_slo(admission: bool = True) -> Scenario:
     )
 
 
+# ------------------------------------------------------------------ #
+# 14. flash crowd: burn-driven autoscaling closes the loop             #
+# ------------------------------------------------------------------ #
+
+# The hot model's class objective IS the controller's signal source:
+# crowd latencies past 1200ms burn the hot class's budget, sim-0's
+# (leader's) controller reads the burn and adds copies over the fast
+# weight paths. Judged bounds live on the runner's 500ms step grid,
+# like the overload scenario: a served-locally probe costs one step,
+# the unthrottled single-holder backlog costs many.
+_AS_SPEC = "hot:p99<1200ms;default:p99<30000ms"
+_AS_MODEL = "as-hot"
+# Detection-ramp allowance (judge_after_ms): a reactive controller
+# cannot promise no-breach while its burn window is still accumulating
+# evidence — the judged property is "the SLO holds once the controller
+# has had its detection window" (PR-14 house style, pinned explicitly).
+_AS_RAMP_MS = 20_000
+
+
+def _check_autoscale_engaged(cluster: SimCluster):
+    """Non-vacuity (autoscaler ON): the controller really closed the
+    loop — burn-driven copy adds were DECIDED (flight-recorded on the
+    leader), the adds LANDED (the hot model holds >= 2 copies at
+    quiescence; the default 7-min surplus anti-thrash keeps them there
+    through the quiesce), and the new copies rode the fast weight path
+    (>= 1 streamed load: peer stream or host re-warm, never N store
+    loads)."""
+    out: list[str] = []
+    decisions = [
+        e for pod in cluster.pods
+        for e in pod.instance.flightrec.dump()
+        if e["kind"] == "autoscale-up"
+    ]
+    if not decisions:
+        out.append(
+            "no autoscale-up decision recorded — the controller never "
+            "engaged (vacuous autoscale run)"
+        )
+    mr = cluster.first_live().instance.registry.get(_AS_MODEL)
+    copies = len(mr.instance_ids) if mr is not None else 0
+    if copies < 2:
+        out.append(
+            f"{_AS_MODEL} holds {copies} cop(ies) at quiescence — the "
+            "burn-driven adds never landed"
+        )
+    streamed = sum(p.loader.stream_load_count for p in cluster.pods)
+    if streamed < 1:
+        out.append(
+            "no scale-up copy was materialized over the stream path "
+            "(peer fetch / host re-warm) — the flash crowd paid store "
+            "loads"
+        )
+    return out
+
+
+def flash_crowd_autoscaled(
+    mode: str = "burn", p99_ms: float = 2500.0,
+) -> Scenario:
+    """A sustained flash crowd on a single-copy hot model, under a
+    PER-INSTANCE congestion service model (each pod's dispatches price
+    independently, so copy count and spread change latency). With
+    MM_AUTOSCALE=burn the leader's controller reads the hot class's
+    burn rate, doubles the copy count over the peer-stream path before
+    the window p99 breaches, d-choices routing spreads the crowd over
+    the new copies, and the judged post-ramp probes hold p99<2500ms at
+    every 10 s checkpoint (5 runner steps: a locally-served probe costs
+    one, a same-step neighbor on the same pod a couple, and a CPU-starved
+    worker thread's virtual-latency inflation at most a couple more —
+    while the unscaled twin's holder saturates at the congestion cap,
+    4000ms, three full steps past the bound). The ``legacy`` twin never reacts (the crowd
+    sits far below the 2000-rpm rate-task threshold — exactly the gap
+    this controller closes) and breaches; the meta-tests in
+    tests/test_sim_scenarios.py prove non-vacuity both ways, and a
+    deliberately violated judged spec (``p99_ms=100``) fails with the
+    controller's decisions visible in the attached flight-recorder
+    dump. ``p99_ms`` parametrizes only the JUDGED spec — the pods'
+    serving spec (the controller's signal) is fixed."""
+    from modelmesh_tpu.autoscale.controller import AutoscaleConfig
+    from modelmesh_tpu.sim import invariants
+
+    n_pods = 4
+    task_config = TaskConfig(
+        publish_interval_s=8.0,
+        rate_interval_s=4.0,
+        janitor_interval_s=30.0,
+        reaper_interval_s=30.0,
+        assume_gone_ms=60_000,
+        autoscale_mode=mode,
+        autoscale_interval_s=2.0,
+        autoscale=AutoscaleConfig(min_burn_samples=4, holddown_ms=4_000),
+    )
+    events = [
+        Event(0, "register", (_AS_MODEL, "hot")),
+        Event(400, "ensure", (_AS_MODEL,)),
+    ]
+    # The crowd: BURSTS of 4 simultaneous probes every 400ms from 6s
+    # through 55.6s (10/s), one per entry pod. The burst shape is
+    # load-bearing for determinism: 4 same-instant arrivals all
+    # dispatch against the single holder before any can wake (their
+    # sleeps end at the next runner advance), so its concurrency — and
+    # the breach — does not depend on real-thread interleavings; once a
+    # copy serves on every pod, each burst member is served locally at
+    # concurrency ~1. The 125-burst length exactly fills the LAST
+    # judged 10s window (judged traffic starts at 26s; 46-56s gets the
+    # full 100 samples) — a sparse final window would make its
+    # nearest-rank p99 the max of a handful of samples, with zero
+    # tolerance for one scheduler-starved straggler.
+    events += [
+        Event(6_000 + 400 * j, "invoke", (_AS_MODEL, f"sim-{i}"))
+        for j in range(125)
+        for i in range(n_pods)
+    ]
+    judged_spec = f"hot:p99<{p99_ms:g}ms;default:p99<30000ms"
+    checks = {
+        "slo_attained": invariants.slo_attained(
+            judged_spec, window_ms=10_000, min_requests=3,
+            model_filter=lambda m: m == _AS_MODEL, slo_class="hot",
+            judge_after_ms=_AS_RAMP_MS,
+        ),
+        "no_request_failures": _check_no_request_failures,
+    }
+    if mode == "burn":
+        checks["autoscale_engaged"] = _check_autoscale_engaged
+    return Scenario(
+        name="flash-crowd-autoscaled"
+        + ("" if mode == "burn" else f"-{mode}")
+        + ("" if p99_ms == 2500.0 else "-tight"),
+        seed=114,
+        n_instances=n_pods,
+        horizon_ms=60_000,
+        task_config=task_config,
+        step_ms=500,
+        # Per-INSTANCE congestion pricing: more copies = fewer
+        # concurrent dispatches per pod = lower tail. base > 0 is
+        # load-bearing for the same reason as the overload scenario.
+        service_base_ms=5.0,
+        service_congestion_ms=300.0,
+        service_scope="instance",
+        # Bounded admission queue: the overloaded holder saturates at
+        # 5 + 300*12 ≈ 3.6s per dispatch — quantized to 4000ms on the
+        # step grid, 3 full steps PAST the judged 2500ms bound (the cap
+        # must not saturate AT the bound: nearest-rank p99 of a
+        # saturated window would then sit exactly on it and the
+        # unscaled twin would pass on a quiet machine) — instead of
+        # pricing an ever-deeper backlog, so once copies land and the
+        # crowd spreads, the holder's leftover sleepers all wake within
+        # ~3.6s and recovery is observable well before the judged
+        # windows.
+        service_congestion_cap=12,
+        instance_kwargs={
+            "slo_spec": _AS_SPEC,
+            # Burn judged over a 10s window so the signal decays once
+            # the spread absorbs the crowd (the default 60s window
+            # would pin burn high for the whole scenario).
+            "slo_window_ms": 10_000,
+            # The sim's service model charges per DISPATCH regardless of
+            # batch occupancy, so the PR-13 batching queue would absorb
+            # a same-model crowd for free and no congestion could ever
+            # build. Pinning the batch off models a runtime already at
+            # its batch-capacity ceiling — the regime where COPY COUNT
+            # is the only remaining lever, i.e. the autoscaler's job.
+            "batch_max": 1,
+        },
+        events=events,
+        extra_checks=checks,
+    )
+
+
 ALL = (
     fanout_budget_under_first_load_failure,
     promote_publish_suppression,
@@ -1019,6 +1196,7 @@ ALL = (
     late_eviction_deregister_quiesce,
     slo_under_flash_crowd,
     overload_shed_protects_slo,
+    flash_crowd_autoscaled,
 )
 
 

@@ -219,41 +219,57 @@ class WeightTransferManager:
 
         return ready
 
+    def _fetch_replies(self, endpoint: str, sender_iid: str, model_id: str,
+                       fp: str):
+        """Validated chunk-indexed fetch sequence: yields FetchReply
+        0..N-1 from the sender, raising ``TransferUnavailable`` on
+        NOT_AVAILABLE, truncation, fingerprint mismatch, or a sender
+        restart mid-stream. ONE implementation of the receive-side
+        protocol validation, shared by the load path (``_stream_from``)
+        and the pre-warm path — a new integrity check added here covers
+        both."""
+        fetch = self.instance.peer_fetch_transport
+        first = fetch(endpoint, model_id, 0, fp)
+        if not first.ok:
+            raise TransferUnavailable(sender_iid)
+        yield first
+        total = first.total_chunks
+        for i in range(1, total):
+            r = fetch(endpoint, model_id, i, fp)
+            if not r.ok:
+                raise TransferUnavailable(
+                    f"{sender_iid} lost the snapshot at chunk {i}/{total}"
+                )
+            if r.fingerprint != first.fingerprint or (
+                r.total_chunks != total
+            ):
+                raise TransferUnavailable(
+                    f"{sender_iid} restarted the snapshot mid-stream"
+                )
+            yield r
+
     def _stream_from(
         self, endpoint: str, sender_iid: str, ce: "CacheEntry", fp: str,
         partial_cb,
     ) -> tuple[LoadedModel, str]:
         inst = self.instance
         model_id, info = ce.model_id, ce.info
-        fetch = inst.peer_fetch_transport
         # The whole chunked transfer is one "peer-stream" span in the
         # load's trace (stage histogram: mm_stage_peer_stream_ms); chunk
         # and byte counts land as attrs when the stream finishes.
         with inst.tracer.span(
             "peer-stream", model=model_id, sender=sender_iid,
         ) as sp:
-            first = fetch(endpoint, model_id, 0, fp)
-            if not first.ok:
-                raise TransferUnavailable(sender_iid)
+            replies = self._fetch_replies(endpoint, sender_iid, model_id, fp)
+            first = next(replies)
             total = first.total_chunks
-            rx = {"bytes": len(first.payload)}
+            rx = {"bytes": 0}
             t0 = _time.perf_counter()  #: wall-clock: perf_counter transfer-throughput metric
 
             def chunks():
+                rx["bytes"] += len(first.payload)
                 yield first.to_chunk()
-                for i in range(1, total):
-                    r = fetch(endpoint, model_id, i, fp)
-                    if not r.ok:
-                        raise TransferUnavailable(
-                            f"{sender_iid} lost the snapshot at chunk "
-                            f"{i}/{total}"
-                        )
-                    if r.fingerprint != first.fingerprint or (
-                        r.total_chunks != total
-                    ):
-                        raise TransferUnavailable(
-                            f"{sender_iid} restarted the snapshot mid-stream"
-                        )
+                for r in replies:
                     rx["bytes"] += len(r.payload)
                     yield r.to_chunk()
 
@@ -379,6 +395,82 @@ class WeightTransferManager:
         else:
             bound = MAX_PENDING_WAIT_S
         return min(bound, inst.load_timeout_s)
+
+    # ------------------------------------------------------------------ #
+    # predictive pre-warm (autoscale/)                                   #
+    # ------------------------------------------------------------------ #
+
+    def prewarm_host(self, model_id: str) -> bool:
+        """Stage a host-tier snapshot WITHOUT materializing a device
+        copy: fetch the full chunk stream from a live holder over the
+        same FetchWeights channel a scale-up uses and park it in the
+        host tier, so a later demand ramp on this instance is a ~ms
+        re-warm instead of a cold store load. Strictly best-effort and
+        strictly peer-sourced — a model with no live holder is not
+        pre-warmed (paying a store load speculatively would compete
+        with real loads for store egress). The snapshot is inserted
+        with ``put_if_room``: speculative bytes never evict demoted
+        (certain) ones."""
+        inst = self.instance
+        loader = inst.loader
+        if (
+            not loader.supports_weight_streaming
+            or not self.host_tier.enabled
+            or not self.cfg.peer_fetch
+            or inst.peer_fetch_transport is None
+        ):
+            return False
+        if self.host_tier.peek(model_id) is not None:
+            return True
+        mr = inst.registry_view.get(model_id)
+        if mr is None:
+            return False
+        info = ModelInfo(
+            model_type=mr.model_type,
+            model_path=mr.model_path,
+            model_key=mr.model_key,
+        )
+        fp = model_fingerprint(info)
+        sender = self._ready_sender(model_id, fp, set())
+        if sender is None:
+            return False
+        iid, endpoint = sender
+        try:
+            replies = self._fetch_replies(endpoint, iid, model_id, fp)
+            first = next(replies)
+            # The manifest rides every reply: bail after ONE chunk when
+            # the snapshot can never fit the FREE host budget right now
+            # (put_if_room below would refuse it anyway) — without this
+            # a full host tier would re-download the whole stream from
+            # a serving peer on every controller tick.
+            free = self.host_tier.capacity_bytes - self.host_tier.used_bytes
+            if first.total_bytes > free:
+                return False
+            chunks = [first.to_chunk()] + [r.to_chunk() for r in replies]
+        except Exception as e:  # noqa: BLE001 — sender death, truncation,
+            # restart, NOT_AVAILABLE: a pre-warm never falls back to the
+            # store, it just doesn't happen this tick
+            log.debug(
+                "pre-warm fetch of %s from %s failed: %s", model_id, iid, e,
+            )
+            return False
+        snap = TransferSnapshot.build(
+            model_id, info, chunks,
+            total_bytes=max(
+                first.total_bytes, sum(len(c.payload) for c in chunks), 1
+            ),
+        )
+        if not self.host_tier.put_if_room(model_id, snap, snap.total_bytes):
+            return False
+        # Bytes are accounted (they crossed the transfer channel) but no
+        # load-source counter moves: nothing was loaded — that happens
+        # at re-warm time, on the LOAD_FROM_HOST_TIER path.
+        self.metrics.inc(
+            MX.TRANSFER_RX_BYTES,
+            sum(len(c.payload) for c in chunks), model_id=model_id,
+        )
+        self._refresh_host_gauges()
+        return True
 
     # ------------------------------------------------------------------ #
     # sender side                                                        #

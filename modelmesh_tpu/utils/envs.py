@@ -199,6 +199,35 @@ REGISTRY: dict[str, EnvVar] = {
                "shed: absorbs bursts without letting sustained overload "
                "build a real queue; 0 sheds immediately",
                "serving/admission.py"),
+        EnvVar("MM_AUTOSCALE", "str", "legacy",
+               "the ONE copy-scaling authority: legacy (default — the "
+               "10s rate-task scale-up + janitor cluster-full "
+               "scale-down, behaviorally identical to before the "
+               "autoscale/ subsystem), burn (the SLO-burn-rate "
+               "controller: pre-breach copy adds over the fast weight "
+               "paths, demote-to-host scale-down, predictive host-tier "
+               "pre-warming; the legacy scalers are suppressed), or "
+               "off (no scaling at all)", "serving/tasks.py"),
+        EnvVar("MM_AUTOSCALE_BURN_UP", "float", "0.5",
+               "class burn rate at/above which the controller scales "
+               "its models up (1.0 = burning exactly at error budget; "
+               "below 1 means the controller acts BEFORE breach)",
+               "autoscale/controller.py"),
+        EnvVar("MM_AUTOSCALE_BURN_DOWN", "float", "0.25",
+               "class burn rate below which a class counts as calm; "
+               "surplus copies demote to the host tier only after "
+               "idle_ticks_down consecutive calm ticks",
+               "autoscale/controller.py"),
+        EnvVar("MM_AUTOSCALE_HOLDDOWN_MS", "int", "5000",
+               "per-model hold after an autoscale copy add: no further "
+               "add until the previous one landed (copy count moved) "
+               "or this window expired", "autoscale/controller.py"),
+        EnvVar("MM_AUTOSCALE_PREWARM", "bool", "1",
+               "predictive pre-warming in burn mode: the leader "
+               "publishes a forecast-driven pre-warm plan and targets "
+               "stage host-tier snapshots streamed from live holders "
+               "so demand ramps re-warm in ~ms instead of paying cold "
+               "store loads", "autoscale/controller.py"),
         EnvVar("MM_LOCK_DEBUG", "bool", "0",
                "instrumented Lock/Condition wrappers: record per-thread "
                "acquisition stacks and assert lock-acquisition order "

@@ -1,0 +1,669 @@
+"""Autoscale subsystem units: controller decisions, forecaster math,
+scaling-authority gating, and the rate-tracker leak regression.
+
+Everything runs under an installed VirtualClock with ticks driven
+DIRECTLY (the test_sim_cluster.py pattern): leadership is assigned
+explicitly, burn is injected through the instance's real SloTracker,
+and every decision is asserted against the controller's bounded
+decision log + the flight recorder.
+"""
+
+import time as _wall
+
+import pytest
+
+from modelmesh_tpu.autoscale.controller import (
+    AutoscaleConfig,
+    AutoscaleController,
+    prewarm_plan_key,
+)
+from modelmesh_tpu.autoscale.forecast import DemandForecaster
+from modelmesh_tpu.cache.lru import HostTier
+from modelmesh_tpu.serving.entry import EntryState
+from modelmesh_tpu.serving.tasks import BackgroundTasks, TaskConfig
+from modelmesh_tpu.sim.harness import SimCluster
+from modelmesh_tpu.utils import clock as clock_mod
+from modelmesh_tpu.utils.clock import VIRTUAL_EPOCH_MS, VirtualClock
+
+# Class "hot" with a tight latency bound: one slow completion burns far
+# past 1x budget, so injected breach samples pressure deterministically.
+SPEC = "hot:p99<100ms;default:p99<10000ms"
+
+
+@pytest.fixture()
+def sim():
+    clock = VirtualClock()
+    prev = clock_mod.install(clock)
+    cluster = SimCluster(
+        n=3, start_tasks=False, load_delay_ms=0.0,
+        instance_kwargs={"slo_spec": SPEC, "slo_window_ms": 10_000},
+    )
+    for pod in cluster.pods:
+        pod.instance._election.close()
+    try:
+        yield cluster, clock
+    finally:
+        cluster.close()
+        clock_mod.install(prev)
+        clock.close()
+
+
+def _wait_real(pred, timeout=5.0, step=0.01):
+    deadline = _wall.monotonic() + timeout
+    while not pred():
+        if _wall.monotonic() > deadline:
+            return False
+        _wall.sleep(step)
+    return True
+
+
+def _load_copy(cluster, pod, model_id, exclude=None):
+    pod.instance.ensure_loaded(model_id, sync=False, exclude=exclude)
+    assert _wait_real(
+        lambda: (
+            (ce := pod.instance.cache.get_quietly(model_id)) is not None
+            and ce.state is EntryState.ACTIVE
+        )
+    ), f"{model_id} did not activate on {pod.iid}"
+
+
+def _load_here(pod, model_id):
+    """Force a copy onto EXACTLY this pod — the LOAD_LOCAL_ONLY hop a
+    placement forward uses (the public ensure path deliberately refuses
+    'place on me, excluding everyone else': the serve-hit forwards to a
+    holder whose miss loop excludes the visited origin)."""
+    from modelmesh_tpu.serving.instance import RoutingContext
+
+    pod.instance.invoke_model(
+        model_id, None, b"", [],
+        RoutingContext(hop=RoutingContext.LOAD_LOCAL_ONLY), sync=True,
+    )
+    ce = pod.instance.cache.get_quietly(model_id)
+    assert ce is not None and ce.state is EntryState.ACTIVE
+
+
+def _cfg(**kw):
+    kw.setdefault("prewarm", False)
+    kw.setdefault("min_burn_samples", 3)
+    return AutoscaleConfig(**kw)
+
+
+def _burn(inst, n=6, latency_ms=5_000.0):
+    """Inject n breaching hot-class completions into the SLO window."""
+    for _ in range(n):
+        inst.slo.record("hot", latency_ms, True)
+
+
+def _calm(inst, n=6):
+    for _ in range(n):
+        inst.slo.record("hot", 10.0, True)
+
+
+# ---------------------------------------------------------------------- #
+# forecaster                                                             #
+# ---------------------------------------------------------------------- #
+
+
+class TestForecaster:
+    def test_ramp_is_trending_and_projected(self):
+        clock = VirtualClock()
+        prev = clock_mod.install(clock)
+        try:
+            f = DemandForecaster(fast_tau_s=60.0, slow_tau_s=600.0)
+            now = clock.now_ms()
+            # Flat baseline: never trending.
+            for k in range(10):
+                f.observe("m", 10.0, now_ms=now + k * 10_000)
+            assert f.trending(min_rate=1.0, now_ms=now + 100_000) == []
+            # Ramp: rate jumps 10 -> 200 over a few samples.
+            for k in range(6):
+                f.observe("m", 200.0, now_ms=now + 100_000 + k * 20_000)
+            t = now + 220_000
+            assert f.trending(min_rate=1.0, ratio=1.5, now_ms=t) == ["m"]
+            # Holt projection extrapolates the ramp past the current
+            # fast estimate.
+            assert f.forecast("m", 60.0, now_ms=t) > f.rate("m")
+        finally:
+            clock_mod.install(prev)
+            clock.close()
+
+    def test_diurnal_phase_floors_the_forecast(self):
+        clock = VirtualClock()
+        prev = clock_mod.install(clock)
+        try:
+            f = DemandForecaster(fast_tau_s=60.0, slow_tau_s=600.0)
+            base = clock.now_ms()
+            spike_hour = DemandForecaster._hour(base + 3_600_000)
+            # Two "days" of the same shape: quiet except one hot hour.
+            for day in range(2):
+                day_ms = base + day * 24 * 3_600_000
+                for h in range(24):
+                    t = day_ms + h * 3_600_000
+                    rate = (
+                        500.0
+                        if DemandForecaster._hour(t) == spike_hour else 1.0
+                    )
+                    f.observe("d", rate, now_ms=t)
+            # Now (quiet phase), EWMAs have settled low — but the
+            # forecast one hour ahead lands in the spike phase and must
+            # carry the learned diurnal floor.
+            t = base + 2 * 24 * 3_600_000
+            assert f.forecast("d", 10.0, now_ms=t) < 100.0
+            assert f.forecast("d", 3_600.0, now_ms=t) >= 400.0
+        finally:
+            clock_mod.install(prev)
+            clock.close()
+
+    def test_trending_orders_hottest_first_and_is_deterministic(self):
+        clock = VirtualClock()
+        prev = clock_mod.install(clock)
+        try:
+            f = DemandForecaster(fast_tau_s=60.0, slow_tau_s=600.0)
+            now = clock.now_ms()
+            for mid, rate in (("a", 50.0), ("b", 500.0)):
+                f.observe(mid, 0.0, now_ms=now)
+                f.observe(mid, rate, now_ms=now + 30_000)
+            assert f.trending(now_ms=now + 30_000) == ["b", "a"]
+        finally:
+            clock_mod.install(prev)
+            clock.close()
+
+
+# ---------------------------------------------------------------------- #
+# reactive scale-up                                                      #
+# ---------------------------------------------------------------------- #
+
+
+class TestScaleUp:
+    def test_burning_class_gets_copies_before_breach_clears(self, sim):
+        cluster, clock = sim
+        pod = cluster.pods[0]
+        inst = pod.instance
+        inst.is_leader = True
+        cluster.register("m-up", "hot")
+        _load_copy(cluster, pod, "m-up")
+        inst.registry_view.wait_for(
+            lambda v: (r := v.get("m-up")) is not None and r.instance_ids
+        )
+        ctrl = AutoscaleController(inst, _cfg())
+        _burn(inst)
+        ctrl.tick()
+        assert _wait_real(
+            lambda: len(inst.registry.get("m-up").instance_ids) >= 2
+        ), f"no copy added: {inst.registry.get('m-up').instance_ids}"
+        ups = [d for d in ctrl.decisions if d["kind"] == "autoscale-up"]
+        assert ups and ups[0]["model"] == "m-up"
+        assert ups[0]["slo_class"] == "hot"
+        assert ups[0]["burn"] >= 1.0
+        # ... and the decision is in the flight recorder.
+        assert any(
+            e["kind"] == "autoscale-up" for e in inst.flightrec.dump()
+        )
+
+    def test_flash_burn_doubles_capped_at_the_fleet(self, sim):
+        """Past burn_flash the step is copies*2, bounded by the live
+        fleet: on this 3-pod cluster 2 copies double to 4 but cap at 3,
+        so exactly one add is issued and every pod ends with a copy."""
+        cluster, clock = sim
+        pod = cluster.pods[0]
+        inst = pod.instance
+        inst.is_leader = True
+        cluster.register("m-dub", "hot")
+        _load_copy(cluster, pod, "m-dub")
+        _load_here(cluster.pods[1], "m-dub")
+        assert _wait_real(
+            lambda: len(inst.registry.get("m-dub").instance_ids) == 2
+        )
+        inst.registry_view.wait_for(
+            lambda v: (r := v.get("m-dub")) is not None
+            and len(r.instance_ids) == 2
+        )
+        ctrl = AutoscaleController(inst, _cfg())
+        _burn(inst)  # burn >> burn_flash
+        ctrl.tick()
+        ups = [d for d in ctrl.decisions if d["kind"] == "autoscale-up"]
+        assert ups and ups[0]["copies"] == 2 and ups[0]["adds"] == 1, ups
+        assert _wait_real(
+            lambda: len(inst.registry.get("m-dub").instance_ids) == 3
+        )
+
+    def test_calm_class_never_scales(self, sim):
+        cluster, clock = sim
+        pod = cluster.pods[0]
+        inst = pod.instance
+        inst.is_leader = True
+        cluster.register("m-calm", "hot")
+        _load_copy(cluster, pod, "m-calm")
+        ctrl = AutoscaleController(inst, _cfg())
+        _calm(inst)
+        ctrl.tick()
+        assert ctrl.decisions == []
+        assert len(inst.registry.get("m-calm").instance_ids) == 1
+
+    def test_non_leader_never_scales_up(self, sim):
+        cluster, clock = sim
+        pod = cluster.pods[0]
+        inst = pod.instance
+        inst.is_leader = False
+        cluster.register("m-nl", "hot")
+        _load_copy(cluster, pod, "m-nl")
+        ctrl = AutoscaleController(inst, _cfg())
+        _burn(inst)
+        ctrl.tick()
+        assert not any(
+            d["kind"] == "autoscale-up" for d in ctrl.decisions
+        )
+        assert len(inst.registry.get("m-nl").instance_ids) == 1
+
+    def test_holddown_suppresses_readds_until_landed_or_expired(self, sim):
+        cluster, clock = sim
+        pod = cluster.pods[0]
+        inst = pod.instance
+        inst.is_leader = True
+        cluster.register("m-hold", "hot")
+        _load_copy(cluster, pod, "m-hold")
+        inst.registry_view.wait_for(
+            lambda v: (r := v.get("m-hold")) is not None and r.instance_ids
+        )
+        calls = []
+        real_ensure = inst.ensure_loaded
+        inst.ensure_loaded = lambda *a, **k: calls.append((a, k))  # no-op
+        try:
+            ctrl = AutoscaleController(
+                inst, _cfg(holddown_ms=60_000)
+            )
+            _burn(inst)
+            ctrl.tick()
+            assert len(calls) == 1
+            # Copies unchanged (the spy placed nothing) and the hold is
+            # armed: the next tick must not re-add.
+            _burn(inst)
+            ctrl.tick()
+            assert len(calls) == 1
+            # Hold expiry re-arms the add.
+            clock.advance(61_000)
+            _burn(inst)
+            ctrl.tick()
+            assert len(calls) == 2
+        finally:
+            inst.ensure_loaded = real_ensure
+
+    def test_copy_cap_bounds_the_add(self, sim):
+        cluster, clock = sim
+        pod = cluster.pods[0]
+        inst = pod.instance
+        inst.is_leader = True
+        cluster.register("m-cap", "hot")
+        _load_copy(cluster, pod, "m-cap")
+        ctrl = AutoscaleController(inst, _cfg(max_copies=1))
+        _burn(inst)
+        ctrl.tick()
+        assert ctrl.decisions == []
+        assert len(inst.registry.get("m-cap").instance_ids) == 1
+
+
+# ---------------------------------------------------------------------- #
+# reversible scale-down                                                  #
+# ---------------------------------------------------------------------- #
+
+
+def _two_copies(cluster, model_id):
+    cluster.register(model_id, "hot")
+    _load_copy(cluster, cluster.pods[0], model_id)
+    inst0 = cluster.pods[0].instance
+    _load_here(cluster.pods[1], model_id)
+    assert _wait_real(
+        lambda: len(inst0.registry.get(model_id).instance_ids) == 2
+    )
+    mr = inst0.registry.get(model_id)
+    shedder_id = max(
+        mr.instance_ids.items(), key=lambda kv: (kv[1], kv[0])
+    )[0]
+    shedder = cluster.by_id(shedder_id)
+    shedder.instance.registry_view.wait_for(
+        lambda v: (r := v.get(model_id)) is not None
+        and len(r.instance_ids) == 2
+    )
+    return shedder
+
+
+class TestScaleDown:
+    def test_surplus_copy_demotes_to_host_tier_and_rewarms(self, sim):
+        cluster, clock = sim
+        shedder = _two_copies(cluster, "m-down")
+        inst = shedder.instance
+        ctrl = AutoscaleController(
+            inst, _cfg(surplus_min_age_ms=0, idle_ticks_down=1)
+        )
+        ctrl.tick()
+        downs = [d for d in ctrl.decisions if d["kind"] == "autoscale-down"]
+        assert downs and downs[0]["model"] == "m-down"
+        # Device copy gone, host snapshot + claim present: the 9ms
+        # reversal path is armed.
+        assert inst.cache.get_quietly("m-down") is None
+        assert inst.host_tier.peek("m-down") is not None
+        mr = inst.registry.get("m-down")
+        assert inst.instance_id not in mr.instance_ids
+        assert inst.instance_id in mr.host_instances
+        # Reversal: a re-demand forced back onto the shedder re-warms
+        # from the host tier — no store load.
+        store_loads = shedder.loader.load_count
+        streams = shedder.loader.stream_load_count
+        _load_here(shedder, "m-down")
+        assert shedder.loader.stream_load_count == streams + 1
+        assert shedder.loader.load_count == store_loads
+
+    def test_min_age_antithrash_blocks_the_shed(self, sim):
+        cluster, clock = sim
+        shedder = _two_copies(cluster, "m-young")
+        ctrl = AutoscaleController(
+            shedder.instance, _cfg(surplus_min_age_ms=10**9, idle_ticks_down=1)
+        )
+        ctrl.tick()
+        assert ctrl.decisions == []
+        assert shedder.instance.cache.get_quietly("m-young") is not None
+
+    def test_burning_class_blocks_the_shed(self, sim):
+        cluster, clock = sim
+        shedder = _two_copies(cluster, "m-press")
+        inst = shedder.instance
+        ctrl = AutoscaleController(
+            inst, _cfg(surplus_min_age_ms=0, idle_ticks_down=1)
+        )
+        _burn(inst)
+        ctrl.tick()
+        assert not any(
+            d["kind"] == "autoscale-down" for d in ctrl.decisions
+        )
+        assert inst.cache.get_quietly("m-press") is not None
+
+    def test_capacity_valve_sheds_without_calm(self, sim, monkeypatch):
+        """The legacy janitor's cluster-full pressure valve survives in
+        burn mode: a nearly-full candidate pool demotes surplus copies
+        even while the class is still burning (never calm) — demotion
+        is cheap and reversible, and without the valve a busy class
+        would pin the cluster full."""
+        from modelmesh_tpu.serving import tasks as tasks_mod
+
+        cluster, clock = sim
+        shedder = _two_copies(cluster, "m-full")
+        inst = shedder.instance
+        ctrl = AutoscaleController(
+            inst, _cfg(surplus_min_age_ms=0, idle_ticks_down=10**6)
+        )
+        _burn(inst)  # class pressured: the calm path can never fire
+        monkeypatch.setattr(
+            tasks_mod, "cluster_fullness", lambda i, t=None: 1.0
+        )
+        ctrl.tick()
+        downs = [d for d in ctrl.decisions if d["kind"] == "autoscale-down"]
+        assert downs and downs[0]["reason"] == "full", ctrl.decisions
+        assert inst.cache.get_quietly("m-full") is None
+        assert inst.host_tier.peek("m-full") is not None
+
+    def test_in_flight_add_blocks_the_shed(self, sim):
+        """A model with a loading claim in flight (most likely the
+        leader's own scale-up materializing) is never demoted — the
+        add/demote churn loop where every cycle pays a transfer."""
+        cluster, clock = sim
+        shedder = _two_copies(cluster, "m-adding")
+        inst = shedder.instance
+
+        def claim(cur):
+            cur.claim_loading("sim-elsewhere")
+            return cur
+
+        inst.registry.update_or_create("m-adding", claim)
+        inst.registry_view.wait_for(
+            lambda v: (r := v.get("m-adding")) is not None
+            and r.loading_instances
+        )
+        ctrl = AutoscaleController(
+            inst, _cfg(surplus_min_age_ms=0, idle_ticks_down=1)
+        )
+        ctrl.tick()
+        assert not any(
+            d["kind"] == "autoscale-down" for d in ctrl.decisions
+        )
+        assert inst.cache.get_quietly("m-adding") is not None
+
+    def test_sole_ready_copy_is_never_shed(self, sim):
+        cluster, clock = sim
+        pod = cluster.pods[0]
+        cluster.register("m-sole", "hot")
+        _load_copy(cluster, pod, "m-sole")
+        ctrl = AutoscaleController(
+            pod.instance, _cfg(surplus_min_age_ms=0, idle_ticks_down=1)
+        )
+        ctrl.tick()
+        assert pod.instance.cache.get_quietly("m-sole") is not None
+
+
+# ---------------------------------------------------------------------- #
+# predictive pre-warming                                                 #
+# ---------------------------------------------------------------------- #
+
+
+class TestPrewarm:
+    def test_leader_plan_prewarm_targets_stage_host_snapshots(self, sim):
+        cluster, clock = sim
+        leader = cluster.pods[0]
+        inst = leader.instance
+        inst.is_leader = True
+        cluster.register("m-pre", "hot")
+        _load_copy(cluster, leader, "m-pre")
+        for p in cluster.pods:
+            p.instance.registry_view.wait_for(
+                lambda v: (r := v.get("m-pre")) is not None
+                and r.instance_ids
+            )
+        cfg = AutoscaleConfig(
+            prewarm=True, prewarm_min_rate=1.0, prewarm_ratio=1.2,
+            min_burn_samples=3,
+        )
+        ctrl = AutoscaleController(inst, cfg)
+        # Baseline tick (rate 0 — untracked), then a demand ramp: the
+        # first positive-rate tick seeds the zero baseline, the next
+        # observes the rate against it and trends.
+        ctrl.tick()
+        inst._model_rate("m-pre").record(500)
+        clock.advance(2_000)
+        ctrl.tick()
+        clock.advance(2_000)
+        ctrl.tick()
+        kv = inst.store.get(prewarm_plan_key(inst.config.kv_prefix))
+        assert kv is not None
+        import json
+
+        plan = json.loads(kv.value.decode())
+        assert "m-pre" in plan and plan["m-pre"], plan
+        assert any(
+            d["kind"] == "autoscale-prewarm-plan" for d in ctrl.decisions
+        )
+        # A target pod's tick stages the snapshot (streamed from the
+        # live holder, never the store) and advertises the host claim.
+        target = cluster.by_id(plan["m-pre"][0])
+        t_inst = target.instance
+        t_ctrl = AutoscaleController(t_inst, cfg)
+        store_loads = target.loader.load_count
+        t_ctrl.tick()
+        # The fetch runs on the cleanup pool (never the tick thread).
+        assert _wait_real(
+            lambda: t_inst.host_tier.peek("m-pre") is not None
+        ), "pre-warm fetch never staged the snapshot"
+        assert target.loader.load_count == store_loads
+        assert _wait_real(lambda: any(
+            d["kind"] == "autoscale-prewarmed" for d in t_ctrl.decisions
+        ))
+        assert _wait_real(
+            lambda: t_inst.instance_id
+            in inst.registry.get("m-pre").host_instances
+        )
+        # The ramp arriving at the target is now a host re-warm.
+        streams = target.loader.stream_load_count
+        _load_here(target, "m-pre")
+        assert target.loader.stream_load_count == streams + 1
+        assert target.loader.load_count == store_loads
+
+    def test_uncovered_model_without_holder_is_not_planned(self, sim):
+        cluster, clock = sim
+        inst = cluster.pods[0].instance
+        inst.is_leader = True
+        cluster.register("m-cold", "hot")  # registered, never loaded
+        cfg = AutoscaleConfig(
+            prewarm=True, prewarm_min_rate=0.5, prewarm_ratio=1.1,
+        )
+        ctrl = AutoscaleController(inst, cfg)
+        ctrl.tick()
+        inst._model_rate("m-cold").record(500)
+        clock.advance(2_000)
+        ctrl.tick()
+        kv = inst.store.get(prewarm_plan_key(inst.config.kv_prefix))
+        plan = {} if kv is None else __import__("json").loads(
+            kv.value.decode()
+        )
+        assert "m-cold" not in plan
+
+
+class TestHostTierPutIfRoom:
+    def test_speculative_insert_never_evicts(self):
+        tier = HostTier(100)
+        assert tier.put("certain-a", "A", 60)
+        assert tier.put("certain-b", "B", 30)
+        # No room for 20 speculative bytes: refused, nothing evicted.
+        assert not tier.put_if_room("spec", "S", 20)
+        assert tier.peek("certain-a") == "A"
+        assert tier.peek("certain-b") == "B"
+        # Fits the free budget: accepted.
+        assert tier.put_if_room("spec", "S", 10)
+        assert tier.peek("spec") == "S"
+        assert tier.used_bytes == 100
+        # Same-key replacement reclaims its own bytes first.
+        assert tier.put_if_room("spec", "S2", 10)
+        assert tier.peek("spec") == "S2"
+        # A regular (demotion) put still evicts LRU as before.
+        assert tier.put("certain-c", "C", 50)
+        assert tier.used_bytes <= 100
+
+
+# ---------------------------------------------------------------------- #
+# scaling-authority gating (MM_AUTOSCALE)                                #
+# ---------------------------------------------------------------------- #
+
+
+class TestAuthorityGating:
+    def _tasks(self, cluster, mode):
+        return BackgroundTasks(
+            cluster.pods[0].instance,
+            TaskConfig(autoscale_mode=mode),
+        )
+
+    def test_default_mode_is_legacy_with_no_controller(self, sim):
+        cluster, clock = sim
+        tasks = BackgroundTasks(cluster.pods[0].instance)
+        assert tasks.config.autoscale_mode == "legacy"
+        assert tasks.autoscaler is None
+
+    def test_exactly_one_scaling_task_per_mode(self, sim):
+        cluster, clock = sim
+        for mode, expect in (
+            ("legacy", {"publisher", "rate", "janitor", "reaper"}),
+            ("burn", {"publisher", "autoscale", "janitor", "reaper"}),
+            ("off", {"publisher", "janitor", "reaper"}),
+        ):
+            tasks = self._tasks(cluster, mode)
+            try:
+                tasks.start()
+                names = {
+                    t.name.split("-")[1] for t in tasks._threads
+                }
+                assert names == expect, (mode, names)
+                assert (tasks.autoscaler is not None) == (mode == "burn")
+            finally:
+                tasks.stop()
+
+    def test_janitor_scale_down_only_under_legacy(self, sim):
+        cluster, clock = sim
+        for mode, expect_calls in (("legacy", 1), ("burn", 0), ("off", 0)):
+            tasks = self._tasks(cluster, mode)
+            calls = []
+            tasks._maybe_scale_down = lambda: calls.append(1)
+            tasks._janitor_tick()
+            assert len(calls) == expect_calls, mode
+
+    def test_junk_mode_raises(self):
+        with pytest.raises(ValueError):
+            TaskConfig(autoscale_mode="junk")
+
+
+# ---------------------------------------------------------------------- #
+# rate-tracker residual-state regression (delete -> re-register)         #
+# ---------------------------------------------------------------------- #
+
+
+class TestRateLeakRegression:
+    MIN_AGE = 5_000
+
+    def _tasks(self, pod):
+        return BackgroundTasks(
+            pod.instance,
+            TaskConfig(
+                autoscale_mode="legacy",
+                second_copy_min_age_ms=self.MIN_AGE,
+                second_copy_max_age_ms=10**9,
+            ),
+        )
+
+    def test_reregistered_model_does_not_inherit_prev_use(self, sim):
+        """A model deleted AND re-registered between two rate ticks must
+        not fabricate a 'used again' age from the dead incarnation's
+        timestamp (the serving/tasks.py:184 leak): the fresh entry has
+        no previous use, so no 1->2 scale-up fires."""
+        cluster, clock = sim
+        pod = cluster.pods[0]
+        inst = pod.instance
+        tasks = self._tasks(pod)
+        cluster.register("m-flap", "hot")
+        _load_copy(cluster, pod, "m-flap")
+        inst.invoke_model("m-flap", "/sim/Predict", b"x", [])
+        tasks._rate_tick()  # records prev_use against incarnation #1
+        assert "m-flap" in tasks._prev_use
+        # Delete + re-register + reload within the tick interval —
+        # by the next tick the id is back in the cache, so key pruning
+        # alone cannot see the swap.
+        assert inst.unregister_model("m-flap")
+        assert _wait_real(
+            lambda: inst.cache.get_quietly("m-flap") is None
+        ), "deletion cleanup did not drop the local copy"
+        cluster.register("m-flap", "hot")
+        _load_copy(cluster, pod, "m-flap")
+        # Advance into the would-be 'used again' window measured against
+        # the STALE timestamp, then use the fresh incarnation once.
+        clock.advance(self.MIN_AGE + 1_000)
+        inst.invoke_model("m-flap", "/sim/Predict", b"x", [])
+        adds = []
+        tasks._add_copy = lambda mid, mr: adds.append(mid)
+        tasks._rate_tick()
+        assert adds == [], (
+            "spurious 1->2 scale-up from residual rate state after "
+            "delete -> re-register"
+        )
+
+    def test_same_incarnation_used_again_still_scales(self, sim):
+        """Non-vacuity twin: the SAME flow without the delete/re-register
+        does fire the 1->2 pattern — proving the regression test would
+        catch the fix being reverted rather than passing vacuously."""
+        cluster, clock = sim
+        pod = cluster.pods[0]
+        inst = pod.instance
+        tasks = self._tasks(pod)
+        cluster.register("m-keep", "hot")
+        _load_copy(cluster, pod, "m-keep")
+        inst.invoke_model("m-keep", "/sim/Predict", b"x", [])
+        tasks._rate_tick()
+        clock.advance(self.MIN_AGE + 1_000)
+        inst.invoke_model("m-keep", "/sim/Predict", b"x", [])
+        adds = []
+        tasks._add_copy = lambda mid, mr: adds.append(mid)
+        tasks._rate_tick()
+        assert adds == ["m-keep"]

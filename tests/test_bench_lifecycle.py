@@ -22,7 +22,7 @@ class TestBenchLifecycleSmoke:
         out = bench_lifecycle.run(
             load_ms=20.0, size_ms=20.0, n_copies=3, fleet=4,
             mass_models=40, reps=1, crowd_copies=4, crowd_fleet=5,
-            drain_models=8, drain_fleet=3,
+            drain_models=8, drain_fleet=3, autoscale_cap_s=5.0,
         )
 
         fs = out["first_serve"]
@@ -89,3 +89,35 @@ class TestBenchLifecycleSmoke:
         assert dr["store_fallback"]["migrated"] == 8
         assert dr["store_fallback"]["failed_requests"] == 0
         assert dr["store_fallback"]["probe_requests"] > 0
+
+        # Autoscale: structural contract only here (the retried floor
+        # test below carries the behavioral assertions).
+        asr = out["autoscale"]
+        assert asr["controller_off"]["recovered"] is False
+        assert asr["controller_off"]["copies_at_end"] == 1
+        assert asr["recovery_speedup_floor"] > 0
+
+    def test_autoscale_recovery_floor(self):
+        """Tier-1 smoke floor (retried, the PR-11/13 convention — the
+        shortest timings inflate most under full-suite load): the
+        controller-ON flash recovery must (a) really be driven by the
+        controller's own demote-to-host scale-down, (b) absorb the ramp
+        off the host re-warm path — re-warm loads strictly greater than
+        cold store loads, which must be ZERO — and (c) recover inside
+        the cap that censors the OFF twin."""
+        last = None
+        for attempt in range(3):
+            on = bench_lifecycle._measure_autoscale_recovery(
+                "burn", 3, 20.0, 1, cap_s=6.0
+            )
+            last = on
+            if (
+                on["recovered"]
+                and on["controller_demotes"] >= 2
+                and on["rewarm_loads"] > on["cold_store_loads"]
+                and on["cold_store_loads"] == 0
+            ):
+                return
+        raise AssertionError(
+            f"autoscale recovery floor not met after 3 attempts: {last}"
+        )

@@ -1,0 +1,110 @@
+"""Direct RateTracker units under VirtualClock.
+
+The ring-buffer decay/window semantics were previously exercised only
+indirectly through the rate task; these pin the threshold math the
+scale-up paths share (the legacy rate tick's ``rpm >= scale_up_rpm``
+and the autoscale controller's ``rpm >= scale_up_rpm * 2 // 3``
+comparisons both read ``RateTracker.rpm``) at exact virtual instants.
+"""
+
+import pytest
+
+from modelmesh_tpu.serving.rate import BUCKETS, RateTracker
+from modelmesh_tpu.utils import clock as clock_mod
+from modelmesh_tpu.utils.clock import VirtualClock
+
+
+@pytest.fixture()
+def vclock():
+    clock = VirtualClock()
+    prev = clock_mod.install(clock)
+    try:
+        yield clock
+    finally:
+        clock_mod.install(prev)
+        clock.close()
+
+
+class TestExtrapolation:
+    def test_fresh_bucket_extrapolates_with_minimum_fraction(self, vclock):
+        rt = RateTracker()
+        rt.record(10)
+        # Zero elapsed time in the current bucket: the in-progress
+        # fraction floors at one second (1/60 min), so 10 requests read
+        # as 600/min — the burst-sensitive startup behavior.
+        assert rt.rpm(1) == 600
+
+    def test_half_bucket_scales_down_the_extrapolation(self, vclock):
+        rt = RateTracker()
+        rt.record(10)
+        vclock.advance(30_000)
+        assert rt.rpm(1) == 20  # 10 requests / 0.5 min
+
+    def test_window_mixes_full_and_partial_buckets(self, vclock):
+        rt = RateTracker()
+        rt.record(60)
+        vclock.advance(150_000)  # 2 full buckets + half of the third
+        # window=5: total 60 over (5-1) + 0.5 minutes.
+        assert rt.rpm(5) == int(60 / 4.5)
+
+
+class TestDecay:
+    def test_counts_fall_out_of_the_window(self, vclock):
+        rt = RateTracker()
+        rt.record(100)
+        vclock.advance(6 * 60_000)
+        # 6 bucket advances: the recorded bucket is outside the 5-min
+        # window (and the rotated-over buckets were zeroed).
+        assert rt.rpm(5) == 0
+
+    def test_full_ring_wrap_zeroes_everything(self, vclock):
+        rt = RateTracker()
+        rt.record(100)
+        vclock.advance((BUCKETS + 5) * 60_000)
+        assert rt.rpm(BUCKETS - 1) == 0
+
+    def test_rotation_keeps_recent_buckets(self, vclock):
+        rt = RateTracker()
+        rt.record(10)
+        vclock.advance(60_000)
+        rt.record(20)
+        # Both buckets inside window=2: 30 requests over 1 + 1/60 min.
+        assert rt.rpm(2) == int(30 / (1 + 1 / 60))
+
+
+class TestWindowClamp:
+    def test_oversized_window_clamps_to_ring(self, vclock):
+        rt = RateTracker()
+        rt.record(30)
+        assert rt.rpm(100) == rt.rpm(BUCKETS - 1)
+
+    def test_zero_window_clamps_to_one(self, vclock):
+        rt = RateTracker()
+        rt.record(30)
+        assert rt.rpm(0) == rt.rpm(1)
+
+
+class TestThresholdMath:
+    """The comparisons the scaling authorities make, at the boundary."""
+
+    def test_sustained_rate_crosses_the_scale_up_threshold(self, vclock):
+        rt = RateTracker()
+        # 2000/min for 3 full minutes, then judged mid-bucket.
+        for _ in range(3):
+            rt.record(2000)
+            vclock.advance(60_000)
+        rt.record(1000)
+        vclock.advance(30_000)
+        # 7000 over 3 full + 0.5 in-progress minutes = exactly
+        # 2000/min: the `rpm >= scale_up_rpm` comparison fires at
+        # equality.
+        assert rt.rpm(4) == 2000
+        assert rt.rpm(4) >= 2000
+
+    def test_surplus_rate_sits_under_the_shed_threshold(self, vclock):
+        rt = RateTracker()
+        rt.record(1000)
+        vclock.advance(60_000)
+        # 1000 over ~1 min < 2000*2//3: both the janitor and the
+        # autoscale controller read this copy as surplus-eligible.
+        assert rt.rpm(2) < 2000 * 2 // 3

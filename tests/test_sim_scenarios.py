@@ -110,6 +110,60 @@ def test_overload_shed_scenario_replays_bit_for_bit():
     assert first.trace_lines() == second.trace_lines()
 
 
+def test_flash_crowd_without_autoscaler_breaches():
+    """Non-vacuity of the autoscale scenario, one way: the SAME flash
+    crowd with MM_AUTOSCALE=legacy (the pre-controller scaling
+    authority: a 10/s crowd sits far below the 2000-rpm rate-task
+    threshold, so nothing ever scales) must breach the judged hot-class
+    SLO at the post-ramp checkpoints — proving the passing variant's
+    verdict is the burn-driven controller's doing."""
+    result = run_scenario(
+        scenarios.flash_crowd_autoscaled(mode="legacy")
+    )
+    assert not result.ok
+    assert result.verdicts["slo_attained"], (
+        "hot SLO held without the autoscale controller — the flash-crowd "
+        "scenario is vacuous"
+    )
+    assert any("p99" in v for v in result.verdicts["slo_attained"])
+    # The engaged non-vacuity check only exists on the burn variant (the
+    # legacy twin scales nothing by construction). The failure dump
+    # (attached automatically) must contain NO autoscale-up decisions —
+    # the controller really was absent, not merely ineffective.
+    if result.flight_records:
+        events = [
+            e for evs in result.flight_records.values() for e in evs
+        ]
+        assert not any(e["kind"] == "autoscale-up" for e in events)
+
+
+def test_violated_autoscale_spec_dumps_decisions():
+    """Non-vacuity the other way, plus the accountability contract: a
+    deliberately violated judged spec (p99<100ms against a 500ms step
+    grid) must FAIL even WITH the controller engaged — and the
+    automatically attached flight-recorder dump must contain the
+    controller's autoscale-up decisions, so the postmortem for a missed
+    SLO shows exactly what the autoscaler did and when."""
+    result = run_scenario(scenarios.flash_crowd_autoscaled(p99_ms=100))
+    assert not result.ok
+    assert result.verdicts["slo_attained"], "tight spec passed — vacuous"
+    assert result.flight_records, "invariant failure did not dump flightrec"
+    events = [e for evs in result.flight_records.values() for e in evs]
+    assert any(e["kind"] == "autoscale-up" for e in events), (
+        "flight dump missing the controller's scale-up decisions"
+    )
+
+
+def test_autoscale_scenario_replays_bit_for_bit():
+    """The autoscale tentpole's acceptance property: the passing
+    (burn-mode) flash-crowd run replays identically from its seed —
+    same trace, same verdict lines."""
+    first = run_scenario(scenarios.flash_crowd_autoscaled())
+    second = run_scenario(scenarios.flash_crowd_autoscaled())
+    assert first.ok, first.render()
+    assert first.trace_lines() == second.trace_lines()
+
+
 def test_late_eviction_quiesce_catches_reverted_fix():
     """With the quiesce's async-deregister drain reverted
     (quiesce_async=False — the pre-fix runner behavior), the held

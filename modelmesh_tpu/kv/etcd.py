@@ -472,6 +472,25 @@ class EtcdKV(KVStore):
         except grpc.RpcError:
             pass
 
+    def retarget(self, target: str, tls=None) -> None:
+        """Repoint this client at a different etcd endpoint — e.g. after
+        a server restart came back on a fresh port (rebinding a released
+        port races every other process on the host for it). Unary stubs
+        are rebuilt immediately; live watch pumps read ``self._channel``
+        fresh on every (re)subscribe, so they follow the swap at their
+        next reconnect without losing their revision cursor, and lease
+        keepalives build their stream per call. The old channel is
+        closed, which also kicks any pump still blocked on it."""
+        from modelmesh_tpu.serving.tls import secure_channel
+
+        old = self._channel
+        self._channel = secure_channel(target, tls)
+        self._kv = grpc_defs.make_stub(self._channel, _KV_SERVICE, _KV_METHODS)
+        self._lease = grpc_defs.make_stub(
+            self._channel, _LEASE_SERVICE, _LEASE_METHODS
+        )
+        old.close()
+
     def close(self) -> None:
         for w in self._watches:
             w.cancel()

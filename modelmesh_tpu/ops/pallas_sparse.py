@@ -1,0 +1,287 @@
+"""Pallas TPU kernels for the sparse solve's hot loop: fused candidate
+mask + scaled-kernel matvec pair.
+
+The XLA sparse path (ops/sparse.py) materializes two [N, M] intermediates
+per solve — the bool candidate mask from ``topk_candidates`` and the f32
+scaled kernel ``P = exp((rowmin - C) / eps) * mask`` — and then streams P
+through two matvecs per Sinkhorn iteration. At 100k x 1k that is ~400 MB
+of f32 kernel state read twice per iteration; the cost matrix itself is
+bf16 and half that. These kernels keep the bf16 cost matrix as the ONLY
+[N, M] operand in HBM:
+
+    rowmin[n] = min_m { C[n, m] : key(n, m) <= thresh[n] }
+    r[n]      = sum_m [key <= thresh] * exp((rowmin[n] - C[n, m]) / eps) * v[m]
+    c[m]      = sum_n [key <= thresh] * exp((rowmin[n] - C[n, m]) / eps) * u[n]
+
+where ``key(n, m) = f32(C[n, m]) - tau * gumbel(n, m)`` is the noisy
+top-K selection key and ``thresh[n]`` the row's K-th key (from the one
+XLA ``top_k`` pass that builds the gathered candidate columns — finding
+the threshold is a selection problem and stays in XLA's sort/TopK custom
+call; everything downstream of it fuses here). The membership test, the
+positional Gumbel draw, the row shift and the exp all recompute inside
+the tile loop from streamed bf16 C plus three row vectors — neither the
+mask nor P ever exists in HBM, and the f32 accumulators live in VMEM
+scratch across the whole reduction (the ops/pallas_lse.py streaming
+pattern, with the online max replaced by a plain masked sum since the
+row shift already bounds the exponent).
+
+The Gumbel draw is bit-identical to ops.auction.hash_gumbel_at: the
+row-side murmur state ``fmix32(row ^ seed * C3)`` is precomputed once
+per solve (``noise_row_state`` — O(N), and how the kernel avoids needing
+the traced seed scalar), and the kernel applies the column-side mix. A
+pure function of (row, col, seed) in both backends means the fused mask
+equals the XLA mask bit-for-bit; ``rowmin`` is a min over the same set
+(exact in f32), and the matvecs match to reduction-order rounding. The
+parity suite (tests/test_pallas_sparse.py) pins the mask/rowmin bitwise
+and the end-to-end Placement indices/valid bitwise in interpret mode.
+
+Selection: ``SolveConfig.sparse_impl`` ("auto" = Pallas on TPU backends,
+XLA elsewhere — the interpreter is for correctness, not speed; explicit
+"pallas" off-TPU runs interpreted for the parity gates).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Tile sizes: multiples of the f32 (8, 128) / bf16 (16, 128) register
+# tiles, matching ops/pallas_lse.py.
+_TN = 256   # rows per block
+_TM = 512   # cols per block
+# Padding cost: far above any real assembled cost (INFEASIBLE included),
+# so padded entries can never pass the threshold test, and the shifted
+# exponent underflows to exactly 0 (no NaN path).
+_POS_BIG = 1.0e30
+
+
+def resolve_sparse_impl(sparse_impl: str) -> str:
+    """Validate + resolve "auto" for the fused sparse-kernel backend.
+
+    Mirrors ops.sinkhorn.resolve_lse_impl: "auto" picks the Pallas
+    kernels only on TPU backends — in interpret mode they are far slower
+    than the XLA scaled-kernel path, so CPU "auto" stays on XLA and an
+    explicit "pallas" off-TPU is the parity-test configuration."""
+    if sparse_impl not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"sparse_impl={sparse_impl!r} (expected auto | xla | pallas)"
+        )
+    if sparse_impl != "auto":
+        return sparse_impl
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def _fmix32(v):
+    """murmur3 finalizer — MUST stay op-for-op identical to the one in
+    ops.auction.hash_gumbel_at (the bitwise mask parity depends on it)."""
+    v ^= v >> 16
+    v *= jnp.uint32(0x85EBCA6B)
+    v ^= v >> 13
+    v *= jnp.uint32(0xC2B2AE35)
+    v ^= v >> 16
+    return v
+
+
+def noise_row_state(n: int, seed: jax.Array) -> jax.Array:
+    """Row-side hash state ``fmix32(row ^ seed * 0xC2B2AE35)`` — the
+    (row, seed)-only prefix of hash_gumbel_at's counter mix. Precomputing
+    it keeps the traced seed out of the kernels (no scalar-prefetch
+    plumbing) without changing a single bit of the draw."""
+    rows = jnp.arange(n, dtype=jnp.uint32)
+    return _fmix32(rows ^ (jnp.asarray(seed, jnp.uint32) * jnp.uint32(0xC2B2AE35)))
+
+
+def _tile_key(c, xr, col0, tau, noised):
+    """f32 selection key for one (rows, cols) tile: the cost plus the
+    column-side completion of the hash-Gumbel draw. ``col0`` is the
+    tile's global column origin (traced program_id arithmetic)."""
+    if not noised:
+        return c
+    # col0 is program_id arithmetic (int32); cast BEFORE combining so the
+    # counter stays uint32 — a signed intermediate would turn the >> 8
+    # into an arithmetic shift and fork the draw from hash_gumbel_at.
+    cols = jax.lax.broadcasted_iota(jnp.uint32, c.shape, 1) + jnp.asarray(
+        col0, jnp.uint32
+    )
+    x = _fmix32(xr ^ (cols * jnp.uint32(0x85EBCA6B)))
+    u = (x >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+    u = jnp.maximum(u, 1e-7)
+    return c - tau * (-jnp.log(-jnp.log(u)))
+
+
+def _row_min_kernel(xr_ref, th_ref, c_ref, out_ref, acc, *, tau, noised):
+    step = pl.program_id(1)
+
+    @pl.when(step == 0)
+    def _init():
+        acc[:] = jnp.full_like(acc, _POS_BIG)
+
+    c = c_ref[:].astype(jnp.float32)
+    key = _tile_key(c, xr_ref[:], step * _TM, tau, noised)
+    masked = jnp.where(key <= th_ref[:], c, _POS_BIG)
+    acc[:] = jnp.minimum(acc[:], jnp.min(masked, axis=1, keepdims=True))
+
+    @pl.when(step == pl.num_programs(1) - 1)
+    def _finalize():
+        out_ref[:] = acc[:]
+
+
+def _row_matvec_kernel(xr_ref, th_ref, rm_ref, v_ref, c_ref, out_ref, acc,
+                       *, eps, tau, noised):
+    step = pl.program_id(1)
+
+    @pl.when(step == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    c = c_ref[:].astype(jnp.float32)
+    key = _tile_key(c, xr_ref[:], step * _TM, tau, noised)
+    p = jnp.where(key <= th_ref[:], jnp.exp((rm_ref[:] - c) / eps), 0.0)
+    acc[:] += jnp.sum(p * v_ref[:], axis=1, keepdims=True)
+
+    @pl.when(step == pl.num_programs(1) - 1)
+    def _finalize():
+        out_ref[:] = acc[:]
+
+
+def _col_matvec_kernel(xr_ref, th_ref, rm_ref, u_ref, c_ref, out_ref, acc,
+                       *, eps, tau, noised):
+    # Reduced axis (rows) is grid dim 1 so the [1, _TM] accumulator
+    # persists across it; the column origin is grid dim 0.
+    step = pl.program_id(1)
+
+    @pl.when(step == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    c = c_ref[:].astype(jnp.float32)
+    key = _tile_key(c, xr_ref[:], pl.program_id(0) * _TM, tau, noised)
+    p = jnp.where(key <= th_ref[:], jnp.exp((rm_ref[:] - c) / eps), 0.0)
+    acc[:] += jnp.sum(p * u_ref[:], axis=0, keepdims=True)
+
+    @pl.when(step == pl.num_programs(1) - 1)
+    def _finalize():
+        out_ref[:] = acc[:]
+
+
+def _pad_to(x, mult, axis, value):
+    n = x.shape[axis]
+    rem = (-n) % mult
+    if rem == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, rem)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _pad_operands(C, thresh, x_row):
+    """Pad to kernel tile multiples. C pads with +_POS_BIG (excluded by
+    the threshold test AND exp-underflows to 0); padded rows get a
+    -_POS_BIG threshold so their mask is empty."""
+    Cp = _pad_to(_pad_to(C, _TN, 0, _POS_BIG), _TM, 1, _POS_BIG)
+    th = _pad_to(
+        thresh.astype(jnp.float32), _TN, 0, -_POS_BIG
+    ).reshape(-1, 1)
+    xr = _pad_to(x_row.astype(jnp.uint32), _TN, 0, 0).reshape(-1, 1)
+    return Cp, th, xr
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tau", "noised", "interpret", "valid_rows")
+)
+def masked_row_min(C, thresh, x_row, *, tau: float, noised: bool,
+                   interpret: bool = False,
+                   valid_rows: int | None = None):
+    """min_m { f32(C[n, m]) : key(n, m) <= thresh[n] } -> f32[N].
+
+    Exact (f32 min carries no rounding), so it is bit-identical to the
+    XLA path's ``min(where(mask, C, inf))`` over the same mask."""
+    n = valid_rows if valid_rows is not None else C.shape[0]
+    Cp, th, xr = _pad_operands(C, thresh, x_row)
+    np_, mp = Cp.shape
+    out = pl.pallas_call(
+        functools.partial(_row_min_kernel, tau=tau, noised=noised),
+        grid=(np_ // _TN, mp // _TM),
+        in_specs=[
+            pl.BlockSpec((_TN, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((_TN, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((_TN, _TM), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((_TN, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((np_, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((_TN, 1), jnp.float32)],
+        interpret=interpret,
+    )(xr, th, Cp)
+    return out[:n, 0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("eps", "tau", "noised", "interpret", "valid_rows"),
+)
+def masked_row_matvec(C, thresh, x_row, rowmin, v, *, eps: float,
+                      tau: float, noised: bool, interpret: bool = False,
+                      valid_rows: int | None = None):
+    """r = P @ v without materializing P -> f32[N]. ``v`` has the
+    original column count (padded columns contribute exact zeros)."""
+    n = valid_rows if valid_rows is not None else C.shape[0]
+    Cp, th, xr = _pad_operands(C, thresh, x_row)
+    rm = _pad_to(rowmin.astype(jnp.float32), _TN, 0, 0.0).reshape(-1, 1)
+    vp = _pad_to(v.astype(jnp.float32), _TM, 0, 0.0).reshape(1, -1)
+    np_, mp = Cp.shape
+    out = pl.pallas_call(
+        functools.partial(
+            _row_matvec_kernel, eps=eps, tau=tau, noised=noised
+        ),
+        grid=(np_ // _TN, mp // _TM),
+        in_specs=[
+            pl.BlockSpec((_TN, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((_TN, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((_TN, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, _TM), lambda i, j: (0, j)),
+            pl.BlockSpec((_TN, _TM), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((_TN, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((np_, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((_TN, 1), jnp.float32)],
+        interpret=interpret,
+    )(xr, th, rm, vp, Cp)
+    return out[:n, 0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("eps", "tau", "noised", "interpret", "valid_cols"),
+)
+def masked_col_matvec(C, thresh, x_row, rowmin, u, *, eps: float,
+                      tau: float, noised: bool, interpret: bool = False,
+                      valid_cols: int | None = None):
+    """c = u @ P without materializing P -> f32[M] (the scatter-free
+    column accumulation; padded rows carry u = 0)."""
+    m = valid_cols if valid_cols is not None else C.shape[1]
+    Cp, th, xr = _pad_operands(C, thresh, x_row)
+    rm = _pad_to(rowmin.astype(jnp.float32), _TN, 0, 0.0).reshape(-1, 1)
+    up = _pad_to(u.astype(jnp.float32), _TN, 0, 0.0).reshape(-1, 1)
+    np_, mp = Cp.shape
+    out = pl.pallas_call(
+        functools.partial(
+            _col_matvec_kernel, eps=eps, tau=tau, noised=noised
+        ),
+        grid=(mp // _TM, np_ // _TN),
+        in_specs=[
+            pl.BlockSpec((_TN, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((_TN, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((_TN, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((_TN, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((_TN, _TM), lambda j, i: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, _TM), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, mp), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, _TM), jnp.float32)],
+        interpret=interpret,
+    )(xr, th, rm, up, Cp)
+    return out[0, :m]

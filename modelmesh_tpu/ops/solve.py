@@ -92,6 +92,12 @@ class SolveConfig(NamedTuple):
     # value-equality alone cannot distinguish "chose the default" from
     # "left unset".
     tier_defaults: bool = True
+    # Sparse-path kernel backend: "auto" = fused Pallas mask+matvec
+    # kernels (ops/pallas_sparse.py) on TPU backends, the XLA
+    # scaled-kernel path elsewhere. Explicit "pallas" off-TPU runs the
+    # kernels in interpret mode (the parity-gate configuration —
+    # correctness, not speed). Env knob: MM_SOLVER_SPARSE_IMPL.
+    sparse_impl: str = "auto"
 
 
 class Placement(NamedTuple):

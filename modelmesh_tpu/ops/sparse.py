@@ -58,6 +58,8 @@ feasible set (pinned by tests/test_sparse_solver.py).
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 
@@ -76,6 +78,13 @@ from modelmesh_tpu.ops.auction import (
     select_from_candidates,
     warm_probe,
 )
+from modelmesh_tpu.ops.pallas_sparse import (
+    masked_col_matvec,
+    masked_row_matvec,
+    masked_row_min,
+    noise_row_state,
+    resolve_sparse_impl,
+)
 from modelmesh_tpu.ops.sinkhorn import SinkhornResult, gated_sinkhorn_loop
 
 # Gumbel scale for the candidate-selection draw (cost units; the cost
@@ -91,6 +100,21 @@ _GATHER_SALT = 0x9E3779B9
 _TINY = 1e-30
 
 
+class FusedGather(NamedTuple):
+    """Per-solve state the fused Pallas kernels (ops/pallas_sparse.py)
+    need to recompute the candidate mask in-tile instead of reading a
+    materialized bool[N, M]: the row's K-th selection key and the
+    row-side hash state of the noise draw. ``tau``/``noised``/
+    ``interpret`` are trace-time Python values (captured from the static
+    SolveConfig), not traced operands."""
+
+    thresh: jax.Array    # f32[N] K-th (tie-inclusive) selection key
+    x_row: jax.Array     # u32[N] row-side hash state (noise_row_state)
+    tau: float
+    noised: bool
+    interpret: bool
+
+
 def topk_candidates(
     C: jax.Array,
     feasible: jax.Array,
@@ -98,6 +122,8 @@ def topk_candidates(
     seed: jax.Array | None = None,
     gather_tau: float = GATHER_TAU,
     row_offset: jax.Array | int = 0,
+    *,
+    return_thresh: bool = False,
 ):
     """Gather each row's K cheapest instances from the assembled cost.
 
@@ -116,6 +142,10 @@ def topk_candidates(
     them — the sparse solve is exact for that row. ``row_offset`` shifts
     the noise counter for a model-axis shard so a sharded gather equals
     the corresponding rows of the single-device one.
+
+    ``return_thresh=True`` appends the f32[N] K-th selection key (the
+    mask's row threshold) for the fused Pallas path, which re-derives
+    mask membership in-kernel instead of consuming the bool[N, M].
     """
     k = min(k, C.shape[1])
     key = C.astype(jnp.float32)
@@ -134,13 +164,15 @@ def topk_candidates(
     # no longer matches), silently falling back to a full O(M log M)
     # variadic sort — measured 1.3 s vs 150 ms for this exact gather at
     # 20k x 256. min() over the (descending) values is bit-identical.
-    mask = key <= -jnp.min(neg_vals, axis=1, keepdims=True)
-    return (
+    kth = -jnp.min(neg_vals, axis=1)
+    mask = key <= kth[:, None]
+    out = (
         jnp.take_along_axis(C, idx, axis=1),
         idx,
         jnp.take_along_axis(feasible, idx, axis=1),
         mask,
     )
+    return out + (kth,) if return_thresh else out
 
 
 def sparse_sinkhorn(
@@ -156,6 +188,7 @@ def sparse_sinkhorn(
     chunk: int = 4,
     col_psum=None,
     dg_reduce=None,
+    fused: FusedGather | None = None,
 ) -> SinkhornResult:
     """Semi-unbalanced Sinkhorn over the masked candidate set (rows
     equalities, columns CAPS via g <= 0 — must match ops/sinkhorn.py; the
@@ -178,18 +211,55 @@ def sparse_sinkhorn(
     scalar as in ``gated_sinkhorn_loop``. Columns nobody gathered get the
     empty-sum floor, which lands their potential at the g = 0 cap —
     exactly where a zero-demand column sits in the dense solve.
+
+    With ``fused`` set (single-device only), the mask and P never
+    materialize: the Pallas kernels (ops/pallas_sparse.py) recompute
+    mask membership from ``fused.thresh``/``fused.x_row`` and the
+    row-shifted exp in-tile, streaming only the bf16 cost matrix —
+    ``mask`` is ignored and may be None.
     """
     row_mass = row_mass.astype(jnp.float32)
     col_mass = col_mass.astype(jnp.float32)
     log_a = jnp.log(jnp.maximum(row_mass, _TINY))
     log_b = jnp.log(jnp.maximum(col_mass, _TINY))
-    Cf = C.astype(jnp.float32)
-    rowmin = jnp.min(jnp.where(mask, Cf, jnp.inf), axis=1)  # finite: >=K masked
-    P = jnp.where(mask, jnp.exp((rowmin[:, None] - Cf) / eps), 0.0)
+    if fused is not None:
+        if col_psum is not None:
+            raise ValueError(
+                "fused sparse kernels are single-device only "
+                "(sharded solves keep the XLA scaled-kernel path)"
+            )
+        rowmin = masked_row_min(
+            C, fused.thresh, fused.x_row, tau=fused.tau,
+            noised=fused.noised, interpret=fused.interpret,
+        )
+
+        def row_prod(v):
+            return masked_row_matvec(
+                C, fused.thresh, fused.x_row, rowmin, v, eps=eps,
+                tau=fused.tau, noised=fused.noised,
+                interpret=fused.interpret,
+            )
+
+        def col_prod(u):
+            return masked_col_matvec(
+                C, fused.thresh, fused.x_row, rowmin, u, eps=eps,
+                tau=fused.tau, noised=fused.noised,
+                interpret=fused.interpret,
+            )
+    else:
+        Cf = C.astype(jnp.float32)
+        rowmin = jnp.min(jnp.where(mask, Cf, jnp.inf), axis=1)  # finite: >=K masked
+        P = jnp.where(mask, jnp.exp((rowmin[:, None] - Cf) / eps), 0.0)
+
+        def row_prod(v):
+            return P @ v
+
+        def col_prod(u):
+            return u @ P
 
     def row_terms(g):
         v = jnp.exp(g / eps)
-        r = jnp.maximum(P @ v, _TINY)
+        r = jnp.maximum(row_prod(v), _TINY)
         return r
 
     def body(carry, _):
@@ -197,7 +267,7 @@ def sparse_sinkhorn(
         r = row_terms(g)
         f = eps * (log_a - jnp.log(r)) + rowmin
         u = row_mass / r                       # exp((f - rowmin) / eps)
-        c = u @ P
+        c = col_prod(u)
         if col_psum is not None:
             c = col_psum(c)
         g = jnp.minimum(0.0, eps * (log_b - jnp.log(jnp.maximum(c, _TINY))))
@@ -427,9 +497,23 @@ def solve_sparse(problem, config, seed, init):
     C = costs_mod.assemble_cost(
         problem, weights=config.weights, dtype=config.dtype
     )
-    cost_k, idx_k, feas_k, mask = topk_candidates(
-        C, problem.feasible, config.topk, seed=seed
+    use_pallas = resolve_sparse_impl(config.sparse_impl) == "pallas"
+    cost_k, idx_k, feas_k, mask, kth = topk_candidates(
+        C, problem.feasible, config.topk, seed=seed, return_thresh=True
     )
+    fused = None
+    if use_pallas:
+        # Explicit "pallas" off-TPU runs the kernels interpreted — the
+        # parity-gate configuration, not a performance path.
+        fused = FusedGather(
+            thresh=kth,
+            x_row=noise_row_state(
+                C.shape[0], seed ^ jnp.uint32(_GATHER_SALT)
+            ),
+            tau=GATHER_TAU,
+            noised=GATHER_TAU > 0,
+            interpret=jax.default_backend() != "tpu",
+        )
     copies = jnp.minimum(problem.copies, MAX_COPIES)
     row_mass = problem.sizes * copies.astype(jnp.float32)
     free = jnp.maximum(problem.capacity - problem.reserved, 0.0)
@@ -438,6 +522,7 @@ def solve_sparse(problem, config, seed, init):
         eps=config.eps, iters=config.sinkhorn_iters,
         g0=None if init is None else init.g0,
         tol=config.sinkhorn_tol, chunk=config.sinkhorn_chunk,
+        fused=fused,
     )
     # Per-element arithmetic (and the dtype quantization) match
     # ops.sinkhorn.plan_logits so gathered scores equal the dense ones.

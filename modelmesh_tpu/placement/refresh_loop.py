@@ -21,6 +21,18 @@ their sum, and the installed plan always lags the submitted snapshot by
 exactly one refresh (pipeline depth 1 — bounded staleness, and plans are
 advisory anyway).
 
+The steady state is INCREMENTAL-FIRST: when the strategy's dispatch
+gates allow (small model-only churn, matched noise epoch — see
+``JaxPlacementStrategy._incremental_rows_locked``), a cycle re-solves
+only the dirty rows against the device-pinned ``SolveBase`` frozen at
+the last full solve, and the base's merge target advances with the
+flight's async arrays — no host round trip. Full solves are the
+background cadence that re-freezes the base (the MAX_DELTA_STREAK
+forced rebuild, instance churn, and the drift/overflow gates), not the
+common case. Host materialization happens once per cycle, for the
+packed plan the registry publisher needs (finalize_plan's single
+batched readback; carries stay device-resident).
+
 Plan visibility is tear-free by construction: a finished plan is installed
 into the strategy by a single reference assignment, so concurrent
 ``choose_load_target`` readers see either generation N-1 or N, never a
@@ -33,10 +45,14 @@ import logging
 import time
 from typing import NamedTuple, Optional, Sequence
 
+import numpy as np
+
 from modelmesh_tpu.placement.jax_engine import (
+    INCREMENTAL_OVERFLOW_FRAC,
     GlobalPlan,
     JaxPlacementStrategy,
     PendingSolve,
+    SolveBase,
     _bucket,
     dispatch_solve,
     finalize_plan,
@@ -79,8 +95,18 @@ class PipelinedRefresher:
         # Donation is only wired through the single-device jit entry
         # (solve_placement_donated); the mesh path would silently ignore
         # it while finalize skipped the carry readback, leaving the
-        # id-keyed fallback dicts permanently stale.
-        self._donate = bool(donate) and strategy.mesh is None
+        # id-keyed fallback dicts permanently stale. It is also mutually
+        # exclusive with the device-pinned incremental base: the base
+        # aliases the very g/prices buffers a donated flight would
+        # consume (resolve_dirty_rows passes g0/price0 straight through
+        # as its Placement's carries), so an incremental-enabled
+        # strategy keeps donation off and pins the base instead —
+        # incremental-first beats buffer reuse in the steady state.
+        self._donate = (
+            bool(donate)
+            and strategy.mesh is None
+            and strategy.incr_max_dirty_frac <= 0
+        )
 
     def submit(
         self,
@@ -101,57 +127,83 @@ class PipelinedRefresher:
             return self.drain()
         with strat._refresh_lock:
             t0 = time.perf_counter()  #: wall-clock: perf_counter solve-timing metric
-            cols, delta, _dm, _di = strat._build_cols_locked(
+            cols, delta, dm, di = strat._build_cols_locked(
                 models, instances, rpm_fn, incremental
             )
-            # The pipelined driver always dispatches FULL solves and never
-            # captures an incremental base (a donated flight consumes the
-            # very g/prices buffers a base would alias); a base left over
-            # from an earlier blocking refresh is superseded the moment a
-            # newer pipelined plan lands, so drop it now.
-            strat._base = None
             prev = self._inflight
             carry = None
             donated = False
-            # A flight superseded by a blocking refresh() (newer
-            # generation already installed) must not chain its device
-            # carry: the blocking full rebuild rotated the seed, so the
-            # stale flight's prices belong to the OLD draw — fall back
-            # to the id-keyed dicts the newer refresh updated instead.
-            cur = strat._plan
-            superseded = (
-                prev is not None and cur is not None
-                and cur.generation > prev.generation
-            )
-            if delta and prev is not None and not superseded and (
-                self._carry_iids == cols.instance_ids
-            ):
-                sol = prev.pending.sol
-                if sol.g is not None and sol.prices is not None and (
-                    sol.g.shape[0] == _bucket(len(cols.instance_ids), 64)
+            # Incremental-first steady state: when the dispatch gates
+            # (dirty fraction, matched noise epoch, no instance churn —
+            # JaxPlacementStrategy._incremental_rows_locked) allow it,
+            # the cycle re-solves only the dirty rows against the
+            # device-pinned base frozen at the last full solve. Full
+            # solves are the cadence path, not the common case: the
+            # MAX_DELTA_STREAK forced rebuild and the drift/overflow
+            # gates are what re-freeze the base.
+            rows = strat._incremental_rows_locked(cols, delta, dm, di)
+            if rows is not None:
+                strat._generation += 1
+                pending = dispatch_solve(
+                    cols, seed=strat._seed, config=strat.solve_config,
+                    base=strat._base, dirty_rows=rows, t_start=t0,
+                )
+                # Advance the merge target NOW, with the in-flight solve's
+                # async arrays (a device-to-device reference chain, no
+                # host sync): the next cycle's dirty rows must merge into
+                # THIS flight's assignment even if it is still crunching
+                # when they dispatch. The frozen column state (g/prices/
+                # overflow reference) stays at the full solve, so drift
+                # accumulated across many increments is still measured
+                # against it at finalize.
+                strat._base = strat._base._replace(
+                    indices=pending.sol.indices, valid=pending.sol.valid
+                )
+            else:
+                # A flight superseded by a blocking refresh() (newer
+                # generation already installed) must not chain its device
+                # carry: the blocking full rebuild rotated the seed, so the
+                # stale flight's prices belong to the OLD draw — fall back
+                # to the id-keyed dicts the newer refresh updated instead.
+                cur = strat._plan
+                superseded = (
+                    prev is not None and cur is not None
+                    and cur.generation > prev.generation
+                )
+                if delta and prev is not None and not superseded and (
+                    self._carry_iids == cols.instance_ids
                 ):
-                    # Chain the carries device-to-device (async arrays:
-                    # this only records a dependency, it does not block).
-                    carry = (sol.g, sol.prices)
-                    donated = self._donate
-            # Shared noise-epoch discipline (delta keeps the seed + may
-            # warm prices; full rebuild rotates + drops prices) — see
-            # JaxPlacementStrategy._epoch_carries_locked. The device chain,
-            # when taken, supersedes the id-keyed dicts entirely.
-            warm_g, warm_price = strat._epoch_carries_locked(delta)
-            strat._generation += 1
-            pending = dispatch_solve(
-                cols, seed=strat._seed, mesh=strat.mesh,
-                warm_g=None if carry else warm_g,
-                warm_price=None if carry else warm_price,
-                config=strat.solve_config, carry=carry,
-                donate=donated, t_start=t0,
-            )
+                    sol = prev.pending.sol
+                    if sol.g is not None and sol.prices is not None and (
+                        sol.g.shape[0] == _bucket(len(cols.instance_ids), 64)
+                    ):
+                        # Chain the carries device-to-device (async arrays:
+                        # this only records a dependency, it does not block).
+                        carry = (sol.g, sol.prices)
+                        donated = self._donate
+                # Shared noise-epoch discipline (delta keeps the seed + may
+                # warm prices; full rebuild rotates + drops prices) — see
+                # JaxPlacementStrategy._epoch_carries_locked. The device chain,
+                # when taken, supersedes the id-keyed dicts entirely.
+                warm_g, warm_price = strat._epoch_carries_locked(delta)
+                strat._generation += 1
+                pending = dispatch_solve(
+                    cols, seed=strat._seed, mesh=strat.mesh,
+                    warm_g=None if carry else warm_g,
+                    warm_price=None if carry else warm_price,
+                    config=strat.solve_config, carry=carry,
+                    donate=donated, t_start=t0,
+                )
             self._inflight = _InFlight(
                 pending, strat._generation, delta, strat._seed
             )
             self._carry_iids = cols.instance_ids
-            plan = self._finalize_install_locked(prev, consumed=donated) if prev else None
+            plan = (
+                self._finalize_install_locked(
+                    prev, consumed=donated, chained=carry is not None
+                )
+                if prev else None
+            )
         return plan
 
     def drain(self) -> Optional[GlobalPlan]:
@@ -172,7 +224,7 @@ class PipelinedRefresher:
     # -- internals ----------------------------------------------------------
 
     def _finalize_install_locked(
-        self, flight: _InFlight, consumed: bool
+        self, flight: _InFlight, consumed: bool, chained: bool = False
     ) -> Optional[GlobalPlan]:
         """Block on solve N-1, pack the plan, install it atomically.
         Returns None when a newer generation was installed meanwhile
@@ -182,13 +234,22 @@ class PipelinedRefresher:
         finalize must not read them back (donated buffers are dead on
         accelerator backends), so the id-keyed host fallback dicts keep
         their previous values instead of updating.
+
+        ``chained``: the next solve already took this flight's carries
+        device-to-device (non-donating), so materializing the host
+        fallback dicts would be a pure extra round trip — skip the
+        readback and keep the state device-resident. Incremental flights
+        skip it unconditionally (their g/prices are aliases of the
+        frozen base, which the host already never needs).
         """
         strat = self.strategy
+        incremental = flight.pending.path == "incremental"
         plan = finalize_plan(
             flight.pending._replace(
                 sol=_without_carries(flight.pending.sol)
                 if consumed else flight.pending.sol
-            )
+            ),
+            fetch_carries=not (consumed or chained or incremental),
         )
         if flight.delta is not None:
             plan.stats["delta_snapshot"] = flight.delta
@@ -215,6 +276,53 @@ class PipelinedRefresher:
         # would mispair them with the new draw. g is draw-independent.
         if plan.warm_price is not None and flight.seed == strat._seed:
             strat._warm_price = plan.warm_price
+        if incremental:
+            # The deferred twin of _solve_locked's overflow quality gate:
+            # the merged assignment already shipped (its drift is bounded
+            # by ONE increment past the budget), but a breach drops the
+            # base so the NEXT cycle re-freezes it with a full solve.
+            base = strat._base
+            if base is not None and base.seed == flight.seed:
+                cols = flight.pending.cols
+                demand = float(np.sum(cols.sizes * cols.copies))
+                budget = base.overflow + INCREMENTAL_OVERFLOW_FRAC * max(
+                    demand, 1e-9
+                )
+                if plan.stats["overflow"] > budget:
+                    log.info(
+                        "pipelined incremental overflow %.3g drifted past "
+                        "the base solve's %.3g + %.2f%% of demand; next "
+                        "cycle re-freezes the base with a full solve",
+                        plan.stats["overflow"], base.overflow,
+                        INCREMENTAL_OVERFLOW_FRAC * 100,
+                    )
+                    strat._base = None
+        elif strat.mesh is None and not consumed:
+            # Re-freeze the incremental base from this full solve's
+            # still-on-device outputs — no host round trip; the only host
+            # pieces (overflow reference, rates column) rode the one
+            # batched readback / the host snapshot. Skipped when the
+            # flight just dispatched is ALREADY incremental: it merged
+            # into (and advanced) the existing base, and overwriting that
+            # chain with this older full state would resurrect stale rows.
+            sol = flight.pending.sol
+            inflight = self._inflight
+            if (
+                sol.g is not None and sol.prices is not None
+                and flight.seed == strat._seed
+                and not (
+                    inflight is not None
+                    and inflight.pending.path == "incremental"
+                )
+            ):
+                cols = flight.pending.cols
+                strat._base = SolveBase(
+                    indices=sol.indices, valid=sol.valid, g=sol.g,
+                    prices=sol.prices, row_err=sol.row_err,
+                    seed=flight.seed,
+                    overflow=plan.stats["overflow"],
+                    rates=np.asarray(cols.rates, np.float32).copy(),  #: host-sync: snapshot rates are host numpy columns
+                )
         strat._plan = plan  # atomic install: readers see old or new, whole
         log.info(
             "pipelined plan installed: gen %d, %d models in %.1f ms "

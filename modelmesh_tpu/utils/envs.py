@@ -75,11 +75,11 @@ REGISTRY: dict[str, EnvVar] = {
                "also measure the steady-state refresh fast path: cold vs "
                "warm e2e refresh under churn (pipelined + delta snapshots "
                "+ convergence-gated early exit)", "bench.py"),
-        EnvVar("MM_BENCH_SOLVER", "int", "0",
+        EnvVar("MM_BENCH_SOLVER", "int", "1",
                "also measure the per-backend solver breakdown: dense vs "
                "sparse top-K device solve and the incremental dirty-row "
                "re-solve vs a full warm solve, with overflow/row_err "
-               "quality fields in the JSON tail", "bench.py"),
+               "quality fields in the JSON tail (0 disables)", "bench.py"),
         EnvVar("MM_BENCH_SERVE", "int", "0",
                "also run the serving data-plane microbench: local-hit / "
                "forward / cache-miss request-path latency at simulated "
@@ -293,6 +293,12 @@ REGISTRY: dict[str, EnvVar] = {
                "candidate instances gathered per model on the sparse "
                "path (default 24); the solve is exact for rows with "
                "<= K feasible instances",
+               "placement/jax_engine.py"),
+        EnvVar("MM_SOLVER_SPARSE_IMPL", "str", "",
+               "sparse-path kernel backend: auto (default — fused Pallas "
+               "mask+matvec kernels on TPU, the XLA scaled-kernel path "
+               "elsewhere) | pallas (forced; interpret mode off-TPU — "
+               "the parity-gate configuration) | xla",
                "placement/jax_engine.py"),
         EnvVar("MM_SOLVER_INCREMENTAL_MAX_DIRTY_FRAC", "float", "0.05",
                "dirty-row fraction ceiling for the incremental re-solve "

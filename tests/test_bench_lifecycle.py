@@ -37,10 +37,10 @@ class TestBenchLifecycleSmoke:
         nc = out["n_copies"]
         assert nc["serial"]["n"] == nc["fastpath"]["n"] == 3
         assert nc["fastpath"]["time_to_n_ms"] > 0
-        # Sequential chain ~= N x load; concurrent fan-out ~= max(load).
-        assert (
-            nc["fastpath"]["time_to_n_ms"] < nc["serial"]["time_to_n_ms"]
-        )
+        assert nc["serial"]["time_to_n_ms"] > 0
+        # The sequential-chain vs concurrent-fan-out wall-clock ORDERING
+        # is a single reps=1 sample here and flakes under full-suite
+        # load — it lives in the retried ordering gate below.
 
         ml = out["mass_load"]
         assert ml["serial"]["loaded"] == ml["fastpath"]["loaded"] == 40
@@ -96,6 +96,28 @@ class TestBenchLifecycleSmoke:
         assert asr["controller_off"]["recovered"] is False
         assert asr["controller_off"]["copies_at_end"] == 1
         assert asr["recovery_speedup_floor"] > 0
+
+    def test_n_copies_fanout_ordering(self):
+        """Retried ordering gate (the PR-11/13 convention): the serial
+        replication chain pays ~N x load sequentially while the
+        concurrent fan-out pays ~max(load), but at reps=1 a single
+        descheduled fan-out thread under full-suite load can invert the
+        one sample the structural smoke above takes."""
+        last = None
+        for attempt in range(3):
+            serial = bench_lifecycle._measure_n_copies(
+                False, 3, 4, 20.0, reps=1
+            )
+            fast = bench_lifecycle._measure_n_copies(
+                True, 3, 4, 20.0, reps=1
+            )
+            last = (fast["time_to_n_ms"], serial["time_to_n_ms"])
+            if fast["time_to_n_ms"] < serial["time_to_n_ms"]:
+                return
+        raise AssertionError(
+            f"n_copies fan-out ordering (fast, serial) not met "
+            f"after 3 attempts: {last}"
+        )
 
     def test_autoscale_recovery_floor(self):
         """Tier-1 smoke floor (retried, the PR-11/13 convention — the

@@ -32,6 +32,10 @@ jax = pytest.importorskip("jax")
 
 @pytest.fixture(scope="module")
 def solver_result():
+    return _measure()
+
+
+def _measure():
     import bench
 
     return bench._measure_solver_paths(2048, 256, cycles=3)
@@ -88,13 +92,23 @@ class TestBenchSolverSmoke:
     def test_sparse_beats_dense(self, solver_result):
         # Measured ~2.9x warm / ~5.1x cold at this tier standalone, but
         # the warm ratio compresses hard on a contended core (observed
-        # 1.09x): additive scheduler noise inflates the shorter sparse
-        # timings proportionally most. The cold ratio (compile + first
-        # solve, seconds-scale on both sides) is robust to that, so it
-        # carries the magnitude floor; warm is a pure ORDERING gate —
-        # sparse never loses to dense at the same tier.
-        assert solver_result["sparse_speedup"] >= 1.0
+        # 1.09x, and a single descheduled sample can invert it outright):
+        # additive scheduler noise inflates the shorter sparse timings
+        # proportionally most. The cold ratio (compile + first solve,
+        # seconds-scale on both sides) is robust to that, so it carries
+        # the magnitude floor off the first measurement; the warm
+        # ORDERING gate — sparse never loses to dense at the same tier —
+        # gets the retried-floor convention (re-measure, 3 attempts).
         assert solver_result["sparse_cold_speedup"] >= 1.5
+        res, last = solver_result, None
+        for attempt in range(3):
+            res = res if attempt == 0 else _measure()
+            last = res["sparse_speedup"]
+            if last >= 1.0:
+                return
+        raise AssertionError(
+            f"warm sparse-vs-dense ordering not met after 3 attempts: {last}"
+        )
 
     def test_incremental_beats_full_warm_solve(self, solver_result):
         _require_incremental_samples(solver_result)
@@ -102,10 +116,19 @@ class TestBenchSolverSmoke:
         # in docs/performance.md is 8.9x), but the incremental solves are
         # the shortest timings in the bench, so scheduler noise under a
         # full-suite run inflates them proportionally most and compresses
-        # the ratio (observed 2.03x under tier-1 load). This smoke gates
-        # the ORDERING — incremental strictly beats the full warm solve —
-        # not the headline magnitude.
-        assert solver_result["incremental_speedup"] >= 1.5
+        # the ratio (observed 2.03x, with rarer excursions below the
+        # floor). Retried-floor convention: re-measure on a miss, up to 3
+        # attempts, preserving the all-fallback skip semantics per run.
+        res, last = solver_result, None
+        for attempt in range(3):
+            res = res if attempt == 0 else _measure()
+            if res["paths"]["incremental"]["device_solve_ms"] is not None:
+                last = res["incremental_speedup"]
+                if last >= 1.5:
+                    return
+        raise AssertionError(
+            f"incremental-vs-full-warm floor not met after 3 attempts: {last}"
+        )
 
     def test_sparse_quality_tracks_dense(self, solver_result):
         paths = solver_result["paths"]

@@ -1,0 +1,111 @@
+"""Field-contract check for the committed BENCH_r*.json trajectory.
+
+The repo commits one ``BENCH_rNN.json`` per growth round: the driver's
+envelope (``{"n", "cmd", "rc", "tail", "parsed"}``) around the single
+JSON line ``bench.py`` prints.  From r06 the headline is re-pointed at
+the production dispatch path and the solver per-path breakdown ships by
+default, so downstream tooling (and the next round's before/after docs)
+can rely on the parsed payload carrying:
+
+- headline: ``solver_path`` (which dispatch tier actually ran),
+  ``sparse_impl`` (honest CPU "xla" fallback vs TPU "pallas"), ``topk``;
+- ``solver.paths.{dense,sparse,full_warm,incremental}``: each entry
+  carries ``solver_path`` / ``device_solve_ms`` / ``overflow_frac`` /
+  ``row_err``; the incremental entry additionally carries
+  ``dirty_rows`` when it produced samples, or ``fallback_cycles`` with
+  ``device_solve_ms: null`` when every churn cycle legitimately fell
+  back through the overflow-drift quality gate.
+
+This test validates the committed files, not a fresh bench run — it is
+the cheap tier-1 tripwire that keeps the trajectory machine-readable
+(a field rename in bench.py without a matching regeneration of the
+round's JSON fails here, not in the next round's tooling).
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+
+# Rounds before r06 predate the dispatch-path headline and the
+# always-on solver breakdown; the contract applies from r06 onward.
+CONTRACT_FROM = 6
+
+PATH_KEYS = ("dense", "sparse", "full_warm", "incremental")
+ENTRY_FIELDS = ("solver_path", "device_solve_ms", "cold_solve_ms",
+                "topk", "overflow_frac", "row_err")
+
+
+def _contract_files():
+    out = []
+    for p in sorted(ROOT.glob("BENCH_r*.json")):
+        try:
+            n = int(p.stem.split("r")[-1])
+        except ValueError:
+            continue
+        if n >= CONTRACT_FROM:
+            out.append(p)
+    return out
+
+
+FILES = _contract_files()
+
+
+@pytest.mark.skipif(not FILES, reason="no BENCH_r*.json at r06 or later")
+class TestBenchTrajectoryContract:
+    @pytest.mark.parametrize("path", FILES, ids=lambda p: p.name)
+    def test_envelope_shape(self, path):
+        doc = json.loads(path.read_text())
+        for key in ("n", "cmd", "rc", "parsed"):
+            assert key in doc, f"{path.name} missing envelope key {key!r}"
+        assert doc["rc"] == 0, f"{path.name} recorded a failing bench run"
+        assert isinstance(doc["parsed"], dict)
+
+    @pytest.mark.parametrize("path", FILES, ids=lambda p: p.name)
+    def test_headline_dispatch_fields(self, path):
+        parsed = json.loads(path.read_text())["parsed"]
+        assert parsed.get("solver_path") in ("dense", "sparse"), (
+            f"{path.name}: headline must record the dispatch tier that "
+            f"ran, got {parsed.get('solver_path')!r}"
+        )
+        # sparse_impl is the honest backend report: "xla" on the CPU
+        # fallback, "pallas" on real TPU, null when the dense tier ran.
+        if parsed["solver_path"] == "sparse":
+            assert parsed.get("sparse_impl") in ("xla", "pallas")
+            assert parsed.get("topk", 0) > 0
+        else:
+            assert parsed.get("sparse_impl") is None
+
+    @pytest.mark.parametrize("path", FILES, ids=lambda p: p.name)
+    def test_solver_path_entries(self, path):
+        parsed = json.loads(path.read_text())["parsed"]
+        solver = parsed.get("solver")
+        assert solver, f"{path.name}: no 'solver' per-path breakdown"
+        paths = solver.get("paths", {})
+        assert set(PATH_KEYS) <= set(paths), (
+            f"{path.name}: solver.paths missing "
+            f"{set(PATH_KEYS) - set(paths)}"
+        )
+        for name in PATH_KEYS:
+            entry = paths[name]
+            for field in ENTRY_FIELDS:
+                assert field in entry, (
+                    f"{path.name}: solver.paths.{name} missing {field!r}"
+                )
+        for name in ("dense", "sparse", "full_warm"):
+            assert paths[name]["device_solve_ms"] is not None
+        incr = paths["incremental"]
+        if incr["device_solve_ms"] is None:
+            # All-fallback is a legitimate quality-gate outcome, but it
+            # must be reported as such, not as a silently missing number.
+            assert incr.get("fallback_cycles", 0) > 0, (
+                f"{path.name}: incremental has no samples and no "
+                "fallback_cycles — missing measurement"
+            )
+        else:
+            assert incr["solver_path"] == "incremental"
+            assert incr.get("dirty_rows", 0) > 0, (
+                f"{path.name}: incremental samples without dirty_rows"
+            )

@@ -543,6 +543,102 @@ def gather(C, feas, dirty):
 
 
 # --------------------------------------------------------------------- #
+# rule: host-round-trip (solver steady-state device residency)          #
+# --------------------------------------------------------------------- #
+
+
+def _roundtrip_findings(tmp_path, source, rel="placement/refresh_loop.py"):
+    p = tmp_path / "modelmesh_tpu" / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    out = run_analysis([str(tmp_path)], repo_root=str(tmp_path),
+                       lock_order_path=str(tmp_path / "order.txt"))
+    return [f for f in out if f.rule == "host-round-trip"]
+
+
+class TestHostRoundTripRule:
+    @pytest.mark.parametrize("body", [
+        "jax.device_get(fetch)",
+        "np.asarray(sol.g)",
+        "jax.block_until_ready(sol)",
+        "sol.block_until_ready()",
+    ])
+    def test_bare_sync_in_refresh_loop_fires(self, tmp_path, body):
+        src = f"""
+import jax
+import numpy as np
+
+def _finalize(sol, fetch):
+    return {body}
+"""
+        assert _roundtrip_findings(tmp_path, src)
+
+    @pytest.mark.parametrize("body", [
+        "jax.device_get(fetch)  #: host-sync: the batched readback",
+        "np.asarray(sol.g)  #: host-sync: host-built columns",
+    ])
+    def test_annotated_sync_is_clean(self, tmp_path, body):
+        src = f"""
+import jax
+import numpy as np
+
+def _finalize(sol, fetch):
+    return {body}
+"""
+        assert not _roundtrip_findings(tmp_path, src)
+
+    def test_annotation_on_line_above_is_clean(self, tmp_path):
+        src = """
+import jax
+
+def _finalize(fetch):
+    #: host-sync: the single batched per-cycle readback
+    return jax.device_get(fetch)
+"""
+        assert not _roundtrip_findings(tmp_path, src)
+
+    def test_jax_engine_scope_is_by_function_name(self, tmp_path):
+        # Only the dispatch/finalize spine is in scope in jax_engine.py —
+        # a sync in an unscoped helper (plan serialization, snapshotting)
+        # is not a steady-state-path finding.
+        src = """
+import numpy as np
+
+def finalize_plan(sol):
+    return np.asarray(sol.overflow)
+
+def to_bytes(plan):
+    return np.asarray(plan.packed)
+"""
+        found = _roundtrip_findings(
+            tmp_path, src, rel="placement/jax_engine.py"
+        )
+        assert [f.qualname for f in found] == ["finalize_plan"]
+
+    def test_other_modules_are_out_of_scope(self, tmp_path):
+        src = """
+import numpy as np
+
+def histogram(x):
+    return np.asarray(x)
+"""
+        assert not _roundtrip_findings(
+            tmp_path, src, rel="observability/metrics.py"
+        )
+
+    def test_jnp_asarray_is_not_a_sync(self, tmp_path):
+        # jnp.asarray is host->device (or a no-op) — the rule polices
+        # device->host materialization only.
+        src = """
+import jax.numpy as jnp
+
+def _dispatch(rows):
+    return jnp.asarray(rows)
+"""
+        assert not _roundtrip_findings(tmp_path, src)
+
+
+# --------------------------------------------------------------------- #
 # MM_LOCK_DEBUG runtime validator                                       #
 # --------------------------------------------------------------------- #
 
@@ -1471,6 +1567,33 @@ class TestFixRevertedMetaTests:
         assert any(f.rule == "clock-discipline" for f in reverted), (
             "stripping every #: wall-clock: annotation from kv/memory.py "
             "must re-fire the rule — otherwise the gate is vacuous"
+        )
+
+    def test_host_round_trip_fires_when_annotations_stripped(
+        self, tmp_path
+    ):
+        import re
+
+        rel = "modelmesh_tpu/placement/jax_engine.py"
+        src = (ROOT / rel).read_text()
+        assert "#: host-sync:" in src
+        clean = [
+            f for f in _real_tree_findings(tmp_path, {rel: src}, "jax")
+            if f.rule == "host-round-trip"
+        ]
+        assert not clean, [f.render() for f in clean]
+        stripped = re.sub(r"#: host-sync:.*$", "", src, flags=re.M)
+        reverted = _real_tree_findings(
+            tmp_path / "rev", {rel: stripped}, "jax"
+        )
+        assert any(
+            f.rule == "host-round-trip"
+            and f.qualname in ("finalize_plan", "dispatch_solve")
+            for f in reverted
+        ), (
+            "stripping every #: host-sync: annotation from jax_engine.py "
+            "must re-fire the rule on the finalize fetch — otherwise the "
+            "device-residency gate is vacuous"
         )
 
     def test_det_hash_fires_on_reverted_fake_runtime_sizing(self, tmp_path):

@@ -456,7 +456,14 @@ class TestEtcdFailFast:
                 inst.invoke_model("m-unknown", PREDICT_METHOD, b"x", [])
             assert time.monotonic() - t0 < 0.5
 
-            server2, _, _ = start_etcd_server(port=port, store=backing)
+            # Restart on a FRESH OS-assigned port and repoint the live
+            # client: rebinding the released port races every other
+            # process on the host for it under full-suite load (the bind
+            # silently succeeds-or-not), which is environmental noise,
+            # not the outage semantics under test. The watch pumps
+            # follow the channel swap on their next resubscribe.
+            server2, port2, _ = start_etcd_server(port=0, store=backing)
+            store.retarget(f"127.0.0.1:{port2}")
             inst._kv_failfast.clear()
             # Heal is not instant: the outage expired the instance's
             # session lease and may have failed the local copy; recovery
@@ -477,7 +484,20 @@ class TestEtcdFailFast:
                 f"record={inst.registry.get('m-pre')!r} "
                 f"cache={inst.cache.get('m-pre')!r}"
             )
-            inst.register_model("m-post", info)
+            # Registration needs the KV wire (m-pre's poll above can be
+            # satisfied from the local loaded copy): give the
+            # resubscribing watches and the fail-fast window a short
+            # bounded retry.
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    inst.register_model("m-post", info)
+                    break
+                except Exception:
+                    if time.monotonic() >= deadline:
+                        raise
+                    inst._kv_failfast.clear()
+                    time.sleep(0.2)
             out = inst.invoke_model("m-post", PREDICT_METHOD, b"x", [])
             assert out.payload.startswith(b"m-post:")
         finally:

@@ -30,6 +30,10 @@ Conventions understood across the rules:
   above) an attribute assignment declares a state-machine field whose
   every write outside the named transition methods (the "funnel") is a
   finding; ``__init__``-family constructors are exempt.
+- ``#: host-sync: <reason>`` on (or immediately above) a line declares
+  a DELIBERATE device->host materialization (the one batched per-cycle
+  readback, a host-built index array) for the host-round-trip rule,
+  which polices the solver steady-state path's device residency.
 """
 
 from __future__ import annotations
@@ -51,6 +55,9 @@ _ANNOTATION_RE = re.compile(
 # which greps the same grammar out of source at call time — keep the
 # two in sync or the static and dynamic checks stop pinning each other.
 WALL_CLOCK_RE = re.compile(r"#:\s*wall-clock:\s*(?P<why>\S.*)$")
+# Deliberate device->host materialization in the solver steady-state
+# path (host-round-trip rule, tools/analysis/jaxhazards.py).
+HOST_SYNC_RE = re.compile(r"#:\s*host-sync:\s*(?P<why>\S.*)$")
 _STATE_FUNNEL_RE = re.compile(
     r"#:\s*state-funnel:\s*(?P<methods>\w+(?:\s*,\s*\w+)*)"
 )
@@ -114,6 +121,8 @@ class ModuleInfo:
     suppressions: dict[int, set[str]] = field(default_factory=dict)
     # line -> justification for a deliberate wall-clock call site
     wall_clock: dict[int, str] = field(default_factory=dict)
+    # line -> justification for a deliberate device->host readback
+    host_sync: dict[int, str] = field(default_factory=dict)
     # lazily-built shared walk: every node paired with its innermost
     # enclosing function qualname (see walked())
     _walked: Optional[list] = field(default=None, repr=False)
@@ -161,6 +170,11 @@ class ModuleInfo:
         """A ``#: wall-clock:`` annotation on the line or the line above
         declares the call deliberately wall-time."""
         return line in self.wall_clock or (line - 1) in self.wall_clock
+
+    def host_sync_ok(self, line: int) -> bool:
+        """A ``#: host-sync:`` annotation on the line or the line above
+        declares the readback a deliberate host materialization."""
+        return line in self.host_sync or (line - 1) in self.host_sync
 
 
 class LockRegistry:
@@ -245,6 +259,9 @@ def load_module(path: str, repo_root: str) -> Optional[ModuleInfo]:
         w = WALL_CLOCK_RE.search(line)
         if w:
             mod.wall_clock[i] = w.group("why").strip()
+        h = HOST_SYNC_RE.search(line)
+        if h:
+            mod.host_sync[i] = h.group("why").strip()
     return mod
 
 

@@ -26,6 +26,16 @@ Three rules, scoped to the JAX-bearing subpackages:
   POSITIONAL data (the hash-noise draw and the scatter merge key off
   them), so hash-ordered indices make the leader's solve diverge from a
   follower's replay of the same snapshot.
+- ``host-round-trip`` (the solver steady-state path: every function in
+  placement/refresh_loop.py plus the jax_engine dispatch/finalize core,
+  ROUNDTRIP_FUNCS): a device->host materialization —
+  ``jax.device_get``, ``np.asarray(...)``, ``.block_until_ready()`` —
+  without a ``#: host-sync: <reason>`` annotation on the line (or the
+  line above). The refresh loop's device-residency contract is ONE
+  batched readback per cycle (the packed plan); every other sync is
+  either deliberate-and-annotated (a host-built index array, stats
+  delineation) or a regression that re-serializes the pipeline on
+  transfer latency.
 
 Jit detection: ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators,
 ``name = jax.jit(fn)`` bindings (the bound local ``fn`` is scanned for
@@ -50,10 +60,25 @@ TRACER_RULE = "jax-tracer-leak"
 SYNC_RULE = "jax-sync-under-lock"
 ITER_RULE = "jax-unordered-iter"
 INDEX_RULE = "jax-unordered-index"
+ROUNDTRIP_RULE = "host-round-trip"
 
 JAX_DIRS = ("modelmesh_tpu/ops/", "modelmesh_tpu/parallel/",
             "modelmesh_tpu/placement/")
 ITER_DIRS = ("modelmesh_tpu/ops/", "modelmesh_tpu/parallel/")
+
+# The solver steady-state path the device-residency contract covers:
+# every function in the pipelined refresh loop, plus the jax_engine
+# functions on the per-cycle dispatch/finalize spine. Module-scoped by
+# basename so the rule composes with test fixtures under tmp paths.
+ROUNDTRIP_ALL_FUNCS_FILES = ("placement/refresh_loop.py",)
+ROUNDTRIP_FUNCS_FILES = ("placement/jax_engine.py",)
+ROUNDTRIP_FUNCS = frozenset({
+    "dispatch_solve",
+    "finalize_plan",
+    "_solve_locked",
+    "_incremental_rows_locked",
+    "_compact_result",
+})
 
 # Sparse/incremental solver entry points whose index-column arguments
 # are positional data (the hash-noise draw and the merge scatter key
@@ -368,6 +393,67 @@ def _check_unordered_index(
     return findings
 
 
+def _host_sync_call(node: ast.Call) -> Optional[tuple[str, str]]:
+    """(token, description) if the call is a device->host sync point:
+    jax.device_get / bare device_get, np.asarray / numpy.asarray, or
+    block_until_ready (method or jax.block_until_ready(x))."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "block_until_ready":
+            return "block_until_ready", "block_until_ready()"
+        if fn.attr == "device_get":
+            return "device_get", "jax.device_get"
+        if fn.attr == "asarray" and isinstance(
+            fn.value, ast.Name
+        ) and fn.value.id in ("np", "numpy"):
+            return "np.asarray", "np.asarray materialization"
+    elif isinstance(fn, ast.Name) and fn.id == "device_get":
+        return "device_get", "device_get"
+    return None
+
+
+def _check_host_round_trip(mod: ModuleInfo) -> list[Finding]:
+    check_all = any(mod.relpath.endswith(f) for f in ROUNDTRIP_ALL_FUNCS_FILES)
+    by_name = any(mod.relpath.endswith(f) for f in ROUNDTRIP_FUNCS_FILES)
+    if not (check_all or by_name):
+        return []
+    findings = []
+    seen: set[tuple[int, str]] = set()
+    for cls, func in iter_functions(mod):
+        if not check_all and func.name not in ROUNDTRIP_FUNCS:
+            continue
+        qual = f"{cls}.{func.name}" if cls else func.name
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = _host_sync_call(node)
+            if hit is None:
+                continue
+            token, what = hit
+            # iter_functions also yields nested defs, whose bodies the
+            # enclosing walk already covered — report each site once.
+            if (node.lineno, token) in seen:
+                continue
+            seen.add((node.lineno, token))
+            if mod.host_sync_ok(node.lineno):
+                continue
+            findings.append(Finding(
+                rule=ROUNDTRIP_RULE,
+                path=mod.relpath,
+                line=node.lineno,
+                qualname=qual,
+                token=token,
+                message=(
+                    f"{what} in the solver steady-state path without a "
+                    f"'#: host-sync: <reason>' annotation — the refresh "
+                    f"loop's device-residency contract is one batched "
+                    f"readback per cycle; annotate the deliberate sync "
+                    f"or keep the state device-resident"
+                ),
+            ))
+    return findings
+
+
 def check(ctx: AnalysisContext) -> list[Finding]:
     findings: list[Finding] = []
     for mod in ctx.modules:
@@ -388,4 +474,5 @@ def check(ctx: AnalysisContext) -> list[Finding]:
             findings += _check_unordered_iter(mod, ctx, jitted_names)
         if in_jax_dir:
             findings += _check_unordered_index(mod, jitted_names)
+        findings += _check_host_round_trip(mod)
     return findings

@@ -629,6 +629,19 @@ def main() -> None:
                 f"bench: solver path measurement failed: {e}",
                 file=sys.stderr,
             )
+    # Macro fleet sim (MM_BENCH_MACRO=1): the event-driven modeled
+    # fleet's scenario matrix + million-user headline (bench_macro.py;
+    # CPU-only, no device involved). Failure must not lose the kernel
+    # line.
+    if envs.get_int("MM_BENCH_MACRO"):
+        try:
+            import bench_macro
+
+            result["macro"] = bench_macro.run()
+        except Exception as e:  # noqa: BLE001
+            print(
+                f"bench: macro measurement failed: {e}", file=sys.stderr
+            )
     # Steady-state refresh fast path: cold vs warm (pipelined + delta +
     # early exit) under churn. Failure must not lose the kernel line.
     if envs.get_int("MM_BENCH_STEADY"):

@@ -1,0 +1,325 @@
+"""Macro fleet bench: trace-driven closed-loop workload against the
+event-driven modeled fleet (sim/engine.py + sim/workload.py).
+
+Two parts, both machine-checked:
+
+* **Scenario matrix** — {diurnal, flash, churn} x {no-fault, kill}
+  x {legacy, burn} x {admission off, on} = 24 cells at small-fleet
+  scale (16 pods). Every cell carries in-cell invariants (request +
+  bytes conservation, a structural p99 ceiling, calm-cell attainment
+  and zero-shed bars, burn-reacts-to-flash); cross-cell directional
+  invariants compare admission on/off twins (the protected first SLO
+  class must attain at least as well with admission on, and must meet
+  an absolute 0.9 bar in the flash overload cells). The interaction
+  bugs PRs 11-15 could ship blind (autoscaler vs admission vs routing
+  feedback) land here as cell regressions.
+
+* **Headline** — 1000 modeled pods x 1M synthetic users x one full
+  virtual day on the diurnal profile (plus one flash crowd and one
+  mass-churn wave), burn authority + admission. Reported: wall
+  seconds against the stated budget (``MM_MACRO_WALL_BUDGET_S``),
+  engine events/sec, simulated requests, per-class p99/slo_attained,
+  and the replay digest.
+
+Run standalone (one JSON line, like bench.py):
+
+    python bench_macro.py            # matrix + headline
+    MM_MACRO_HEADLINE=0 python bench_macro.py   # matrix only
+
+or through the bench driver: ``MM_BENCH_MACRO=1 python bench.py``.
+The committed ``BENCH_MACRO_r*.json`` files carry the standalone
+envelope; tests/test_bench_trajectory.py pins their field contract.
+
+Determinism: cells and headline use fixed seeds; the digest in each
+cell is the bit-for-bit replay witness (tests/test_bench_macro.py
+re-runs one cell and asserts digest equality).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from modelmesh_tpu.sim.engine import FleetConfig
+from modelmesh_tpu.sim.workload import (
+    FaultOverlay,
+    FlashCrowd,
+    MassChurn,
+    WorkloadSpec,
+    run_macro,
+)
+from modelmesh_tpu.utils import envs
+
+SCHEMA = 1
+
+# Matrix scale: small enough that 24 cells stay in bench budget,
+# large enough that congestion, scale-up, and admission all engage.
+MATRIX_PODS = 16
+MATRIX_USERS = 400_000
+MATRIX_MODELS = 96
+MATRIX_DAY_S = 3_600
+MATRIX_SLOT_MS = 5_000
+MATRIX_SEED = 7
+MATRIX_SLO = "hi:p99<15ms;default:p99<40ms"
+MATRIX_CLASSES = (("hi", 0.2), ("default", 0.8))
+# Structural latency ceiling: service base + the congestion cap's worth
+# of queueing + cold-load tail slack. Nothing the model can emit should
+# exceed this; a breach means the congestion/cold-wait model broke.
+P99_CEILING_MS = 160.0
+# Calm cells (diurnal shape, no fault) must attain and never shed.
+CALM_ATTAIN_BAR = 0.95
+# Overload twins: the first (protected) SLO class with admission on.
+PROTECTED_ATTAIN_BAR = 0.9
+
+SHAPES = ("diurnal", "flash", "churn")
+FAULTS = ("none", "kill")
+AUTHORITIES = ("legacy", "burn")
+ADMISSIONS = (False, True)
+
+
+def _cell_spec(shape: str, fault: str) -> WorkloadSpec:
+    flash = ()
+    churn = ()
+    faults = ()
+    users = MATRIX_USERS
+    if shape == "flash":
+        flash = (FlashCrowd(at_ms=1_200_000, duration_ms=600_000,
+                            boost=60.0, n_models=4),)
+    elif shape == "churn":
+        churn = (MassChurn(at_ms=1_200_000, frac=0.25),
+                 MassChurn(at_ms=2_400_000, frac=0.25))
+    else:
+        users = MATRIX_USERS // 2  # calm diurnal: below the knee
+    if fault == "kill":
+        faults = (FaultOverlay(at_ms=1_800_000, kind="kill", frac=0.125),)
+    return WorkloadSpec(
+        users=users,
+        models=MATRIX_MODELS,
+        day_s=MATRIX_DAY_S,
+        slot_ms=MATRIX_SLOT_MS,
+        think_ms=5_000.0,
+        classes=MATRIX_CLASSES,
+        flash=flash,
+        churn=churn,
+        faults=faults,
+    )
+
+
+def _check_cell(name: str, shape: str, fault: str, authority: str,
+                admission: bool, out: dict) -> dict[str, list[str]]:
+    """In-cell machine-checked invariants; violations keyed by check."""
+    checks: dict[str, list[str]] = {}
+    checks["conservation"] = list(out["conservation_violations"])
+    v: list[str] = []
+    if out["p99_ms"] > P99_CEILING_MS:
+        v.append(f"p99 {out['p99_ms']}ms > structural ceiling "
+                 f"{P99_CEILING_MS}ms")
+    for cls, c in out["classes"].items():
+        if c["p99_ms"] > P99_CEILING_MS:
+            v.append(f"{cls} p99 {c['p99_ms']}ms > ceiling")
+    checks["p99_ceiling"] = v
+    v = []
+    if out["served"] == 0:
+        v.append("vacuous cell: zero served requests")
+    if out["offered"] < MATRIX_DAY_S:  # << users * day / think
+        v.append(f"vacuous cell: offered={out['offered']}")
+    checks["non_vacuous"] = v
+    if shape == "diurnal" and fault == "none":
+        v = []
+        for cls, c in out["classes"].items():
+            if c["slo_attained"] < CALM_ATTAIN_BAR:
+                v.append(
+                    f"calm cell: {cls} slo_attained "
+                    f"{c['slo_attained']:.3f} < {CALM_ATTAIN_BAR}"
+                )
+        if out["shed"] != 0:
+            v.append(f"calm cell shed {out['shed']} != 0")
+        checks["calm_attainment"] = v
+    if not admission and out["shed"] != 0:
+        checks["no_admission_no_shed"] = [
+            f"admission off but shed={out['shed']}"
+        ]
+    if shape == "flash" and authority == "burn":
+        if out["fleet"]["scale_up"] == 0:
+            checks["burn_reacts_to_flash"] = [
+                "flash crowd produced zero burn scale-ups"
+            ]
+    return {k: val for k, val in checks.items() if True}
+
+
+def _cross_checks(cells: list[dict]) -> dict[str, list[str]]:
+    """Directional invariants across admission on/off twins."""
+    by_key = {
+        (c["shape"], c["fault"], c["authority"], c["admission"]): c
+        for c in cells
+    }
+    protected = MATRIX_CLASSES[0][0]
+    v_dir: list[str] = []
+    v_bar: list[str] = []
+    for shape in SHAPES:
+        for fault in FAULTS:
+            for auth in AUTHORITIES:
+                on = by_key[(shape, fault, auth, True)]
+                off = by_key[(shape, fault, auth, False)]
+                att_on = on["classes"][protected]["slo_attained"]
+                att_off = off["classes"][protected]["slo_attained"]
+                # Tolerance 0.15: the twins' RNG streams diverge (the
+                # closed loop feeds latency back into arrivals), so
+                # cells hovering at the attainment threshold jitter by
+                # a few windows; the check catches admission actively
+                # HARMING the protected class, not window noise.
+                if att_on + 0.15 < att_off:
+                    v_dir.append(
+                        f"{shape}/{fault}/{auth}: {protected} attained "
+                        f"{att_on:.3f} with admission < {att_off:.3f} "
+                        "without"
+                    )
+                if shape == "flash" and att_on < PROTECTED_ATTAIN_BAR:
+                    v_bar.append(
+                        f"{shape}/{fault}/{auth}: protected class "
+                        f"attained {att_on:.3f} < {PROTECTED_ATTAIN_BAR} "
+                        "with admission on"
+                    )
+    return {
+        "admission_protects_first_class": v_dir,
+        "flash_protected_bar": v_bar,
+    }
+
+
+def run_matrix() -> dict:
+    cells: list[dict] = []
+    t0 = time.perf_counter()  #: wall-clock: bench measures real runtime
+    for shape in SHAPES:
+        for fault in FAULTS:
+            spec = _cell_spec(shape, fault)
+            for authority in AUTHORITIES:
+                for admission in ADMISSIONS:
+                    cfg = FleetConfig(
+                        authority=authority,
+                        admission=admission,
+                        slo_spec=MATRIX_SLO,
+                    )
+                    name = (
+                        f"{shape}/{fault}/{authority}/"
+                        f"adm={'on' if admission else 'off'}"
+                    )
+                    out = run_macro(
+                        spec, MATRIX_PODS, cfg, seed=MATRIX_SEED
+                    )
+                    cell = {
+                        "cell": name,
+                        "shape": shape,
+                        "fault": fault,
+                        "authority": authority,
+                        "admission": admission,
+                        "offered": out["offered"],
+                        "served": out["served"],
+                        "shed": out["shed"],
+                        "failed": out["failed"],
+                        "p99_ms": out["p99_ms"],
+                        "classes": out["classes"],
+                        "fleet": out["fleet"],
+                        "digest": out["digest"],
+                        "checks": _check_cell(
+                            name, shape, fault, authority, admission, out
+                        ),
+                    }
+                    cells.append(cell)
+    cross = _cross_checks(cells)
+    failures = sum(
+        len(v) for c in cells for v in c["checks"].values()
+    ) + sum(len(v) for v in cross.values())
+    return {
+        "cells": cells,
+        "cross_checks": cross,
+        "checks_failed": failures,
+        "wall_s": round(time.perf_counter() - t0, 2),  #: wall-clock: bench measures real runtime
+        "params": {
+            "pods": MATRIX_PODS, "users": MATRIX_USERS,
+            "models": MATRIX_MODELS, "day_s": MATRIX_DAY_S,
+            "slo": MATRIX_SLO, "seed": MATRIX_SEED,
+        },
+    }
+
+
+def run_headline() -> dict:
+    pods = envs.get_int("MM_MACRO_PODS")
+    users = envs.get_int("MM_MACRO_USERS")
+    day_s = envs.get_int("MM_MACRO_DAY_S")
+    budget_s = envs.get_int("MM_MACRO_WALL_BUDGET_S")
+    spec = WorkloadSpec(
+        users=users,
+        models=2_048,
+        day_s=day_s,
+        slot_ms=10_000,
+        think_ms=20_000.0,
+        classes=(("hi", 0.1), ("default", 0.9)),
+        flash=(FlashCrowd(at_ms=day_s * 250, duration_ms=1_800_000,
+                          boost=50.0, n_models=8),),
+        churn=(MassChurn(at_ms=day_s * 500, frac=0.1),),
+    )
+    cfg = FleetConfig(
+        authority="burn", admission=True,
+        slo_spec="hi:p99<25ms;default:p99<100ms",
+    )
+    t0 = time.perf_counter()  #: wall-clock: the headline IS a wall-clock claim
+    out = run_macro(spec, pods, cfg, seed=1_700)
+    wall = time.perf_counter() - t0  #: wall-clock: the headline IS a wall-clock claim
+    checks: dict[str, list[str]] = {
+        "conservation": list(out["conservation_violations"]),
+        "wall_budget": (
+            [] if wall <= budget_s
+            else [f"headline wall {wall:.1f}s > budget {budget_s}s"]
+        ),
+        "diurnal_exercised": (
+            [] if out["requests_simulated"] >= users
+            else [f"requests_simulated {out['requests_simulated']} "
+                  "< one per user"]
+        ),
+    }
+    return {
+        "pods": pods,
+        "users": users,
+        "virtual_day_s": day_s,
+        "models": spec.models,
+        "wall_s": round(wall, 2),
+        "wall_budget_s": budget_s,
+        "requests_simulated": out["requests_simulated"],
+        "engine_events": out["engine_events"],
+        "engine_events_per_s": round(out["engine_events"] / wall, 1),
+        "requests_per_wall_s": round(out["requests_simulated"] / wall, 1),
+        "offered": out["offered"],
+        "served": out["served"],
+        "shed": out["shed"],
+        "failed": out["failed"],
+        "p50_ms": out["p50_ms"],
+        "p99_ms": out["p99_ms"],
+        "classes": out["classes"],
+        "fleet": out["fleet"],
+        "digest": out["digest"],
+        "checks": checks,
+        "checks_failed": sum(len(v) for v in checks.values()),
+    }
+
+
+def run() -> dict:
+    """bench.py entry point (MM_BENCH_MACRO=1)."""
+    result: dict = {"macro_schema": SCHEMA}
+    result["matrix"] = run_matrix()
+    if envs.get_int("MM_MACRO_HEADLINE"):
+        result["headline"] = run_headline()
+    result["checks_failed"] = result["matrix"]["checks_failed"] + (
+        result.get("headline", {}).get("checks_failed", 0)
+    )
+    return result
+
+
+def main() -> int:
+    result = run()
+    print(json.dumps(result))
+    return 1 if result["checks_failed"] else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

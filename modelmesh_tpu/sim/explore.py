@@ -141,13 +141,72 @@ def run_seed(
     )
 
 
+def _run_named_scenario(name: str, step_ms: int, trace: bool) -> int:
+    """--scenario NAME: one scripted scenario from sim/scenarios.py."""
+    from modelmesh_tpu.sim import scenarios
+
+    factory = scenarios.BY_NAME.get(name)
+    if factory is None:
+        print(f"unknown scenario {name!r}; available:")
+        for n in sorted(scenarios.BY_NAME):
+            print(f"  {n}")
+        return 2
+    result = run_scenario(factory(), step_ms=step_ms)
+    status = "PASS" if result.ok else "FAIL"
+    print(f"[{status}] {result.name} wall={result.wall_s:.1f}s")
+    if trace or not result.ok:
+        print(result.render())
+    if not result.ok:
+        print(
+            f"REPLAY: python -m modelmesh_tpu.sim --scenario {name} "
+            f"--step-ms {step_ms}"
+        )
+    return 0 if result.ok else 1
+
+
+def _run_macro(args) -> int:
+    """--macro: closed-loop workload-generator run on the modeled
+    fleet (sim/engine.py + sim/workload.py) — the CLI door to the
+    macro-bench's machinery at hand-picked scale."""
+    import json
+
+    from modelmesh_tpu.sim.engine import FleetConfig
+    from modelmesh_tpu.sim.workload import WorkloadSpec, run_macro
+
+    seed = args.seed if args.seed is not None else 0
+    spec = WorkloadSpec(
+        users=args.users,
+        models=args.models,
+        day_s=args.day_s,
+        classes=(("hi", 0.2), ("default", 0.8)),
+    )
+    cfg = FleetConfig(
+        authority=args.authority,
+        admission=args.admission,
+        slo_spec="hi:p99<25ms;default:p99<100ms",
+    )
+    out = run_macro(spec, args.pods, cfg, seed=seed)
+    print(json.dumps(out))
+    if out["conservation_violations"]:
+        print(
+            f"REPLAY: python -m modelmesh_tpu.sim --macro --seed {seed} "
+            f"--pods {args.pods} --users {args.users} "
+            f"--models {args.models} --day-s {args.day_s} "
+            f"--authority {args.authority}"
+            + (" --admission" if args.admission else "")
+        )
+        return 1
+    return 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     from modelmesh_tpu.utils.envs import get_int
 
     parser = argparse.ArgumentParser(
         prog="python -m modelmesh_tpu.sim",
         description="Deterministic cluster simulation: seeded random "
-        "fault exploration with invariant checking.",
+        "fault exploration with invariant checking, scripted scenarios "
+        "by name, or the macro workload generator.",
     )
     parser.add_argument("--seed", type=int, default=None,
                         help="base seed (default: MM_SIM_SEED)")
@@ -160,7 +219,30 @@ def main(argv: Optional[list[str]] = None) -> int:
                         help="virtual ms advanced per runner tick")
     parser.add_argument("--trace", action="store_true",
                         help="print the full event trace even on success")
+    parser.add_argument("--scenario", metavar="NAME", default=None,
+                        help="run ONE scripted scenario by name "
+                        "(sim/scenarios.py; unknown name lists all)")
+    parser.add_argument("--macro", action="store_true",
+                        help="run the closed-loop macro workload on the "
+                        "modeled fleet instead of fault exploration")
+    parser.add_argument("--pods", type=int, default=16,
+                        help="[--macro] modeled fleet size")
+    parser.add_argument("--users", type=int, default=100_000,
+                        help="[--macro] closed-loop synthetic users")
+    parser.add_argument("--models", type=int, default=256,
+                        help="[--macro] registered model count")
+    parser.add_argument("--day-s", type=int, default=3_600,
+                        help="[--macro] virtual seconds simulated")
+    parser.add_argument("--authority", default="burn",
+                        choices=("legacy", "burn", "off"),
+                        help="[--macro] autoscale authority mode")
+    parser.add_argument("--admission", action="store_true",
+                        help="[--macro] enable modeled admission control")
     args = parser.parse_args(argv)
+    if args.scenario is not None:
+        return _run_named_scenario(args.scenario, args.step_ms, args.trace)
+    if args.macro:
+        return _run_macro(args)
     seed = args.seed if args.seed is not None else get_int("MM_SIM_SEED")
     steps = args.steps if args.steps is not None else get_int("MM_SIM_STEPS")
 

@@ -46,6 +46,7 @@ from modelmesh_tpu.serving.instance import (
 )
 from modelmesh_tpu.serving.tasks import BackgroundTasks, TaskConfig
 from modelmesh_tpu.sim.kv import SimKV, SimKVConfig
+from modelmesh_tpu.sim.ringlog import RingLog
 from modelmesh_tpu.utils import clock as _clock
 
 log = logging.getLogger(__name__)
@@ -282,8 +283,9 @@ class SimCluster:
         # SLO invariant read this — "no demanded model unserved at any
         # virtual instant" and "p99 within objective at every
         # checkpoint" are asserted over the observed probe traffic, not
-        # just quiescence.
-        self.request_log: list[tuple[int, str, bool, str, int]] = []
+        # just quiescence. Bounded ring (MM_SIM_LOG_EVENTS): unbounded
+        # per-probe accumulation is a memory blowup at macro scale.
+        self.request_log = RingLog()
         # instance_id -> virtual ms it died (kill or post-drain); the
         # runner merges this into the dead-placement grace bookkeeping
         # for deaths IT didn't schedule (e.g. rolling-upgrade waves).
@@ -315,8 +317,9 @@ class SimCluster:
         # Batched data plane observability: one row per batched runtime
         # dispatch — (virtual_ms, instance_id, batch_size, distinct
         # models). Scenario checks assert the queue/flush state machine
-        # coalesced concurrent requests under virtual time.
-        self.batch_dispatches: list[tuple[int, str, int, int]] = []
+        # coalesced concurrent requests under virtual time. Same bound
+        # as request_log.
+        self.batch_dispatches = RingLog()
         self._n = 0
         for _ in range(n):
             self.add_instance(

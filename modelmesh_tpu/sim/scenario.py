@@ -65,6 +65,7 @@ import time as _wall
 from typing import Optional
 
 from modelmesh_tpu.serving.tasks import TaskConfig
+from modelmesh_tpu.sim.engine import EventLoop
 from modelmesh_tpu.sim.harness import SimCluster
 from modelmesh_tpu.sim.kv import SimKVConfig
 from modelmesh_tpu.sim import invariants
@@ -329,16 +330,27 @@ class ScenarioRunner:
                 events = sorted(
                     sc.events, key=lambda e: (e.at_ms, e.kind, e.args)
                 )
-                idx = 0
-                while clock.now_ms() - start < sc.horizon_ms:
-                    now_rel = clock.now_ms() - start
-                    while idx < len(events) and events[idx].at_ms <= now_rel:
-                        self._fire(cluster, clock, events[idx])
-                        idx += 1
-                    clock.advance(self.step_ms)
-                    _wall.sleep(self.yield_s)  #: wall-clock: yields the advancing thread so product threads run between virtual steps
-                for ev in events[idx:]:
-                    self._fire(cluster, clock, ev)
+                # Scripted events ride the shared event-driven core
+                # (sim/engine.py): the loop owns the heap and drives the
+                # clock in bridged mode — bounded steps with a wall
+                # yield each, so full-fidelity pod threads woken by the
+                # advance run between steps (the historical drive loop,
+                # now one implementation shared with the macro path).
+                # Scheduling in sorted order preserves the firing order
+                # (the heap tie-breaks equal due times by schedule seq).
+                loop = EventLoop(clock)
+                for ev in events:
+                    loop.schedule_at(
+                        start + ev.at_ms, self._fire, cluster, clock, ev
+                    )
+                loop.run(
+                    start + sc.horizon_ms,
+                    step_ms=self.step_ms,
+                    yield_s=self.yield_s,
+                )
+                # Events scheduled at/past the horizon still fire (the
+                # pre-engine runner flushed its remaining schedule too).
+                loop.drain()
                 # Quiesce: heal every partition (a permanently-partitioned
                 # store has no convergence obligations), then give the
                 # protocol its reconciliation window.

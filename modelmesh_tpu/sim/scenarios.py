@@ -1200,6 +1200,11 @@ ALL = (
 )
 
 
+# Name -> factory, for the CLI (python -m modelmesh_tpu.sim --scenario
+# NAME) and anything else that addresses scripted scenarios by name.
+BY_NAME = {factory.__name__: factory for factory in ALL}
+
+
 def run_all(step_ms: int = 1_000) -> list[ScenarioResult]:
     results = []
     for factory in ALL:

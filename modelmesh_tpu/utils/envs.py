@@ -314,6 +314,36 @@ REGISTRY: dict[str, EnvVar] = {
         EnvVar("MM_SIM_STEPS", "int", "40",
                "random fault/workload events generated per simulated "
                "scenario seed", "sim/explore.py"),
+        EnvVar("MM_SIM_LOG_EVENTS", "int", "262144",
+               "bound on SimCluster's per-request and batch-dispatch "
+               "observation rings (total-order seq retained; 0 = "
+               "unbounded, the pre-ring behavior) — macro-scale runs "
+               "must not accumulate per-probe rows forever",
+               "sim/ringlog.py"),
+        EnvVar("MM_BENCH_MACRO", "int", "0",
+               "run the macro fleet bench (bench_macro.py: scenario "
+               "matrix + million-user headline on the event-driven "
+               "modeled fleet) as part of bench.py",
+               "bench.py"),
+        EnvVar("MM_MACRO_HEADLINE", "int", "1",
+               "include the 1000-pod x 1M-user x virtual-day headline "
+               "in bench_macro.py (0 = scenario matrix only; the "
+               "matrix is the cheap machine-checked part)",
+               "bench_macro.py"),
+        EnvVar("MM_MACRO_PODS", "int", "1000",
+               "modeled fleet size for the macro headline",
+               "bench_macro.py"),
+        EnvVar("MM_MACRO_USERS", "int", "1000000",
+               "closed-loop synthetic users for the macro headline",
+               "bench_macro.py"),
+        EnvVar("MM_MACRO_DAY_S", "int", "86400",
+               "virtual seconds the macro headline simulates (default "
+               "one full day: the diurnal profile's native period)",
+               "bench_macro.py"),
+        EnvVar("MM_MACRO_WALL_BUDGET_S", "int", "900",
+               "stated wall-clock budget for the macro headline on the "
+               "2-core CPU box; the bench reports a violation (not a "
+               "crash) when exceeded", "bench_macro.py"),
         EnvVar("MM_SOLVER_AUCTION_STALL_TOL", "float", "",
                "auction early-exit stall tolerance: per-round price "
                "movement (price units) and best-overflow improvement "

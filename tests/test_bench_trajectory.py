@@ -109,3 +109,77 @@ class TestBenchTrajectoryContract:
             assert incr.get("dirty_rows", 0) > 0, (
                 f"{path.name}: incremental samples without dirty_rows"
             )
+
+
+# ---------------------------------------------------------------------------
+# Macro-bench trajectory (BENCH_MACRO_r*.json, from r01 / PR 18)
+# ---------------------------------------------------------------------------
+
+MACRO_FILES = sorted(ROOT.glob("BENCH_MACRO_r*.json"))
+
+HEADLINE_FIELDS = (
+    "pods", "users", "virtual_day_s", "wall_s", "wall_budget_s",
+    "requests_simulated", "engine_events", "engine_events_per_s",
+    "requests_per_wall_s", "digest", "checks_failed",
+)
+
+
+@pytest.mark.skipif(not MACRO_FILES, reason="no BENCH_MACRO_r*.json yet")
+class TestBenchMacroTrajectoryContract:
+    """Same envelope as BENCH_r*.json around ``bench_macro.py``'s one
+    JSON line: the matrix cell grid with machine-checked invariants
+    plus the million-user headline with its wall-clock budget."""
+
+    @pytest.mark.parametrize("path", MACRO_FILES, ids=lambda p: p.name)
+    def test_envelope_shape(self, path):
+        doc = json.loads(path.read_text())
+        for key in ("n", "cmd", "rc", "parsed"):
+            assert key in doc, f"{path.name} missing envelope key {key!r}"
+        assert doc["rc"] == 0, f"{path.name} recorded a failing macro run"
+        assert isinstance(doc["parsed"], dict)
+
+    @pytest.mark.parametrize("path", MACRO_FILES, ids=lambda p: p.name)
+    def test_matrix_cells(self, path):
+        parsed = json.loads(path.read_text())["parsed"]
+        matrix = parsed.get("matrix")
+        assert matrix, f"{path.name}: no scenario matrix"
+        cells = matrix.get("cells", [])
+        # Full cross: >= 3 shapes x 2 faults x 2 authorities x 2
+        # admission modes (the ISSUE's acceptance floor).
+        assert len(cells) >= 24, f"{path.name}: only {len(cells)} cells"
+        for cell in cells:
+            for key in ("shape", "fault", "authority", "admission",
+                        "checks", "p99_ms", "classes"):
+                assert key in cell, (
+                    f"{path.name}: cell {cell.get('name')} missing {key!r}"
+                )
+            for check, violations in cell["checks"].items():
+                assert violations == [], (
+                    f"{path.name}: {cell.get('name')} failed {check}: "
+                    f"{violations}"
+                )
+        shapes = {c["shape"] for c in cells}
+        assert {"diurnal", "flash", "churn"} <= shapes
+        assert {c["authority"] for c in cells} >= {"legacy", "burn"}
+        assert {c["admission"] for c in cells} == {False, True}
+        for check, violations in matrix.get("cross_checks", {}).items():
+            assert violations == [], (
+                f"{path.name}: cross-check {check} failed: {violations}"
+            )
+
+    @pytest.mark.parametrize("path", MACRO_FILES, ids=lambda p: p.name)
+    def test_headline_within_budget(self, path):
+        parsed = json.loads(path.read_text())["parsed"]
+        head = parsed.get("headline")
+        assert head, f"{path.name}: no million-user headline"
+        for field in HEADLINE_FIELDS:
+            assert field in head, f"{path.name}: headline missing {field!r}"
+        assert head["checks_failed"] == 0
+        assert head["wall_s"] <= head["wall_budget_s"], (
+            f"{path.name}: headline wall {head['wall_s']}s blew the "
+            f"{head['wall_budget_s']}s budget"
+        )
+        assert head["pods"] >= 1_000
+        assert head["users"] >= 1_000_000
+        assert head["virtual_day_s"] >= 86_400
+        assert len(head["digest"]) == 64

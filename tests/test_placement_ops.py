@@ -123,10 +123,16 @@ class TestAuction:
         reason="documented pre-existing failure, DEFERRED in PR 12 (see "
                "CHANGES.md): the auction solver's stickiness-vs-balance "
                "cost surface at small dense shapes lands ~46/64 stays vs "
-               "the 0.9 bar; touching the cost surface risks invalidating "
-               "PR-11's bitwise parity gates, so the fix is its own PR. "
-               "strict=False: a solver change that happens to fix it "
-               "should not turn tier-1 red.",
+               "the 0.9 bar. RE-MEASURED at PR 18 after sparse dispatch "
+               "became the default (PR 16): still exactly 46/64 (0.72) — "
+               "unchanged, because 64x8 sits below the auto-sparse gate "
+               "(m_pad >= 192) and still routes through the dense tier, "
+               "so the sparse default never touches this shape's cost "
+               "surface. The fix remains a deliberate cost-surface "
+               "change (risks invalidating PR-11's bitwise parity "
+               "gates), deferred to its own PR. strict=False: a solver "
+               "change that happens to fix it should not turn tier-1 "
+               "red.",
     )
     def test_prefers_existing_placement(self):
         # With everything else equal, models already loaded somewhere stay.

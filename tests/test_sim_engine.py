@@ -1,0 +1,493 @@
+"""Event-driven simulation core: EventLoop semantics, modeled-fleet
+mechanics, and the modeled-vs-full PARITY GATE.
+
+The parity tests are the contract that keeps ``sim/engine.py`` honest:
+every FleetConfig default claims to be calibrated against a named piece
+of the real stack, and these tests re-derive the claim from the real
+code — sizing bit-for-bit against SimLoader, burn arithmetic against
+SloTracker, and the copy-count trajectory against a real SimCluster
+driven through the same demand. If a control-plane default changes,
+the parity test fails here before the macro-bench silently drifts.
+"""
+
+import math
+import zlib
+
+import pytest
+
+from modelmesh_tpu.observability.slo import SloTracker, parse_slo_spec
+from modelmesh_tpu.sim.engine import (
+    EventLoop,
+    FleetConfig,
+    ModeledFleet,
+    _BurnWindow,
+    model_size_bytes,
+)
+from modelmesh_tpu.utils import clock as clock_mod
+from modelmesh_tpu.utils.clock import VirtualClock
+
+
+@pytest.fixture()
+def vclock():
+    clock = VirtualClock()
+    prev = clock_mod.install(clock)
+    try:
+        yield clock
+    finally:
+        clock_mod.install(prev)
+        clock.close()
+
+
+# ---------------------------------------------------------------------------
+# EventLoop
+# ---------------------------------------------------------------------------
+
+
+class TestEventLoop:
+    def test_pure_mode_fires_in_due_seq_order(self):
+        loop = EventLoop()
+        t0 = loop.now_ms
+        fired = []
+        # Same due time -> schedule order breaks the tie; later due
+        # times fire later regardless of schedule order.
+        loop.schedule_at(t0 + 300, fired.append, ("late", None))
+        loop.schedule_at(t0 + 100, fired.append, ("a", None))
+        loop.schedule_at(t0 + 100, fired.append, ("b", None))
+        loop.schedule_in(200, fired.append, ("c", None))
+        loop.run(t0 + 1_000)
+        assert [x[0] for x in fired] == ["a", "b", "c", "late"]
+        # Pure mode lands EXACTLY on the horizon, never past it.
+        assert loop.now_ms == t0 + 1_000
+        assert loop.clock.now_ms() == t0 + 1_000
+        assert loop.events_processed == 4
+
+    def test_pure_mode_jumps_to_due_times(self):
+        loop = EventLoop()
+        t0 = loop.now_ms
+        stamps = []
+        loop.schedule_at(t0 + 250, lambda: stamps.append(loop.now_ms - t0))
+        loop.schedule_at(t0 + 777, lambda: stamps.append(loop.now_ms - t0))
+        loop.run(t0 + 10_000)
+        # The clock lands exactly on each due time (no step grid).
+        assert stamps == [250, 777]
+
+    def test_cancel_and_pending(self):
+        loop = EventLoop()
+        t0 = loop.now_ms
+        fired = []
+        ev = loop.schedule_at(t0 + 100, fired.append, 1)
+        loop.schedule_at(t0 + 200, fired.append, 2)
+        assert loop.pending() == 2
+        EventLoop.cancel(ev)
+        assert loop.pending() == 1
+        loop.run(t0 + 500)
+        assert fired == [2]
+
+    def test_handler_scheduling_within_horizon_fires_same_run(self):
+        loop = EventLoop()
+        t0 = loop.now_ms
+        fired = []
+
+        def chain(depth):
+            fired.append(loop.now_ms - t0)
+            if depth:
+                loop.schedule_in(100, chain, depth - 1)
+
+        loop.schedule_at(t0 + 100, chain, 3)
+        loop.run(t0 + 1_000)
+        assert fired == [100, 200, 300, 400]
+
+    def test_bridged_mode_quantizes_to_step_grid(self, vclock):
+        """Bridged semantics are the historical ScenarioRunner drive
+        loop: events fire when a full step lands at/past their due
+        time, and the horizon may overshoot by up to one step."""
+        loop = EventLoop(vclock)
+        t0 = loop.now_ms
+        stamps = []
+        loop.schedule_at(t0 + 150, lambda: stamps.append(loop.now_ms - t0))
+        loop.schedule_at(t0 + 400, lambda: stamps.append(loop.now_ms - t0))
+        loop.run(t0 + 950, step_ms=100)
+        # due=+150 observed at the +200 grid line; due=+400 on its line.
+        assert stamps == [200, 400]
+        # Horizon +950 on a 100ms grid: the clock overshoots to +1000.
+        assert loop.now_ms == t0 + 1_000
+
+    def test_drain_fires_leftovers_at_current_time(self):
+        loop = EventLoop()
+        t0 = loop.now_ms
+        fired = []
+        loop.schedule_at(t0 + 5_000, lambda: fired.append(loop.now_ms - t0))
+        loop.run(t0 + 1_000)
+        assert fired == []
+        loop.drain()
+        # Past-horizon leftovers fire anyway, at the frozen clock.
+        assert fired == [1_000]
+        assert loop.pending() == 0
+
+
+# ---------------------------------------------------------------------------
+# Parity gate: modeled constants vs the real stack
+# ---------------------------------------------------------------------------
+
+
+class TestParitySizing:
+    def test_model_size_matches_simloader_bit_for_bit(self):
+        """engine.model_size_bytes must reproduce SimLoader._size_for
+        exactly — the macro fleet's capacity pressure (evictions,
+        placement failures) is only comparable to the full sim if every
+        model weighs the same in both."""
+        from modelmesh_tpu.sim.harness import SimLoader
+
+        loader = SimLoader(default_size_bytes=8 << 20)
+        for i in range(200):
+            mid = f"parity-m-{i}"
+            assert model_size_bytes(mid, 8 << 20) == loader._size_for(mid), mid
+
+    def test_size_formula_source(self):
+        # The shared formula, stated once: crc32 spread in [0.5, 1.5).
+        h = zlib.crc32(b"x-model") % 1000
+        assert model_size_bytes("x-model", 1000) == int(1000 * (0.5 + h / 1000.0))
+
+
+class TestParityBurn:
+    def test_burn_window_matches_slotracker(self, vclock):
+        """The modeled _BurnWindow aggregates (bad, total) per slot; the
+        real SloTracker records per request. On an identical stream the
+        burn rates must agree exactly — the burn authority's modeled
+        decisions are otherwise incomparable to production's."""
+        spec = "default:p99<100ms"
+        obj = parse_slo_spec(spec)["default"]
+        tracker = SloTracker(spec=spec, window_ms=60_000)
+        win = _BurnWindow()
+        # 12 slots of 1s: 40 requests each, a varying slice over-bound.
+        for slot in range(12):
+            bad = (3 * slot) % 11
+            for i in range(40):
+                lat = 500.0 if i < bad else 5.0
+                tracker.record("default", lat, ok=True)
+            win.observe(vclock.now_ms(), bad, 40)
+            vclock.advance(1_000)
+        snap = tracker.attainment("default")
+        burn = win.burn(
+            vclock.now_ms(), 60_000, obj.good_target, min_samples=5
+        )
+        assert burn is not None
+        assert burn == pytest.approx(snap.burn_rate, rel=1e-9)
+
+    def test_burn_window_min_samples_gate(self):
+        win = _BurnWindow()
+        win.observe(0, 1, 3)
+        assert win.burn(1_000, 60_000, 0.99, min_samples=5) is None
+        win.observe(10, 0, 2)
+        assert win.burn(1_000, 60_000, 0.99, min_samples=5) is not None
+
+    def test_burn_window_prunes_trailing_window(self):
+        win = _BurnWindow()
+        win.observe(0, 10, 10)        # all-bad, will age out
+        win.observe(100_000, 0, 10)   # all-good, in window
+        burn = win.burn(100_000, 60_000, 0.99, min_samples=5)
+        assert burn == pytest.approx(0.0)
+
+
+class TestParityCopyCount:
+    """The headline parity gate: a real SimCluster and a ModeledFleet
+    fed the same sustained per-model demand under the same scale-up
+    threshold must land on the same copy count (+-1)."""
+
+    RPM_TARGET = 48          # sustained demand, requests/min
+    SCALE_UP_RPM = 30        # per-copy threshold both sides share
+
+    def _real_copies(self) -> int:
+        from modelmesh_tpu.serving.tasks import TaskConfig
+        from modelmesh_tpu.sim.harness import SimCluster
+
+        clock = VirtualClock()
+        prev = clock_mod.install(clock)
+        cluster = SimCluster(
+            n=3, start_tasks=False, load_delay_ms=0.0,
+            task_config=TaskConfig(scale_up_rpm=self.SCALE_UP_RPM),
+        )
+        try:
+            for pod in cluster.pods:
+                pod.instance._election.close()
+            holder = cluster.pods[0]
+            cluster.register("m-parity")
+            holder.instance.ensure_loaded("m-parity", sync=False)
+            import time as _wall
+
+            deadline = _wall.monotonic() + 5.0
+            while not holder.instance.loader.is_loaded("m-parity"):
+                assert _wall.monotonic() < deadline, "copy never loaded"
+                _wall.sleep(0.01)  #: wall-clock: async load worker runs on real threads
+            # 5 virtual minutes at RPM_TARGET: fills the 5-minute
+            # RateTracker window the real rate task reads.
+            per_min = self.RPM_TARGET
+            for _ in range(5):
+                for _ in range(per_min):
+                    cluster.invoke("m-parity", via=holder.iid)
+                clock.advance(60_000)
+            holder.instance.is_leader = True
+            holder.tasks._rate_tick()
+            deadline = _wall.monotonic() + 5.0
+            while True:
+                mr = holder.instance.registry.get("m-parity")
+                if mr is not None and len(mr.instance_ids) >= 2:
+                    break
+                assert _wall.monotonic() < deadline, (
+                    "real rate task never scaled up"
+                )
+                _wall.sleep(0.01)  #: wall-clock: async scale-up load runs on real threads
+            return len(holder.instance.registry.get("m-parity").instance_ids)
+        finally:
+            cluster.close()
+            clock_mod.install(prev)
+            clock.close()
+
+    def _modeled_copies(self) -> int:
+        loop = EventLoop()
+        cfg = FleetConfig(
+            authority="legacy",
+            scale_up_rpm=self.SCALE_UP_RPM,
+            rate_interval_s=10.0,
+        )
+        fleet = ModeledFleet(loop, 3, cfg)
+        fleet.register("m-parity")
+        fleet.add_copy("m-parity", "pod-0")
+        slot_ms = 10_000
+        n_per_slot = self.RPM_TARGET * slot_ms // 60_000
+        t = loop.now_ms
+        horizon = t + 5 * 60_000
+        while t < horizon:
+            fleet.route_slot("m-parity", n_per_slot, slot_ms)
+            fleet.end_slot()
+            t += slot_ms
+            loop.run(t)
+        return len(fleet.models["m-parity"].holders)
+
+    def test_copy_count_trajectory_parity(self, vclock):
+        # vclock fixture unused directly; _real_copies installs its own
+        # so the modeled run here stays on the plain EventLoop clock.
+        real = self._real_copies()
+        modeled = self._modeled_copies()
+        # Demand at 1.6x the per-copy threshold: both controllers add a
+        # second copy and stop (2 copies halves per-copy rate below
+        # threshold). Tolerance +-1 absorbs rate-estimator shape
+        # differences (ring buckets vs EWMA).
+        assert modeled >= 2, "modeled rate authority never scaled up"
+        assert abs(real - modeled) <= 1, (real, modeled)
+
+
+# ---------------------------------------------------------------------------
+# Modeled-fleet mechanics
+# ---------------------------------------------------------------------------
+
+
+def _warm_fleet(n_pods=4, copies=2, cfg=None, mid="m-w", cls="default"):
+    loop = EventLoop()
+    fleet = ModeledFleet(loop, n_pods, cfg or FleetConfig(authority="off"))
+    fleet.register(mid, cls)
+    for i in range(copies):
+        assert fleet.add_copy(mid, f"pod-{i}")
+    # Past every load latency: copies flip active via the loop.
+    loop.run(loop.now_ms + 1_000)
+    return loop, fleet
+
+
+class TestModeledFleet:
+    def test_route_slot_conserves_requests(self):
+        _, fleet = _warm_fleet(n_pods=4, copies=3)
+        for n in (1, 2, 7, 100, 1_000, 9_999):
+            res = fleet.route_slot("m-w", n, 10_000)
+            assert res.served + res.shed + res.failed == n
+            assert sum(k for _, k in res.lat) == res.served
+            fleet.end_slot()
+
+    def test_water_fill_levels_load(self):
+        _, fleet = _warm_fleet(n_pods=3, copies=3)
+        # Pre-load one holder: water-filling must pour around it.
+        hot = fleet._inst("pod-0")
+        hot.load_ewma = 50.0
+        res = fleet.route_slot("m-w", 10_000, 10_000)
+        assert res.served == 10_000
+        loads = sorted(
+            (i.iid, i.slot_load) for i in fleet.instances if i.slot_load > 0
+        )
+        # The two cold holders absorb (nearly) all of it, evenly.
+        cold = [l for iid, l in loads if iid != "pod-0"]
+        assert len(cold) == 2
+        assert cold[0] == pytest.approx(cold[1], rel=0.15)
+        hot_share = dict(loads).get("pod-0", 0.0)
+        assert hot_share < cold[0] / 2
+
+    def test_single_holder_or_d1_herds(self):
+        cfg = FleetConfig(authority="off", route_d=1)
+        _, fleet = _warm_fleet(n_pods=3, copies=3, cfg=cfg)
+        fleet.route_slot("m-w", 900, 10_000)
+        loaded = [i for i in fleet.instances if i.slot_load > 0]
+        # Legacy d<=1: the whole slot lands on the single least-loaded
+        # winner (herding preserved on purpose).
+        assert len(loaded) == 1
+
+    def test_cold_route_waits_on_load_then_serves(self):
+        loop = EventLoop()
+        cfg = FleetConfig(authority="off")
+        fleet = ModeledFleet(loop, 2, cfg)
+        fleet.register("m-cold")
+        res = fleet.route_slot("m-cold", 10, 10_000)
+        # First flow triggers the demand load and waits for it.
+        assert res.served == 10
+        (lat, k), = res.lat
+        assert k == 10
+        assert lat >= cfg.load_delay_ms  # waited out the cold start
+        assert fleet.counters["loads_store"] == 1
+
+    def test_cold_route_times_out_to_failure(self):
+        loop = EventLoop()
+        cfg = FleetConfig(authority="off", load_delay_ms=60_000.0,
+                          load_timeout_ms=30_000)
+        fleet = ModeledFleet(loop, 2, cfg)
+        fleet.register("m-slow")
+        res = fleet.route_slot("m-slow", 5, 10_000)
+        assert res.failed == 5
+        assert fleet.counters["cold_fails"] == 5
+
+    def test_burn_authority_scales_up_on_burn(self):
+        loop = EventLoop()
+        cfg = FleetConfig(
+            authority="burn", slo_spec="default:p99<10ms",
+            min_burn_samples=5, autoscale_interval_s=1.0,
+        )
+        fleet = ModeledFleet(loop, 4, cfg)
+        fleet.register("m-burn")
+        fleet.add_copy("m-burn", "pod-0")
+        loop.run(loop.now_ms + 1_000)
+        t = loop.now_ms
+        for _ in range(8):
+            fleet.route_slot("m-burn", 200, 1_000)  # keeps rpm (demand) hot
+            fleet.end_slot()
+            # Every request over-bound: burn >> flash threshold.
+            fleet.observe_slot("default", t, bad=200, total=200)
+            t += 1_000
+            loop.run(t)
+        assert fleet.counters["scale_up"] >= 1
+        assert len(fleet.models["m-burn"].holders) >= 2
+
+    def test_burn_authority_scales_down_when_calm(self):
+        loop = EventLoop()
+        cfg = FleetConfig(
+            authority="burn", slo_spec="default:p99<100ms",
+            min_burn_samples=5, autoscale_interval_s=1.0,
+            idle_ticks_down=2, holddown_ms=0,
+        )
+        fleet = ModeledFleet(loop, 4, cfg)
+        fleet.register("m-calm")
+        fleet.add_copy("m-calm", "pod-0")
+        fleet.add_copy("m-calm", "pod-1")
+        loop.run(loop.now_ms + 1_000)
+        t = loop.now_ms
+        for _ in range(10):
+            fleet.observe_slot("default", t, bad=0, total=100)
+            t += 1_000
+            loop.run(t)
+        assert fleet.counters["scale_down"] >= 1
+        assert len(fleet.models["m-calm"].holders) == 1
+
+    def test_admission_throttles_burning_class_not_first(self):
+        loop = EventLoop()
+        cfg = FleetConfig(
+            authority="off", admission=True,
+            slo_spec="hi:p99<10ms;default:p99<10ms",
+            min_burn_samples=5,
+        )
+        fleet = ModeledFleet(loop, 3, cfg)
+        fleet.register("m-hi", "hi")
+        fleet.register("m-def", "default")
+        for mid in ("m-hi", "m-def"):
+            fleet.add_copy(mid, "pod-0")
+        loop.run(loop.now_ms + 1_000)
+        t = loop.now_ms
+        for _ in range(6):
+            # Both classes burning: only the non-first class sheds.
+            fleet.observe_slot("hi", t, bad=50, total=50)
+            fleet.observe_slot("default", t, bad=50, total=50)
+            t += 1_000
+            loop.run(t)
+        assert fleet.throttle["hi"] == 1.0, "first clause must never shed"
+        assert fleet.throttle["default"] < 1.0
+        res = fleet.route_slot("m-def", 100, 1_000)
+        assert res.shed > 0
+        res_hi = fleet.route_slot("m-hi", 100, 1_000)
+        assert res_hi.shed == 0
+        # Sheds are availability events, not latency samples.
+        assert sum(k for _, k in res.lat) == res.served
+
+    def test_kill_preserves_bytes_conservation(self):
+        loop, fleet = _warm_fleet(n_pods=4, copies=3)
+        assert fleet.bytes_conservation_violations() == []
+        fleet.kill("pod-1")
+        assert fleet.bytes_conservation_violations() == []
+        assert "pod-1" not in fleet.models["m-w"].holders
+        res = fleet.route_slot("m-w", 100, 1_000)
+        assert res.served == 100  # survivors absorb the flow
+        fleet.partition("pod-2")
+        assert fleet.bytes_conservation_violations() == []
+        fleet.heal("pod-2")
+        assert fleet.route_slot("m-w", 100, 1_000).served == 100
+
+    def test_eviction_to_host_tier_rewarm_is_cheap(self):
+        loop = EventLoop()
+        cfg = FleetConfig(authority="off", capacity_bytes=2,
+                          default_size_bytes=1)
+        fleet = ModeledFleet(loop, 1, cfg)
+        # Fill pod-0 beyond capacity: the LRU victim demotes to host.
+        mids = ["m-ev-0", "m-ev-1", "m-ev-2"]
+        for mid in mids:
+            fleet.register(mid)
+            fleet.route_slot(mid, 1, 1_000)  # demand-load + LRU touch
+            loop.run(loop.now_ms + 200)
+        assert fleet.counters["evictions"] >= 1
+        assert fleet.bytes_conservation_violations() == []
+        inst = fleet._inst("pod-0")
+        hosted = [m for m, c in inst.copies.items() if c.phase == "host"]
+        assert hosted, "eviction must demote to the host tier"
+        # Re-warming the hosted copy is the cheap path.
+        res = fleet.route_slot(hosted[0], 1, 1_000)
+        assert res.served == 1
+        assert fleet.counters["loads_host"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# CLI doors (satellite: --scenario / --macro)
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_unknown_scenario_lists_and_rc2(self, capsys):
+        from modelmesh_tpu.sim.explore import main
+
+        rc = main(["--scenario", "no-such-scenario"])
+        out = capsys.readouterr().out
+        assert rc == 2
+        assert "unknown scenario" in out
+        # The listing names real factories so the user can retry.
+        from modelmesh_tpu.sim import scenarios
+
+        for name in list(scenarios.BY_NAME)[:2]:
+            assert name in out
+
+    def test_macro_cli_tiny_run(self, capsys):
+        import json
+
+        from modelmesh_tpu.sim.explore import main
+
+        rc = main([
+            "--macro", "--pods", "4", "--users", "2000",
+            "--models", "16", "--day-s", "300", "--seed", "3",
+            "--authority", "burn", "--admission",
+        ])
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        assert rc == 0
+        summary = json.loads(out)
+        assert summary["conservation_violations"] == []
+        assert summary["requests_simulated"] > 0
+        assert len(summary["digest"]) == 64

@@ -70,6 +70,7 @@ from typing import TYPE_CHECKING, Optional
 from modelmesh_tpu.autoscale.forecast import DemandForecaster
 from modelmesh_tpu.observability.metrics import Metric as MX
 from modelmesh_tpu.utils.clock import get_clock
+from modelmesh_tpu.utils.lockdebug import mm_lock
 
 if TYPE_CHECKING:  # pragma: no cover
     from modelmesh_tpu.serving.instance import ModelMeshInstance
@@ -153,11 +154,14 @@ def prewarm_plan_key(kv_prefix: str) -> str:
 class AutoscaleController:
     """One instance's autoscale participant. Decision state is mutated
     from the owning task thread (single-writer, like the rate-task
-    bookkeeping), with two narrow exceptions owned by the pre-warm
-    worker on the cleanup pool: ``_prewarming`` discard and the
-    ``autoscale-prewarmed`` decision append — both GIL-atomic ops.
-    Cross-thread readers (tests, dumps) see GIL-atomic snapshots of the
-    bounded ``decisions`` list."""
+    bookkeeping), with two exceptions owned by the pre-warm worker on
+    the cleanup pool: the ``_prewarming`` discard and the
+    ``autoscale-prewarmed`` decision append. Those two fields are
+    guarded by ``_mu`` — the decision-log trim is a len-then-del
+    compound and the in-flight check is check-then-act, neither of
+    which GIL atomicity covers across the two threads. Cross-thread
+    readers (tests, dumps) still take GIL-atomic snapshots of the
+    bounded ``decisions`` list without the lock."""
 
     def __init__(
         self,
@@ -168,18 +172,23 @@ class AutoscaleController:
         self.cfg = config or AutoscaleConfig()
         self.forecaster = DemandForecaster()
         # class -> burn rate at the previous tick (trend detection).
+        #: shared-ok: single-writer task-thread state (tick cadence owns all writes)
         self._last_burn: dict[str, float] = {}
         # class -> consecutive calm ticks (burn <= burn_down).
+        #: shared-ok: single-writer task-thread state (tick cadence owns all writes)
         self._calm: dict[str, int] = {}
+        #: shared-ok: single-writer task-thread state (tick cadence owns all writes)
         self._ticks = 0
         # model -> (hold_until_ms, copies_at_decision): suppress re-adds
         # until the previous add either landed (copy count moved) or the
         # hold expired (the add failed / got stuck).
+        #: shared-ok: single-writer task-thread state (tick cadence owns all writes)
         self._hold: dict[str, tuple[int, int]] = {}
         # Admission-shed pressure: served-traffic burn must not double
         # count sheds (they never enter the SLO window), but a non-zero
         # shed delta IS demand the fleet dropped — scale-up eligibility
         # for throttled classes halves its burn threshold.
+        #: shared-ok: single-writer task-thread state (tick cadence owns all writes)
         self._last_shed_count = 0
         # Last published pre-warm plan JSON (leader); avoids a KV write
         # per tick when nothing changed. Reset on every leadership GAIN
@@ -187,16 +196,23 @@ class AutoscaleController:
         # re-elected leader whose recomputed plan happens to equal its
         # own LAST published one would otherwise skip the write and
         # leave the interim leader's stale plan standing.
+        #: shared-ok: single-writer task-thread state (tick cadence owns all writes)
         self._published_plan: Optional[str] = None
+        #: shared-ok: single-writer task-thread state (tick cadence owns all writes)
         self._was_leader = False
+        # Guards the two fields shared between the tick thread and the
+        # pre-warm worker on the cleanup pool.
+        self._mu = mm_lock("AutoscaleController._mu")
         # Models with a pre-warm fetch currently in flight on the
-        # cleanup pool (GIL-atomic set ops; added on the tick thread,
-        # discarded by the worker in a finally).
+        # cleanup pool (added on the tick thread, discarded by the
+        # worker in a finally).
+        #: guarded-by: _mu
         self._prewarming: set[str] = set()
         # Bounded decision log: (ts_ms, kind, fields) — the signal
         # snapshot → action record tests and scenarios read. Appended
         # from the tick thread and (for autoscale-prewarmed) the
-        # pre-warm worker; list append is GIL-atomic.
+        # pre-warm worker.
+        #: guarded-by: _mu
         self.decisions: list[dict] = []
 
     # ------------------------------------------------------------------ #
@@ -522,7 +538,9 @@ class AutoscaleController:
                 break
             if inst.instance_id not in plan[model_id]:
                 continue
-            if model_id in self._prewarming:
+            with self._mu:
+                in_flight = model_id in self._prewarming
+            if in_flight:
                 continue  # a fetch is already in flight
             if inst.cache.get_quietly(model_id) is not None:
                 continue  # a device copy landed meanwhile
@@ -537,7 +555,8 @@ class AutoscaleController:
                     inst._claim_host_copy(model_id)
                 continue
             done += 1
-            self._prewarming.add(model_id)
+            with self._mu:
+                self._prewarming.add(model_id)
             inst._cleanup_pool.submit(self._prewarm_one, model_id)
 
     def _prewarm_one(self, model_id: str) -> None:
@@ -556,7 +575,8 @@ class AutoscaleController:
             # re-plans (and the sender may simply be gone)
             log.debug("pre-warm of %s failed: %s", model_id, e)
         finally:
-            self._prewarming.discard(model_id)
+            with self._mu:
+                self._prewarming.discard(model_id)
 
     # ------------------------------------------------------------------ #
     # accountability                                                     #
@@ -564,6 +584,9 @@ class AutoscaleController:
 
     def _record(self, kind: str, now: int, **fields) -> None:
         self.instance.flightrec.record(kind, **fields)
-        self.decisions.append({"ts_ms": now, "kind": kind, **fields})
-        if len(self.decisions) > DEFAULT_MAX_DECISIONS:
-            del self.decisions[: len(self.decisions) - DEFAULT_MAX_DECISIONS]
+        with self._mu:
+            self.decisions.append({"ts_ms": now, "kind": kind, **fields})
+            if len(self.decisions) > DEFAULT_MAX_DECISIONS:
+                del self.decisions[
+                    : len(self.decisions) - DEFAULT_MAX_DECISIONS
+                ]

@@ -436,7 +436,7 @@ class InMemoryKV(KVStore):
             return True
 
     def lease_revoke(self, lease_id: int) -> None:
-        with self.batch():  # all attached keys drop at ONE revision
+        with self.batch():  # all attached keys drop at ONE revision  # analysis-ok: shared-state — batch() acquires and holds self._lock for the whole block (reentrant store batch)
             entry = self._leases.pop(lease_id, None)
             if entry is None:
                 return

@@ -283,6 +283,15 @@ class RemoteKV(KVStore):
         self._max_value_bytes = max_message_bytes() - (64 << 10)
         self._timeout = timeout_s
         self._watches: list[_RemoteWatch] = []
+        # Delivery-barrier state (wait_idle). The dict and lock exist
+        # from construction — only the barrier watch stream is created
+        # lazily (a watch costs a server stream; most clients never
+        # call wait_idle) — so two first callers can never each install
+        # a fresh dict and orphan the other's sentinel event.
+        #: guarded-by: _barrier_lock
+        self._barrier_events: dict[str, threading.Event] = {}
+        self._barrier_lock = threading.Lock()
+        self._barrier_watch: Optional[_RemoteWatch] = None
 
     def get(self, key: str) -> Optional[KeyValue]:
         resp = self._stub.Get(kpb.GetRequest(key=key), timeout=self._timeout)
@@ -511,20 +520,19 @@ class RemoteKV(KVStore):
         import time as _time
         import uuid as _uuid
 
-        if not hasattr(self, "_barrier_events"):
-            self._barrier_events: dict[str, threading.Event] = {}
-            self._barrier_lock = threading.Lock()
+        with self._barrier_lock:
+            if self._barrier_watch is None:
 
-            def on_barrier(events):
-                with self._barrier_lock:
-                    for ev in events:
-                        e = self._barrier_events.pop(
-                            ev.kv.key.rsplit("/", 1)[-1], None
-                        )
-                        if e is not None:
-                            e.set()
+                def on_barrier(events):
+                    with self._barrier_lock:
+                        for ev in events:
+                            e = self._barrier_events.pop(
+                                ev.kv.key.rsplit("/", 1)[-1], None
+                            )
+                            if e is not None:
+                                e.set()
 
-            self._barrier_watch = self.watch("__barrier__/", on_barrier)
+                self._barrier_watch = self.watch("__barrier__/", on_barrier)
         token = _uuid.uuid4().hex  # analysis-ok: det-entropy — one-shot wire barrier token, unique per call by design; never reaches a trace or record
         evt = threading.Event()
         with self._barrier_lock:

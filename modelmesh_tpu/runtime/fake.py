@@ -133,7 +133,7 @@ class FakeRuntimeServicer:
 
     def predict(self, method: str, request: bytes, context) -> bytes:
         md = dict(context.invocation_metadata())
-        self.last_predict_metadata = md  # test hook: header propagation
+        self.last_predict_metadata = md  #: shared-ok: test-introspection hook; last-writer-wins by design
         mid = md.get(grpc_defs.MODEL_ID_HEADER, "")
         if not mid:
             context.abort(

@@ -32,6 +32,7 @@ from modelmesh_tpu.runtime.spi import (
     ModelLoadException,
 )
 from modelmesh_tpu.utils.lockdebug import mm_condition, mm_lock
+from modelmesh_tpu.utils import racedebug
 
 log = logging.getLogger(__name__)
 
@@ -75,9 +76,12 @@ class EntryState(enum.Enum):
         return self in (EntryState.ACTIVE, EntryState.PARTIAL)
 
 
+@racedebug.tracked("state")
 class CacheEntry:
     """One local copy of a model. Thread-safe via its own lock; completion
-    is observed through ``wait_active``."""
+    is observed through ``wait_active``. Under MM_RACE_DEBUG=1 every
+    ``state`` write is epoch-checked against the happens-before clocks —
+    a transition that bypasses ``_lock`` raises ``DataRaceViolation``."""
 
     def __init__(
         self,

@@ -447,6 +447,7 @@ class ModelMeshInstance:
         self._model_rates: dict[str, RateTracker] = {}
         self._model_rates_lock = mm_lock("ModelMeshInstance._model_rates_lock")
         # model_id -> failfast-until timestamp (KV-outage sentinels).
+        #: shared-ok: GIL-atomic sentinel map; a lost prune/insert costs one extra registry probe, never a wrong answer
         self._kv_failfast: dict[str, int] = {}
         # Request-path fast path: the epoch-keyed ClusterView snapshot
         # (rebuilt only when the instances view moves) and the per-model
@@ -462,6 +463,7 @@ class ModelMeshInstance:
             feedback_decay_ms=self.config.feedback_decay_ms,
             seed=_zlib.crc32(self.instance_id.encode()),
         )
+        #: shared-ok: benign last-writer-wins memo — concurrent rebuilds install equally-fresh views (see cluster_view)
         self._cluster_view_cache: Optional[ClusterView] = None
         # Local in-flight gauge for the piggybacked feedback trailer:
         # requests currently executing against THIS runtime (between the

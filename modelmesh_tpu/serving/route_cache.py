@@ -64,6 +64,7 @@ from typing import Optional, Sequence
 
 from modelmesh_tpu.cache.lru import now_ms
 from modelmesh_tpu.utils.lockdebug import mm_lock
+from modelmesh_tpu.utils import racedebug
 
 DEFAULT_TTL_MS = 1_000
 # Distinct model ids cached before a wholesale reset; a cache, not a
@@ -307,6 +308,7 @@ class ServeCandidate:
         return f"<{self.iid}{':' + flags if flags else ''} w={self.weight:g}>"
 
 
+@racedebug.tracked("_by_model")
 class RouteCache:
     """Candidate-set memo + anchored power-of-d pick.
 

@@ -95,8 +95,11 @@ class EventLoop:
     def __init__(self, clock: Optional[_clock.VirtualClock] = None):
         self.clock = clock if clock is not None else _clock.VirtualClock()
         self._heap: list[_Ev] = []
+        #: shared-ok: single-threaded EventLoop state — ticks, routing, and faults all run on the loop thread
         self._seq = 0
+        #: shared-ok: single-threaded EventLoop state — ticks, routing, and faults all run on the loop thread
         self.now_ms: int = self.clock.now_ms()
+        #: shared-ok: single-threaded EventLoop state — ticks, routing, and faults all run on the loop thread
         self.events_processed = 0
 
     # -- scheduling --------------------------------------------------------
@@ -411,10 +414,13 @@ class ModeledFleet:
         # Clause order in the spec is priority order; the first clause
         # is never shed (routing/admission.py semantics).
         self._slo_order = list(self.slo)
+        #: shared-ok: single-threaded EventLoop state — ticks, routing, and faults all run on the loop thread
         self.throttle: dict[str, float] = {c: 1.0 for c in self.slo}
         self.forecaster = DemandForecaster() if self.cfg.prewarm else None
+        #: shared-ok: single-threaded EventLoop state — ticks, routing, and faults all run on the loop thread
         self._calm_ticks: dict[str, int] = {}
         # Scale/churn observability for invariants and the bench tail.
+        #: shared-ok: single-threaded EventLoop state — ticks, routing, and faults all run on the loop thread
         self.counters = {
             "scale_up": 0, "scale_down": 0, "loads_store": 0,
             "loads_peer": 0, "loads_host": 0, "evictions": 0,

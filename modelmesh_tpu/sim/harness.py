@@ -273,6 +273,7 @@ class SimCluster:
         self._service_lock = threading.Lock()
         self.kv = SimKV(seed=seed, config=kv_config)
         self.task_config = task_config or TaskConfig()
+        #: shared-ok: scenario-driver state — the script step and upgrade coordinator run on one thread at a time
         self.pods: list[SimPod] = []
         # Instances this scenario demanded copies of (feeds the
         # availability invariant).
@@ -289,9 +290,11 @@ class SimCluster:
         # instance_id -> virtual ms it died (kill or post-drain); the
         # runner merges this into the dead-placement grace bookkeeping
         # for deaths IT didn't schedule (e.g. rolling-upgrade waves).
+        #: shared-ok: scenario-driver state — the script step and upgrade coordinator run on one thread at a time
         self.deaths: dict[str, int] = {}
         # Drain reports by instance id (reconfig/drain.py), for scenario
         # checks (non-vacuity: the drained pod really migrated copies).
+        #: shared-ok: scenario-driver state — the script step and upgrade coordinator run on one thread at a time
         self.drain_reports: dict = {}
         # reconfig/rolling.py UpgradeReport of the last rolling_upgrade.
         self.upgrade_report = None
@@ -320,6 +323,7 @@ class SimCluster:
         # coalesced concurrent requests under virtual time. Same bound
         # as request_log.
         self.batch_dispatches = RingLog()
+        #: shared-ok: scenario-driver state — the script step and upgrade coordinator run on one thread at a time
         self._n = 0
         for _ in range(n):
             self.add_instance(

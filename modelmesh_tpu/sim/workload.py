@@ -267,6 +267,7 @@ class WorkloadGenerator:
             for f in spec.flash
         ]
         self._lat_ewma = fleet.cfg.service_base_ms
+        #: shared-ok: single-threaded EventLoop state — slot callbacks run on the loop thread
         self._slot_ev = None
         for f in spec.faults:
             loop.schedule_at(self.t0 + f.at_ms, self._fault, f)

@@ -107,13 +107,18 @@ class _KickingEvent(threading.Event):
 
 
 class _VirtualTimer:
-    __slots__ = ("deadline_ms", "fn", "name", "cancelled")
+    __slots__ = ("deadline_ms", "fn", "name", "cancelled", "race_token")
 
     def __init__(self, deadline_ms: int, fn, name: str):
         self.deadline_ms = deadline_ms
         self.fn = fn
         self.name = name
         self.cancelled = False
+        # MM_RACE_DEBUG schedule->fire happens-before edge: the
+        # scheduler's clock, adopted by the timer body in _run_timer.
+        from modelmesh_tpu.utils import racedebug
+
+        self.race_token = racedebug.task_created()
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -271,7 +276,10 @@ class VirtualClock(Clock):
 
     @staticmethod
     def _run_timer(t: _VirtualTimer) -> None:
+        from modelmesh_tpu.utils import racedebug
+
         try:
+            racedebug.task_begin(t.race_token)
             t.fn()
         except Exception:  # noqa: BLE001 — timer bodies are foreign code
             import traceback

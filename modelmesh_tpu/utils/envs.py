@@ -236,6 +236,17 @@ REGISTRY: dict[str, EnvVar] = {
                "inversion); read at lock CREATION time — set it before "
                "constructing instances. Debug/test aid, not for "
                "production", "utils/lockdebug.py"),
+        EnvVar("MM_RACE_DEBUG", "bool", "0",
+               "FastTrack-lite vector-clock happens-before data-race "
+               "sanitizer: mm_lock/mm_rlock/mm_condition carry "
+               "release->acquire clock edges (plus thread create/join, "
+               "pool submit->run, call_later schedule->fire), and "
+               "@racedebug.tracked classes record per-field access "
+               "epochs, raising DataRaceViolation with both conflicting "
+               "stacks on an unordered access. Read at lock/instance "
+               "CREATION time — set it before building a cluster. "
+               "Debug/test aid, not for production",
+               "utils/racedebug.py"),
         EnvVar("MM_KV_READ_ONLY", "int", "0",
                "KV-migration read-only mode: block model add/remove, "
                "suppress reaper pruning", "serving/instance.py"),

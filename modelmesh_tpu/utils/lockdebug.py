@@ -285,23 +285,39 @@ class _DebugLock:
 
 
 def mm_lock(name: str):
-    """A ``threading.Lock`` — instrumented under MM_LOCK_DEBUG=1."""
-    if not enabled():
-        return threading.Lock()
-    return _DebugLock(name, threading.Lock())
+    """A ``threading.Lock`` — instrumented under MM_LOCK_DEBUG=1 and/or
+    MM_RACE_DEBUG=1 (utils/racedebug.py); plain otherwise."""
+    from modelmesh_tpu.utils import racedebug
+
+    lock = _DebugLock(name, threading.Lock()) if enabled() \
+        else threading.Lock()
+    return racedebug.maybe_wrap_lock(name, lock)
 
 
 def mm_rlock(name: str):
-    """A ``threading.RLock`` — instrumented under MM_LOCK_DEBUG=1."""
-    if not enabled():
-        return threading.RLock()
-    return _DebugLock(name, threading.RLock())
+    """A ``threading.RLock`` — instrumented under MM_LOCK_DEBUG=1 and/or
+    MM_RACE_DEBUG=1; plain otherwise."""
+    from modelmesh_tpu.utils import racedebug
+
+    lock = _DebugLock(name, threading.RLock()) if enabled() \
+        else threading.RLock()
+    return racedebug.maybe_wrap_lock(name, lock)
 
 
 def mm_condition(name: str, lock=None):
     """A ``threading.Condition`` whose underlying lock is instrumented
-    under MM_LOCK_DEBUG=1. Pass ``lock`` to share an existing (possibly
-    already-instrumented) lock, matching ``threading.Condition(lock)``."""
-    if lock is None and enabled():
-        lock = _DebugLock(name, threading.RLock())
+    under MM_LOCK_DEBUG=1 and/or MM_RACE_DEBUG=1. Pass ``lock`` to share
+    an existing (possibly already-instrumented) lock, matching
+    ``threading.Condition(lock)`` — a shared lock that is already
+    race-wrapped is reused as-is so the release->acquire clock channel
+    stays unified."""
+    from modelmesh_tpu.utils import racedebug
+
+    if lock is None:
+        if enabled():
+            lock = _DebugLock(name, threading.RLock())
+        elif racedebug.enabled():
+            lock = threading.RLock()
+    if lock is not None:
+        lock = racedebug.maybe_wrap_lock(name, lock)
     return threading.Condition(lock)

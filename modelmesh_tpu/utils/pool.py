@@ -20,6 +20,8 @@ import queue
 import threading
 from typing import Callable
 
+from modelmesh_tpu.utils import racedebug
+
 log = logging.getLogger(__name__)
 
 _SENTINEL = object()
@@ -49,7 +51,9 @@ class BoundedDaemonPool:
             if self._closed:
                 return False
             self._pending += 1
-            self._q.put((fn, args))
+            # MM_RACE_DEBUG submit->run happens-before edge; None (one
+            # flag check) when the sanitizer is idle.
+            self._q.put((fn, args, racedebug.task_created()))
             # Lazy spawn: one worker per queued task until the cap, so an
             # idle instance holds no threads and a burst gets parallelism.
             if len(self._workers) < self._max:
@@ -67,8 +71,9 @@ class BoundedDaemonPool:
             item = self._q.get()
             if item is _SENTINEL:
                 return
-            fn, args = item
+            fn, args, race_token = item
             try:
+                racedebug.task_begin(race_token)
                 fn(*args)
             except Exception:  # noqa: BLE001 — janitorial: log, keep serving
                 log.exception("%s task %r failed", self._name, fn)

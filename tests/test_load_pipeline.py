@@ -43,8 +43,22 @@ def _lock_debug(monkeypatch):
     """MM_LOCK_DEBUG=1: every lock the lifecycle paths create in these
     tests is the instrumented wrapper (utils/lockdebug.py) — a lock-
     acquisition-order inversion anywhere in the load/evict/publish races
-    exercised here fails the test with a held-locks dump."""
+    exercised here fails the test with a held-locks dump.
+
+    MM_RACE_DEBUG=1 additionally arms the happens-before sanitizer
+    (utils/racedebug.py): CacheEntry.state writes are epoch-checked, so
+    a transition that slips past _lock raises DataRaceViolation with
+    both conflicting stacks instead of silently corrupting state."""
     monkeypatch.setenv("MM_LOCK_DEBUG", "1")
+    monkeypatch.setenv("MM_RACE_DEBUG", "1")
+    from modelmesh_tpu.utils import racedebug
+
+    yield
+    try:
+        assert racedebug.violations() == []
+    finally:
+        racedebug.clear_violations()
+        racedebug.deactivate()
 
 
 class GatedLoader(ModelLoader):

@@ -1,0 +1,462 @@
+"""MM_RACE_DEBUG happens-before sanitizer (utils/racedebug.py): the
+dynamic half of the shared-state escape rule.
+
+Covers the vector-clock edges (lock release->acquire, thread
+fork/join, pool submit->run, call_later schedule->fire), the tracked
+field shim (construction exemption, slotted classes, opt-in read
+tracking), the fix-reverted runtime twin (an injected unsynchronized
+write raises DataRaceViolation while the locked twin stays clean — the
+static half of the same pair lives in test_static_analysis.py
+TestSharedStateFixReverted), zero production overhead with the flag
+off, and a full scripted sim scenario executing clean under the armed
+witness.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from modelmesh_tpu.utils import racedebug
+from modelmesh_tpu.utils.lockdebug import mm_condition, mm_lock, mm_rlock
+
+
+@pytest.fixture()
+def armed(monkeypatch):
+    """MM_RACE_DEBUG=1 + patched Thread edges for the test body; always
+    disarmed and drained on the way out (the patches are process-wide)."""
+    monkeypatch.setenv("MM_RACE_DEBUG", "1")
+    racedebug.activate()
+    yield
+    racedebug.clear_violations()
+    racedebug.deactivate()
+
+
+def _run_threads(*bodies):
+    """Run each body on its own thread; return exceptions per body."""
+    errs = [None] * len(bodies)
+
+    def call(i, body):
+        try:
+            body()
+        except racedebug.DataRaceViolation as e:  # noqa: PERF203
+            errs[i] = e
+
+    ts = [
+        threading.Thread(target=call, args=(i, b), name=f"body-{i}")
+        for i, b in enumerate(bodies)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return errs
+
+
+@racedebug.tracked("field")
+class _Plain:
+    """Dict-based tracked class; writes go wherever the test points."""
+
+    def __init__(self):
+        self.lock = mm_lock("_Plain.lock")
+        self.field = 0  # construction write: exempt
+
+
+@racedebug.tracked("field")
+class _Slotted:
+    __slots__ = ("lock", "field")
+
+    def __init__(self):
+        self.lock = mm_lock("_Slotted.lock")
+        self.field = 0
+
+
+# --------------------------------------------------------------------- #
+# happens-before edges                                                  #
+# --------------------------------------------------------------------- #
+
+
+class TestVectorClockEdges:
+    def test_lock_release_acquire_orders_writes(self, armed):
+        obj = _Plain()
+        barrier = threading.Barrier(2)  # NOT an hb edge — pure timing
+
+        def writer():
+            barrier.wait(5)
+            for _ in range(20):
+                with obj.lock:
+                    obj.field += 1
+
+        errs = _run_threads(writer, writer)
+        assert errs == [None, None]
+        assert racedebug.violations() == []
+        assert obj.field == 40
+
+    def test_unsynchronized_writes_raise_with_both_stacks(self, armed):
+        obj = _Plain()
+        barrier = threading.Barrier(2)
+
+        def writer():
+            barrier.wait(5)
+            obj.field = 1
+
+        errs = _run_threads(writer, writer)
+        caught = [e for e in errs if e is not None]
+        assert caught, "two unordered writes must trip the sanitizer"
+        msg = str(caught[0])
+        assert "_Plain.field" in msg and "write-write" in msg
+        assert "this access" in msg and "conflicting access" in msg
+        assert racedebug.violations()  # logged for fixture asserts
+        racedebug.clear_violations()
+
+    def test_thread_start_edge_orders_parent_write(self, armed):
+        obj = _Plain()
+        obj.field = 1  # parent write, no lock
+
+        def child():
+            obj.field = 2  # ordered via the start snapshot
+
+        t = threading.Thread(target=child)
+        t.start()
+        t.join()
+        assert racedebug.violations() == []
+
+    def test_thread_join_edge_orders_final_write(self, armed):
+        obj = _Plain()
+
+        def child():
+            obj.field = 1
+
+        t = threading.Thread(target=child)
+        t.start()
+        t.join()
+        obj.field = 2  # ordered: join adopted the child's final clock
+        assert racedebug.violations() == []
+
+    def test_finished_but_unjoined_thread_is_still_a_race(self, armed):
+        obj = _Plain()
+        done = threading.Event()  # NOT an hb edge in the model
+
+        def child():
+            obj.field = 1
+            done.set()
+
+        threading.Thread(target=child).start()
+        assert done.wait(5)
+        with pytest.raises(racedebug.DataRaceViolation):
+            obj.field = 2  # no join edge: unordered with the child write
+        racedebug.clear_violations()
+
+    def test_pool_submit_edge_orders_task_body(self, armed):
+        from modelmesh_tpu.utils.pool import BoundedDaemonPool
+
+        obj = _Plain()
+        obj.field = 1  # submitter write before the task exists
+        pool = BoundedDaemonPool(2, name="race-test")
+        done = threading.Event()
+
+        def task():
+            obj.field = 2  # ordered via the submit token
+            done.set()
+
+        assert pool.submit(task)
+        assert done.wait(5)
+        assert racedebug.violations() == []
+        pool.shutdown()
+
+    def test_two_pool_tasks_racing_are_caught(self, armed):
+        from modelmesh_tpu.utils.pool import BoundedDaemonPool
+
+        obj = _Plain()
+        pool = BoundedDaemonPool(2, name="race-test")
+        barrier = threading.Barrier(2)
+        done = threading.Barrier(3)
+
+        def task():
+            barrier.wait(5)
+            try:
+                obj.field = 1  # tasks are unordered with EACH OTHER
+            finally:
+                done.wait(5)
+
+        pool.submit(task)
+        pool.submit(task)
+        done.wait(5)
+        assert racedebug.violations(), (
+            "two concurrently-running pool tasks writing the same "
+            "tracked field must trip the sanitizer"
+        )
+        racedebug.clear_violations()
+        pool.shutdown()
+
+    def test_virtual_timer_fire_is_ordered_after_schedule(self, armed):
+        from modelmesh_tpu.utils import clock
+
+        obj = _Plain()
+        fired = threading.Event()
+        with clock.installed(clock.VirtualClock()):
+            obj.field = 1  # scheduler write
+
+            def body():
+                obj.field = 2  # ordered via the timer token
+                fired.set()
+
+            clock.get_clock().call_later(0.5, body)
+            clock.get_clock().advance(1_000)
+            assert fired.wait(5)
+        assert racedebug.violations() == []
+
+    def test_system_timer_fire_is_ordered_after_schedule(self, armed):
+        from modelmesh_tpu.utils import clock
+
+        obj = _Plain()
+        obj.field = 1
+        fired = threading.Event()
+
+        def body():
+            obj.field = 2  # threading.Timer rides the Thread.start patch
+            fired.set()
+
+        clock.SystemClock().call_later(0.01, body)
+        assert fired.wait(5)
+        assert racedebug.violations() == []
+
+    def test_condition_wait_handoff_is_ordered(self, armed):
+        obj = _Plain()
+        cv = mm_condition("_Plain.cv")
+        state = {"ready": False}
+
+        def producer():
+            with cv:
+                obj.field = 1
+                state["ready"] = True
+                cv.notify()
+
+        def consumer():
+            with cv:
+                while not state["ready"]:
+                    cv.wait(5)
+                obj.field = 2  # cv wait reacquired through the wrapper
+
+        errs = _run_threads(consumer, producer)
+        assert errs == [None, None]
+        assert racedebug.violations() == []
+
+    def test_condition_shares_existing_race_lock(self, armed):
+        lock = mm_lock("Shared._lock")
+        assert type(lock).__name__ == "_RaceLock"
+        cv = mm_condition("Shared._cv", lock)
+        assert cv._lock is lock, (
+            "a Condition over an already-wrapped lock must SHARE the "
+            "wrapper, or the release->acquire clock channel splits"
+        )
+
+    def test_rlock_reentrant_acquire(self, armed):
+        lock = mm_rlock("R._lock")
+        with lock:
+            with lock:
+                pass  # no deadlock, no violation machinery confusion
+        assert racedebug.violations() == []
+
+
+# --------------------------------------------------------------------- #
+# tracked-field shim                                                    #
+# --------------------------------------------------------------------- #
+
+
+class TestTrackedShim:
+    def test_construction_writes_are_exempt(self, armed):
+        obj = _Plain()  # __init__ writes field with no lock held
+        assert racedebug.violations() == []
+        assert obj.field == 0
+
+    def test_shim_reports_under_product_class_name(self, armed):
+        obj = _Plain()
+        assert type(obj).__name__ == "_Plain"
+        assert type(obj) is not _Plain  # but IS the invisible shim
+
+    def test_slotted_class_is_tracked(self, armed):
+        obj = _Slotted()
+        barrier = threading.Barrier(2)
+
+        def racy():
+            barrier.wait(5)
+            obj.field = 1
+
+        errs = _run_threads(racy, racy)
+        assert any(e is not None for e in errs), (
+            "slotted tracked classes must be checked too (the shim "
+            "carries the epoch table in its own slot)"
+        )
+        racedebug.clear_violations()
+
+    def test_slotted_locked_writes_are_clean(self, armed):
+        obj = _Slotted()
+
+        def safe():
+            with obj.lock:
+                obj.field += 1
+
+        errs = _run_threads(safe, safe)
+        assert errs == [None, None]
+        assert racedebug.violations() == []
+
+    def test_untracked_fields_are_ignored(self, armed):
+        obj = _Plain()
+        barrier = threading.Barrier(2)
+
+        def racy_other():
+            barrier.wait(5)
+            obj.other = 1  # not in the tracked set
+
+        errs = _run_threads(racy_other, racy_other)
+        assert errs == [None, None]
+        assert racedebug.violations() == []
+
+    def test_read_tracking_is_opt_in(self, armed):
+        @racedebug.tracked("f", reads=("f",))
+        class WithReads:
+            def __init__(self):
+                self.f = 0
+
+        obj = WithReads()
+        done = threading.Event()
+
+        def writer():
+            obj.f = 1
+            done.set()
+
+        threading.Thread(target=writer).start()
+        assert done.wait(5)
+        with pytest.raises(racedebug.DataRaceViolation) as ei:
+            _ = obj.f  # unordered read-after-write
+        assert "write-read" in str(ei.value)
+        racedebug.clear_violations()
+
+    def test_reads_must_be_subset_of_fields(self):
+        with pytest.raises(ValueError):
+            racedebug.tracked("a", reads=("b",))
+
+
+# --------------------------------------------------------------------- #
+# fix-reverted runtime twin (static twin: TestSharedStateFixReverted)   #
+# --------------------------------------------------------------------- #
+
+
+@racedebug.tracked("counter")
+class _RacyTwin:
+    """The injected bug: a pool-visible counter bumped with NO lock."""
+
+    def __init__(self):
+        self.counter = 0
+
+    def bump(self):
+        self.counter += 1
+
+
+@racedebug.tracked("counter")
+class _LockedTwin:
+    """The fix: the same bump under the instance lock."""
+
+    def __init__(self):
+        self._lock = mm_lock("_LockedTwin._lock")
+        self.counter = 0
+
+    def bump(self):
+        with self._lock:
+            self.counter += 1
+
+
+class TestFixRevertedRuntimeTwin:
+    def _hammer(self, obj, n=2, iters=25):
+        barrier = threading.Barrier(n)
+
+        def body():
+            barrier.wait(5)
+            for _ in range(iters):
+                obj.bump()
+                time.sleep(0)
+
+        return _run_threads(*([body] * n))
+
+    def test_injected_unsynchronized_write_is_caught(self, armed):
+        errs = self._hammer(_RacyTwin())
+        assert any(e is not None for e in errs), (
+            "the runtime witness must catch the injected racy bump — "
+            "otherwise the sanitizer gate is vacuous"
+        )
+        racedebug.clear_violations()
+
+    def test_locked_twin_passes(self, armed):
+        obj = _LockedTwin()
+        errs = self._hammer(obj)
+        assert errs == [None] * len(errs)
+        assert racedebug.violations() == []
+        assert obj.counter == 50
+
+
+# --------------------------------------------------------------------- #
+# zero production overhead                                              #
+# --------------------------------------------------------------------- #
+
+
+class TestRaceDebugProductionMode:
+    @pytest.fixture(autouse=True)
+    def _flag_off(self, monkeypatch):
+        monkeypatch.delenv("MM_RACE_DEBUG", raising=False)
+        # earlier armed tests may have left the patches in place
+        racedebug.deactivate()
+        racedebug.clear_violations()
+
+    def test_factories_return_plain_primitives(self, monkeypatch):
+        monkeypatch.delenv("MM_LOCK_DEBUG", raising=False)
+        assert type(mm_lock("P._l")) is type(threading.Lock())
+        assert type(mm_rlock("P._r")) is type(threading.RLock())
+        cv = mm_condition("P._cv")
+        assert type(cv) is threading.Condition
+        assert type(cv._lock) is type(threading.RLock())
+
+    def test_tracked_classes_stay_untouched(self):
+        from modelmesh_tpu.runtime.spi import ModelInfo
+        from modelmesh_tpu.serving.entry import CacheEntry
+        from modelmesh_tpu.serving.route_cache import RouteCache
+
+        e = CacheEntry("m", ModelInfo(model_type="t"))
+        rc = RouteCache()
+        assert type(e) is CacheEntry
+        assert type(rc) is RouteCache
+        # the product classes define no __setattr__ of their own: every
+        # write is a plain object.__setattr__, zero interposition
+        assert "__setattr__" not in CacheEntry.__dict__
+        assert "__setattr__" not in RouteCache.__dict__
+
+    def test_thread_methods_unpatched(self):
+        assert threading.Thread.start.__module__ == "threading"
+        assert threading.Thread.join.__module__ == "threading"
+
+    def test_task_tokens_are_free(self):
+        assert racedebug.task_created() is None
+        racedebug.task_begin(None)  # no-op, no error
+        assert not racedebug.active()
+
+
+# --------------------------------------------------------------------- #
+# scripted scenario under the armed witness                             #
+# --------------------------------------------------------------------- #
+
+
+class TestScenarioUnderWitness:
+    def test_sim_scenario_runs_clean_under_witness(self, armed):
+        """Acceptance: a full scripted scenario — real instances with
+        tracked CacheEntry/RouteCache fields, KV, janitor cadences,
+        a delete/re-register race — executes ZERO unordered accesses
+        under the armed sanitizer, and the scenario's own invariants
+        hold."""
+        from modelmesh_tpu.sim import scenarios
+        from modelmesh_tpu.sim.scenario import run_scenario
+
+        result = run_scenario(scenarios.delete_reregister_race())
+        assert result.ok, result.render()
+        assert racedebug.violations() == []

@@ -50,8 +50,22 @@ def _lock_debug(monkeypatch):
     """MM_LOCK_DEBUG=1: the routing/invalidation races these tests drive
     run on instrumented locks (utils/lockdebug.py), so an acquisition-
     order inversion on the request path fails loudly here instead of
-    deadlocking in production."""
+    deadlocking in production.
+
+    MM_RACE_DEBUG=1 additionally arms the happens-before sanitizer
+    (utils/racedebug.py): RouteCache._by_model rebinds are epoch-checked,
+    so a wholesale reset that slips past _lock raises DataRaceViolation
+    with both conflicting stacks."""
     monkeypatch.setenv("MM_LOCK_DEBUG", "1")
+    monkeypatch.setenv("MM_RACE_DEBUG", "1")
+    from modelmesh_tpu.utils import racedebug
+
+    yield
+    try:
+        assert racedebug.violations() == []
+    finally:
+        racedebug.clear_violations()
+        racedebug.deactivate()
 
 
 class _InstantLoader(ModelLoader):

@@ -1974,3 +1974,652 @@ class TestSecondReviewRegressions:
         # the module-level pass must not re-report in-function writes
         fs = _funnel_findings(tmp_path, 'self.state = "X"')
         assert len(fs) == 1, [f.render() for f in fs]
+
+
+# --------------------------------------------------------------------- #
+# rule family 9: shared-state escape analysis                           #
+# --------------------------------------------------------------------- #
+
+
+SHARED_SRC = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.val = 0{annotate}
+
+    def start(self):
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def bump(self):
+        {bump}
+
+    def _work(self):
+        {work}
+"""
+
+
+def _shared(tmp_path, src):
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    return [f for f in _findings(tmp_path, src)
+            if f.rule == "shared-state"]
+
+
+class TestSharedStateRule:
+    def test_unguarded_write_from_two_roots_fires(self, tmp_path):
+        fs = _shared(tmp_path, SHARED_SRC.format(
+            annotate="", bump="self.val += 1", work="self.val += 1",
+        ))
+        assert {f.qualname for f in fs} == {"C.bump", "C._work"}, (
+            [f.render() for f in fs]
+        )
+        # the message names the concrete roots so triage is one read
+        assert "thread:_work" in fs[0].message
+        assert "api" in fs[0].message
+        assert "shared-ok" in fs[0].message  # points at the way out
+        # __init__ writes are exempt (construction happens-before)
+        assert all("__init__" not in f.qualname for f in fs)
+
+    def test_lock_held_writes_pass(self, tmp_path):
+        fs = _shared(tmp_path, SHARED_SRC.format(
+            annotate="",
+            bump="with self._lock:\n            self.val += 1",
+            work="with self._lock:\n            self.val += 1",
+        ))
+        assert not fs, [f.render() for f in fs]
+
+    def test_guarded_by_annotation_exempts(self, tmp_path):
+        # the guards family owns annotated fields; double-reporting the
+        # same write under two rules would just be noise
+        fs = _shared(tmp_path, SHARED_SRC.format(
+            annotate="  #: guarded-by: _lock",
+            bump="self.val += 1", work="self.val += 1",
+        ))
+        assert not fs, [f.render() for f in fs]
+
+    def test_shared_ok_annotation_exempts(self, tmp_path):
+        fs = _shared(tmp_path, SHARED_SRC.format(
+            annotate="  #: shared-ok: single-writer fixture field",
+            bump="self.val += 1", work="self.val += 1",
+        ))
+        assert not fs, [f.render() for f in fs]
+
+    def test_inline_suppression_works(self, tmp_path):
+        fs = _shared(tmp_path, SHARED_SRC.format(
+            annotate="", bump="self.val += 1",
+            work="self.val += 1  # analysis-ok: shared-state — fixture: "
+                 "deliberate lock-free write",
+        ))
+        assert {f.qualname for f in fs} == {"C.bump"}
+
+    def test_state_funnel_annotation_exempts(self, tmp_path):
+        fs = _shared(tmp_path, """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: state-funnel: bump
+        self.val = 0
+
+    def start(self):
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def bump(self):
+        self.val += 1
+
+    def _work(self):
+        self.bump()
+""")
+        assert not fs, [f.render() for f in fs]
+
+    def test_no_thread_roots_no_findings(self, tmp_path):
+        fs = _shared(tmp_path, """
+class C:
+    def __init__(self):
+        self.val = 0
+
+    def bump(self):
+        self.val += 1
+
+    def other(self):
+        self.val -= 1
+""")
+        assert not fs, [f.render() for f in fs]
+
+    def test_single_writing_root_is_clean(self, tmp_path):
+        # single-writer fields never fire (the documented
+        # under-approximation — #: shared-ok: documents the contract,
+        # MM_RACE_DEBUG covers the dynamic side)
+        fs = _shared(tmp_path, SHARED_SRC.format(
+            annotate="", bump="return self.val",
+            work="self.val += 1",
+        ))
+        assert not fs, [f.render() for f in fs]
+
+    def test_mutator_calls_are_writes(self, tmp_path):
+        fs = _shared(tmp_path, SHARED_SRC.format(
+            annotate="", bump="self.val.append(2)",
+            work="self.val.append(1)",
+        ))
+        assert len(fs) == 2
+        assert fs[0].token == "self.val.append()"
+
+    @pytest.mark.parametrize("root,tag", [
+        ("self.pool.submit(self._work)", "pool:_work"),
+        ("self.clock.call_later(1.0, self._work)", "timer:_work"),
+        ("self.kv.watch('p/', self._work)", "watch:_work"),
+    ])
+    def test_pool_timer_watch_roots(self, tmp_path, root, tag):
+        fs = _shared(tmp_path, """
+import threading
+
+class C:
+    def __init__(self, pool, clock, kv):
+        self._lock = threading.Lock()
+        self.pool = pool
+        self.clock = clock
+        self.kv = kv
+        self.val = 0
+
+    def start(self):
+        {root}
+
+    def bump(self):
+        self.val += 1
+
+    def _work(self):
+        self.val += 1
+""".format(root=root))
+        assert fs, f"{tag} root must fire"
+        assert any(tag in f.message for f in fs), (
+            [f.render() for f in fs]
+        )
+
+    def test_escaping_bound_method_reference_is_a_root(self, tmp_path):
+        # the serving/tasks.py cadence-specs shape: a bare self.m in a
+        # table escapes to whoever consumes the table
+        fs = _shared(tmp_path, """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.val = 0
+
+    def specs(self):
+        return [("tick", self._work, 30)]
+
+    def bump(self):
+        self.val += 1
+
+    def _work(self):
+        self.val += 1
+""")
+        assert fs
+        assert any("cb:_work" in f.message for f in fs)
+
+    def test_servicer_public_methods_are_roots(self, tmp_path):
+        servicer = """
+class EchoServicer:
+    def __init__(self):
+        self.count = 0
+
+    def Predict(self, request, context):
+        self.count += 1
+        return request
+
+    def Status(self, request, context):
+        self.count += 1
+        return request
+"""
+        fs = _shared(tmp_path, servicer)
+        assert {f.qualname for f in fs} == {
+            "EchoServicer.Predict", "EchoServicer.Status"
+        }
+        assert any("grpc:Predict" in f.message for f in fs)
+        # the same class NOT named/derived *Servicer has no roots
+        plain = _shared(
+            tmp_path / "plain", servicer.replace("EchoServicer", "Echo")
+        )
+        assert not plain, [f.render() for f in plain]
+
+    def test_helper_only_called_under_lock_is_protected(self, tmp_path):
+        helper_src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.val = 0
+
+    def start(self):
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def bump(self):
+        with self._lock:
+            self._incr()
+
+    def _work(self):
+        {work}
+
+    def _incr(self):
+        self.val += 1
+"""
+        fs = _shared(tmp_path, helper_src.format(
+            work="with self._lock:\n            self._incr()"
+        ))
+        assert not fs, [f.render() for f in fs]
+        # ONE unheld call chain re-exposes the helper
+        fs = _shared(tmp_path / "rev", helper_src.format(
+            work="self._incr()"
+        ))
+        assert {f.qualname for f in fs} == {"C._incr"}
+
+    def test_locked_suffix_method_holds_callers_lock(self, tmp_path):
+        fs = _shared(tmp_path, """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.val = 0
+
+    def start(self):
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def bump(self):
+        with self._lock:
+            self._incr_locked()
+
+    def _work(self):
+        with self._lock:
+            self._incr_locked()
+
+    def _incr_locked(self):
+        self.val += 1
+""")
+        assert not fs, [f.render() for f in fs]
+
+    def test_property_access_is_a_call_not_an_escape(self, tmp_path):
+        # regression: a @property getter's bare self.<name> loads are
+        # getter CALLS on the current thread, not escaping callbacks
+        # (the GlobalPlan.placements false positive)
+        fs = _shared(tmp_path, """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._memo = None
+
+    def start(self):
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def _work(self):
+        pass
+
+    @property
+    def memo(self):
+        self._memo = 1
+        return self._memo
+
+    def use(self):
+        return self.memo
+""")
+        assert not fs, [f.render() for f in fs]
+
+
+# --------------------------------------------------------------------- #
+# shared-state fix-reverted meta-tests + the PR's true positives        #
+# --------------------------------------------------------------------- #
+
+
+RACY_TWIN_SRC = """
+import threading
+
+class Twin:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counter = 0
+
+    def start(self):
+        threading.Thread(target=self._work, daemon=True).start()
+
+    def bump(self):
+        self.counter += 1
+
+    def _work(self):
+        self.counter += 1
+"""
+
+LOCKED_TWIN_SRC = RACY_TWIN_SRC.replace(
+    "        self.counter += 1",
+    "        with self._lock:\n            self.counter += 1",
+)
+
+
+class TestSharedStateFixReverted:
+    """Static half of the injected-race meta-test pair; the runtime half
+    (the same racy/locked twins executed under MM_RACE_DEBUG=1) lives in
+    test_racedebug.py TestFixRevertedRuntimeTwin — the two checks pin
+    each other."""
+
+    def test_injected_unsynchronized_write_caught_statically(
+        self, tmp_path
+    ):
+        racy = _shared(tmp_path, RACY_TWIN_SRC)
+        assert {f.qualname for f in racy} == {"Twin.bump", "Twin._work"}, (
+            "the static rule must catch the injected racy twin — "
+            "otherwise the gate is vacuous"
+        )
+        locked = _shared(tmp_path / "locked", LOCKED_TWIN_SRC)
+        assert not locked, [f.render() for f in locked]
+
+    def test_autoscale_prewarm_guard_reverted_refires(self, tmp_path):
+        """The PR's true positive #1: AutoscaleController._prewarming was
+        added on the tick thread and discarded on the cleanup pool with
+        no lock (check-then-act + concurrent set mutation). Fixed with
+        _mu; reverting the guard must re-fire the rule."""
+        rel = "modelmesh_tpu/autoscale/controller.py"
+        src = (ROOT / rel).read_text()
+        guarded_add = (
+            "            with self._mu:\n"
+            "                self._prewarming.add(model_id)"
+        )
+        guarded_discard = (
+            "            with self._mu:\n"
+            "                self._prewarming.discard(model_id)"
+        )
+        assert guarded_add in src and guarded_discard in src, (
+            "the _mu pre-warm guard is gone"
+        )
+        clean = _real_tree_findings(tmp_path, {rel: src}, "shared-state")
+        assert not clean, [f.render() for f in clean]
+        reverted_src = src.replace(
+            "        #: guarded-by: _mu\n"
+            "        self._prewarming: set[str] = set()",
+            "        self._prewarming: set[str] = set()",
+        ).replace(
+            guarded_add, "            self._prewarming.add(model_id)",
+        ).replace(
+            guarded_discard,
+            "            self._prewarming.discard(model_id)",
+        )
+        reverted = _real_tree_findings(
+            tmp_path / "rev", {rel: reverted_src}, "shared-state"
+        )
+        assert any(
+            f.rule == "shared-state" and "_prewarming" in f.token
+            for f in reverted
+        ), [f.render() for f in reverted]
+
+    def test_remote_kv_lazy_barrier_init_reverted_refires(self, tmp_path):
+        """The PR's true positive #2: RemoteKV.wait_idle lazily installed
+        _barrier_events on first call — two concurrent first callers
+        could each install a fresh dict, orphaning the other's sentinel
+        event into a spurious TimeoutError. Fixed by hoisting the state
+        to __init__; re-introducing the lazy init must re-fire."""
+        rel = "modelmesh_tpu/kv/service.py"
+        src = (ROOT / rel).read_text()
+        fixed_init = (
+            "        #: guarded-by: _barrier_lock\n"
+            "        self._barrier_events: dict[str, threading.Event]"
+            " = {}\n"
+        )
+        fixed_gate = (
+            "        with self._barrier_lock:\n"
+            "            if self._barrier_watch is None:"
+        )
+        assert fixed_init in src and fixed_gate in src, (
+            "the hoisted barrier-state fix is gone"
+        )
+        clean = [
+            f for f in _real_tree_findings(
+                tmp_path, {rel: src}, "shared-state"
+            ) if "_barrier" in f.token
+        ]
+        assert not clean, [f.render() for f in clean]
+        reverted_src = src.replace(fixed_init, "").replace(
+            fixed_gate,
+            '        if not hasattr(self, "_barrier_events"):\n'
+            "            self._barrier_events = {}\n"
+            "            if True:",
+        )
+        reverted = _real_tree_findings(
+            tmp_path / "rev", {rel: reverted_src}, "shared-state"
+        )
+        assert any(
+            f.rule == "shared-state" and "_barrier_events" in f.token
+            for f in reverted
+        ), [f.render() for f in reverted]
+
+
+# --------------------------------------------------------------------- #
+# guards.py cross-object resolution edge cases                          #
+# --------------------------------------------------------------------- #
+
+
+CROSS_SRC = """
+import threading
+
+class Entry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "NEW"  #: guarded-by: _lock [rebind]
+
+class Holder:
+    def __init__(self):
+        self.entry = Entry()
+
+    def touch(self):
+        {body}
+"""
+
+
+class TestGuardsCrossObjectEdgeCases:
+    def _guard(self, tmp_path, body):
+        return [f for f in _findings(tmp_path, CROSS_SRC.format(body=body))
+                if f.rule == "guarded-by"]
+
+    def test_aliased_attribute_write_under_aliased_lock_passes(
+        self, tmp_path
+    ):
+        fs = self._guard(
+            tmp_path,
+            'e = self.entry\n        with e._lock:\n'
+            '            e.state = "ACTIVE"',
+        )
+        assert not fs, [f.render() for f in fs]
+
+    def test_aliased_attribute_write_without_lock_fires(self, tmp_path):
+        fs = self._guard(
+            tmp_path,
+            'e = self.entry\n        e.state = "ACTIVE"',
+        )
+        assert fs and fs[0].token == "e.state"
+
+    def test_foreign_lock_under_own_lock_only_still_fires(self, tmp_path):
+        # holding SELF's lock does not license writes through a foreign
+        # receiver — the annotation wants e's lock held on e
+        src = CROSS_SRC.format(
+            body='e = self.entry\n        with self._mine:\n'
+                 '            e.state = "ACTIVE"',
+        ).replace(
+            "        self.entry = Entry()",
+            "        self.entry = Entry()\n"
+            "        self._mine = threading.Lock()",
+        )
+        fs = [f for f in _findings(tmp_path, src)
+              if f.rule == "guarded-by"]
+        assert fs and fs[0].token == "e.state"
+
+    def test_nested_with_on_foreign_lock_passes(self, tmp_path):
+        src = CROSS_SRC.format(
+            body='e = self.entry\n        with self._mine:\n'
+                 '            with e._lock:\n'
+                 '                e.state = "ACTIVE"',
+        ).replace(
+            "        self.entry = Entry()",
+            "        self.entry = Entry()\n"
+            "        self._mine = threading.Lock()",
+        )
+        fs = [f for f in _findings(tmp_path, src)
+              if f.rule == "guarded-by"]
+        assert not fs, [f.render() for f in fs]
+
+    def test_ambiguous_cross_object_annotation_is_skipped(self, tmp_path):
+        # two classes annotate the same attr name with DIFFERENT locks:
+        # a foreign write resolves to neither (no false positive)
+        src = CROSS_SRC.format(
+            body='e = self.entry\n        e.state = "ACTIVE"',
+        ) + """
+
+class Other:
+    def __init__(self):
+        self._olock = threading.Lock()
+        self.state = "X"  #: guarded-by: _olock
+"""
+        fs = [f for f in _findings(tmp_path, src)
+              if f.rule == "guarded-by"]
+        assert not fs, [f.render() for f in fs]
+
+    def test_funnel_write_through_local_alias_fires(self, tmp_path):
+        src = """
+class Entry:
+    def __init__(self):
+        #: state-funnel: set_state
+        self.state = "NEW"
+
+    def set_state(self, v):
+        self.state = v
+
+class Holder:
+    def __init__(self):
+        self.entry = Entry()
+
+    def promote(self):
+        e = self.entry
+        e.state = "ACTIVE"
+
+    def promote_through_funnel(self):
+        e = self.entry
+        e.set_state("ACTIVE")
+"""
+        fs = [f for f in _findings(tmp_path, src)
+              if f.rule == "state-funnel"]
+        assert len(fs) == 1, [f.render() for f in fs]
+        assert fs[0].qualname == "Holder.promote"
+        assert fs[0].token == "e.state"
+
+
+# --------------------------------------------------------------------- #
+# CLI: --format json and --changed                                      #
+# --------------------------------------------------------------------- #
+
+
+class TestCliJsonAndChanged:
+    def test_json_format_round_trips(self, tmp_path, capsys):
+        import json
+
+        rc, out = _cli(tmp_path, "--format", "json", capsys=capsys)
+        assert rc == 1
+        data = json.loads(out)
+        assert data, "fixture findings must appear in the JSON output"
+        assert {
+            "rule", "file", "line", "qualname", "token", "message",
+            "suppressed",
+        } <= set(data[0])
+        assert any(
+            d["rule"] == "clock-discipline" and d["suppressed"] is False
+            for d in data
+        )
+        # after baselining, the SAME findings surface with the flag set
+        # and the exit code drops to 0 (machine consumers see both)
+        _cli(tmp_path, "--update-baseline", capsys=capsys)
+        rc, out = _cli(tmp_path, "--format", "json", capsys=capsys)
+        assert rc == 0
+        data = json.loads(out)
+        assert data and all(d["suppressed"] is True for d in data)
+
+    def test_changed_paths_lists_scoped_modified_and_untracked(
+        self, tmp_path
+    ):
+        from tools.analysis.__main__ import changed_paths
+
+        def git(*a):
+            subprocess.run(
+                ["git", *a], cwd=tmp_path, check=True,
+                capture_output=True, timeout=30,
+            )
+
+        (tmp_path / "modelmesh_tpu").mkdir()
+        git("init", "-q")
+        git("config", "user.email", "t@example.com")
+        git("config", "user.name", "t")
+        tracked = tmp_path / "modelmesh_tpu" / "a.py"
+        tracked.write_text("x = 1\n")
+        out_of_scope = tmp_path / "conftest.py"
+        out_of_scope.write_text("y = 1\n")
+        git("add", "-A")
+        git("commit", "-qm", "seed")
+        tracked.write_text("x = 2\n")                      # modified
+        fresh = tmp_path / "modelmesh_tpu" / "b.py"
+        fresh.write_text("z = 1\n")                        # untracked
+        out_of_scope.write_text("y = 2\n")                 # not analyzed
+        got = changed_paths(str(tmp_path))
+        assert got == [str(tracked), str(fresh)]
+
+    def test_changed_scopes_walk_and_drops_tree_wide_rules(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        import tools.analysis.__main__ as cli
+
+        pkg = tmp_path / "modelmesh_tpu"
+        pkg.mkdir()
+        (pkg / "changed.py").write_text(
+            "import time\n\ndef f():\n    return time.time()\n"
+        )
+        (pkg / "unchanged.py").write_text(
+            "import time\n\ndef g():\n    return time.time()\n"
+        )
+        monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+        monkeypatch.setattr(
+            cli, "changed_paths",
+            lambda root, scope="modelmesh_tpu": [str(pkg / "changed.py")],
+        )
+        rc = cli.main([
+            "--changed",
+            "--baseline", str(tmp_path / "baseline.txt"),
+            "--lock-order-file", str(tmp_path / "order.txt"),
+        ])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "changed.py" in out
+        assert "unchanged.py" not in out, "walk must scope to the diff"
+        # no lock-order drift noise from the partial tree
+        assert "lock-order" not in out
+
+    def test_changed_with_no_diff_exits_zero(self, tmp_path, capsys,
+                                             monkeypatch):
+        import tools.analysis.__main__ as cli
+
+        monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+        monkeypatch.setattr(
+            cli, "changed_paths",
+            lambda root, scope="modelmesh_tpu": [],
+        )
+        rc = cli.main(["--changed"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "no changed" in out
+
+    def test_changed_refuses_update_baseline(self, tmp_path, capsys):
+        rc, _ = _cli(tmp_path, "--changed", "--update-baseline",
+                     capsys=capsys)
+        assert rc == 2
+
+    def test_changed_refuses_explicit_paths(self, tmp_path, capsys):
+        rc, _ = _cli(tmp_path, "--changed", capsys=capsys)
+        # _cli always passes the scratch tree as an explicit path
+        assert rc == 2

@@ -7,15 +7,64 @@ non-zero otherwise. See docs/static-analysis.md.
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import subprocess
 import sys
 
 from tools.analysis import core, lockorder
+
+# Rules that reason over the WHOLE tree (the derived acquisition graph,
+# the env registry vs. its consumers and docs). On the partial tree a
+# --changed run walks they would report the unwalked remainder as
+# missing — dropped there, never in a full run.
+TREE_WIDE_RULES = ("lock-order", "env-unread", "env-undocumented")
 
 
 def repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+
+def changed_paths(root: str, scope: str = "modelmesh_tpu") -> list[str]:
+    """Changed .py files vs. HEAD (staged, unstaged, and untracked) under
+    the default analyzed tree — the working set a pre-push local
+    iteration cares about. Files outside ``scope`` (tests, tools) are
+    not analyzed by the full run either."""
+    out: set[str] = set()
+    for args in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            args, cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(args)} failed: {proc.stderr.strip()}"
+            )
+        out.update(ln.strip() for ln in proc.stdout.splitlines())
+    return sorted(
+        os.path.join(root, p) for p in out
+        if p.endswith(".py")
+        and p.startswith(scope + "/")
+        and os.path.exists(os.path.join(root, p))
+    )
+
+
+def render_json(findings, baseline) -> str:
+    return json.dumps([
+        {
+            "rule": f.rule,
+            "file": f.path,
+            "line": f.line,
+            "qualname": f.qualname,
+            "token": f.token,
+            "message": f.message,
+            "suppressed": f.key() in baseline,
+        }
+        for f in findings
+    ], indent=2)
 
 
 def main(argv=None) -> int:
@@ -44,6 +93,12 @@ def main(argv=None) -> int:
     ap.add_argument("--only", default=None, metavar="FAMILY[,FAMILY...]",
                     help="run only these rule families for fast local "
                          "iteration; known: " + ", ".join(core.FAMILY_KEYS))
+    ap.add_argument("--changed", action="store_true",
+                    help="analyze only .py files changed vs. HEAD (plus "
+                         "untracked); tree-wide rules are skipped")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="output format (json: one object per finding "
+                         "with a `suppressed` flag for baselined ones)")
     args = ap.parse_args(argv)
 
     paths = args.paths or [os.path.join(root, "modelmesh_tpu")]
@@ -57,6 +112,24 @@ def main(argv=None) -> int:
         print("error: --update-baseline requires a full run "
               "(drop --only)", file=sys.stderr)
         return 2
+    if args.changed and args.update_baseline:
+        # Same hazard as --only: the baseline is shared and full-tree.
+        print("error: --update-baseline requires a full run "
+              "(drop --changed)", file=sys.stderr)
+        return 2
+    if args.changed:
+        if args.paths:
+            print("error: --changed derives its file set from git; "
+                  "drop the explicit paths", file=sys.stderr)
+            return 2
+        try:
+            paths = changed_paths(root)
+        except (RuntimeError, OSError, subprocess.SubprocessError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if not paths:
+            print("0 finding(s) (no changed .py files)")
+            return 0
 
     if args.write_lock_order:
         ctx = core.build_context(paths, root)
@@ -75,6 +148,8 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.changed:
+        findings = [f for f in findings if f.rule not in TREE_WIDE_RULES]
 
     if args.update_baseline:
         core.write_baseline(args.baseline, findings)
@@ -86,6 +161,17 @@ def main(argv=None) -> int:
     fresh = [f for f in findings if f.key() not in baseline]
     stale = set(baseline) - {f.key() for f in findings}
 
+    if args.format == "json":
+        # stdout is pure JSON (machine consumers pipe it); the stale
+        # note is advisory and goes to stderr.
+        print(render_json(findings, baseline))
+        if stale and not args.changed:
+            print(
+                f"note: {len(stale)} baseline entr(ies) no longer fire",
+                file=sys.stderr,
+            )
+        return 1 if fresh else 0
+
     for f in fresh:
         print(f.render())
     suppressed = len(findings) - len(fresh)
@@ -93,7 +179,9 @@ def main(argv=None) -> int:
         f"\n{len(fresh)} finding(s) "
         f"({suppressed} baselined, {len(findings)} total)"
     )
-    if stale:
+    if stale and not args.changed:
+        # a --changed run only saw a slice of the tree: entries for
+        # unwalked files LOOK stale but are not
         print(
             f"note: {len(stale)} baseline entr(ies) no longer fire — "
             f"prune them:\n  " + "\n  ".join(sorted(stale))

@@ -34,6 +34,10 @@ Conventions understood across the rules:
   a DELIBERATE device->host materialization (the one batched per-cycle
   readback, a host-built index array) for the host-round-trip rule,
   which polices the solver steady-state path's device residency.
+- ``#: shared-ok: <reason>`` on (or immediately above) an attribute
+  assignment declares the attribute DELIBERATELY shared without a lock
+  (GIL-atomic flags, single-writer counters, single-threaded-by-contract
+  state) for the shared-state escape rule (tools/analysis/sharedstate.py).
 """
 
 from __future__ import annotations
@@ -61,6 +65,8 @@ HOST_SYNC_RE = re.compile(r"#:\s*host-sync:\s*(?P<why>\S.*)$")
 _STATE_FUNNEL_RE = re.compile(
     r"#:\s*state-funnel:\s*(?P<methods>\w+(?:\s*,\s*\w+)*)"
 )
+# Deliberately lock-free shared attribute (shared-state escape rule).
+SHARED_OK_RE = re.compile(r"#:\s*shared-ok:\s*(?P<why>\S.*)$")
 # Rule names contain single hyphens, so the justification separator is
 # an em/en dash or a double hyphen: "# analysis-ok: <rules> — <why>".
 _SUPPRESS_RE = re.compile(
@@ -105,6 +111,15 @@ class Annotation:
 class FunnelAnnotation:
     attr: str
     methods: tuple[str, ...]   # the only methods allowed to write
+    cls: str
+    path: str
+    line: int
+
+
+@dataclass
+class SharedOkAnnotation:
+    attr: str
+    why: str
     cls: str
     path: str
     line: int
@@ -198,6 +213,8 @@ class LockRegistry:
         self.funnels: dict[str, dict[str, FunnelAnnotation]] = {}
         # attr -> funnel annotations across all classes
         self.funnels_by_attr: dict[str, list[FunnelAnnotation]] = {}
+        # class -> {attr: SharedOkAnnotation} (deliberately lock-free)
+        self.shared_ok: dict[str, dict[str, SharedOkAnnotation]] = {}
 
     def add_lock(self, cls: str, attr: str) -> None:
         self.class_locks.setdefault(cls, set()).add(attr)
@@ -211,6 +228,9 @@ class LockRegistry:
     def add_funnel(self, ann: FunnelAnnotation) -> None:
         self.funnels.setdefault(ann.cls, {})[ann.attr] = ann
         self.funnels_by_attr.setdefault(ann.attr, []).append(ann)
+
+    def add_shared_ok(self, ann: SharedOkAnnotation) -> None:
+        self.shared_ok.setdefault(ann.cls, {})[ann.attr] = ann
 
     def alias_of(self, cls: str, attr: str) -> Optional[str]:
         return self.cond_alias.get((cls, attr))
@@ -390,6 +410,18 @@ def _collect_annotations(registry: LockRegistry, mod: ModuleInfo) -> None:
                     path=mod.relpath,
                     line=target_line,
                 ))
+        s = SHARED_OK_RE.search(line)
+        if s:
+            resolved = _annotated_attr(mod, i)
+            if resolved is not None:
+                attr, target_line = resolved
+                registry.add_shared_ok(SharedOkAnnotation(
+                    attr=attr,
+                    why=s.group("why").strip(),
+                    cls=line_class.get(target_line, ""),
+                    path=mod.relpath,
+                    line=target_line,
+                ))
 
 
 # --------------------------------------------------------------------- #
@@ -514,7 +546,7 @@ def build_context(paths: Iterable[str], repo_root: str) -> AnalysisContext:
 # (comma-separated); every key runs by default.
 FAMILY_KEYS = (
     "guarded-by", "blocking", "lock-order", "jax",
-    "clock", "determinism", "state-funnel", "env",
+    "clock", "determinism", "state-funnel", "env", "shared-state",
 )
 
 
@@ -535,6 +567,7 @@ def run_analysis(
         guards,
         jaxhazards,
         lockorder,
+        sharedstate,
         statefunnel,
     )
 
@@ -549,6 +582,7 @@ def run_analysis(
         "determinism": determinism.check,
         "state-funnel": statefunnel.check,
         "env": envrules.check,
+        "shared-state": sharedstate.check,
     }
     selected = list(only) if only else list(FAMILY_KEYS)
     unknown = [k for k in selected if k not in runners]

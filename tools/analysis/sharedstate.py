@@ -1,0 +1,458 @@
+"""Rule family 9 — ``shared-state``: escape analysis for unannotated
+shared mutable instance state.
+
+The existing lock rules only police fields someone *remembered* to
+annotate. This family finds the fields they forgot: it builds a
+per-class **thread-root graph** — every entry point whose body may run
+on a thread other than the caller's —
+
+- ``thread:<m>``   ``threading.Thread(target=self.m)`` (and Timer)
+- ``pool:<m>``     ``<pool>.submit(self.m, ...)`` (utils/pool.py et al.)
+- ``timer:<m>``    ``clock.call_later(delay, self.m)`` timer bodies
+- ``watch:<m>``    KV ``watch(...)`` / ``add_listener(...)`` callbacks
+- ``grpc:<m>``     public methods of ``*Servicer`` classes
+- ``cb:<m>``       any other escaping bound-method reference (a
+                   ``self.m`` loaded anywhere except as the function of
+                   a call — the serving/tasks.py cadence ``specs``
+                   tables, strategy callbacks, partials)
+- ``api``          every public method, once the class has any root
+                   above (arbitrary request threads enter through the
+                   public surface of an object that already owns
+                   background work)
+
+then propagates reachability through the intra-class call graph
+(``self.m()`` and nested-def calls) and flags every **lexically
+unprotected write** to a ``self.<attr>`` that is reachable from >= 2
+distinct roots.
+
+A write is protected when it sits under a ``with``-held registered lock
+(any lock — once a field is annotated ``#: guarded-by:`` the guards
+family enforces it is the *right* one), inside a ``*_locked``
+caller-holds-the-lock method, inside ``__init__``-family constructors
+(construction happens-before publication), or when every call chain
+from every root to its enclosing function passes through a held call
+site (a helper only ever invoked under the lock).
+
+Exempt attributes: lock/condition attributes themselves, fields already
+covered by ``#: guarded-by:`` or ``#: state-funnel:`` annotations
+(those families own them), and fields declared deliberately lock-free
+with the new ``#: shared-ok: <reason>`` grammar.
+
+Known under-approximation (documented in docs/static-analysis.md): the
+>= 2-root test counts *writing* paths only, so a single-writer field
+read lock-free from another root never fires — that is exactly the
+single-writer contract ``#: shared-ok:`` exists to document, and the
+MM_RACE_DEBUG runtime witness (utils/racedebug.py) covers the dynamic
+side of the same hazard class.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.analysis.core import (
+    LOCKED_SUFFIX,
+    AnalysisContext,
+    Finding,
+    ModuleInfo,
+    receiver_and_attr,
+    with_lock_items,
+)
+from tools.analysis.guards import (
+    EXEMPT_FUNCS,
+    HEAPQ_FNS,
+    MUTATORS,
+    _writes_in_target,
+)
+
+RULE = "shared-state"
+
+# Call shapes that hand a callable to another thread's run loop. Maps
+# callee name -> which argument positions may carry the callback
+# (None = every positional argument).
+_THREAD_CTORS = {"Thread", "Timer"}
+_SUBMIT_NAMES = {"submit"}
+_TIMER_NAMES = {"call_later"}
+_WATCH_NAMES = {"watch", "add_listener"}
+
+
+@dataclass
+class _Site:
+    """One self-attribute write inside a function."""
+    attr: str
+    line: int
+    token: str
+    held: bool           # lexically under a registered lock
+
+
+@dataclass
+class _FuncInfo:
+    key: str                             # "m" or "m.nested"
+    node: ast.AST
+    writes: list[_Site] = field(default_factory=list)
+    # callee key -> True if EVERY call site in this function is held
+    calls: dict[str, bool] = field(default_factory=dict)
+    roots: list[tuple[str, str]] = field(default_factory=list)  # (root, seed)
+
+
+def _call_name(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+class _ClassScan:
+    """Per-class: function map, call graph, writes, thread roots."""
+
+    def __init__(self, mod: ModuleInfo, ctx: AnalysisContext,
+                 node: ast.ClassDef):
+        self.mod = mod
+        self.ctx = ctx
+        self.cls = node.name
+        self.node = node
+        self.funcs: dict[str, _FuncInfo] = {}
+        self.methods: set[str] = set()       # direct method names
+        # property/cached_property getters and setters: a bare
+        # ``self.name`` load runs the getter on the CURRENT thread — a
+        # call edge, never an escaping callback reference
+        self.properties: set[str] = set()
+        self.is_servicer = node.name.endswith("Servicer") or any(
+            isinstance(b, (ast.Name, ast.Attribute))
+            and (b.id if isinstance(b, ast.Name) else b.attr).endswith(
+                "Servicer")
+            for b in node.bases
+        )
+        self._collect_funcs(node, prefix="")
+        for info in self.funcs.values():
+            _FuncVisitor(self, info).run()
+
+    def _collect_funcs(self, owner: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(owner):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{prefix}{child.name}"
+                self.funcs[key] = _FuncInfo(key=key, node=child)
+                if not prefix:
+                    self.methods.add(child.name)
+                    for dec in child.decorator_list:
+                        name = (
+                            dec.id if isinstance(dec, ast.Name)
+                            else dec.attr if isinstance(dec, ast.Attribute)
+                            else ""
+                        )
+                        if name in ("property", "cached_property",
+                                    "setter", "getter", "deleter"):
+                            self.properties.add(child.name)
+                self._collect_funcs(child, prefix=f"{key}.")
+            elif not isinstance(child, ast.ClassDef):
+                self._collect_funcs(child, prefix)
+
+    def resolve_call(self, caller_key: str, name: str) -> str | None:
+        """Resolve a bare-name call/reference inside ``caller_key`` to a
+        nested-def key (innermost enclosing scope wins)."""
+        parts = caller_key.split(".")
+        for i in range(len(parts), 0, -1):
+            cand = ".".join(parts[:i]) + "." + name
+            if cand in self.funcs:
+                return cand
+        return None
+
+
+class _FuncVisitor(ast.NodeVisitor):
+    """One pass over one function body: writes (with held-lock state),
+    intra-class call edges, and thread-root registrations."""
+
+    def __init__(self, scan: _ClassScan, info: _FuncInfo):
+        self.scan = scan
+        self.info = info
+        # *_locked methods run with the caller's lock held by contract.
+        self.depth = 1 if info.node.name.endswith(LOCKED_SUFFIX) else 0
+        # node ids already consumed as a call's func or a root callback
+        self._consumed: set[int] = set()
+
+    def run(self) -> None:
+        for stmt in self.info.node.body:
+            self.visit(stmt)
+
+    # -- lock context ------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = bool(with_lock_items(node, self.scan.ctx.registry))
+        if locked:
+            self.depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if locked:
+            self.depth -= 1
+        for item in node.items:
+            self.visit(item.context_expr)
+
+    # Nested defs are separate _FuncInfo entries with their own pass.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- writes ------------------------------------------------------------
+
+    def _record(self, w) -> None:
+        if w.receiver != "self":
+            return
+        self.info.writes.append(_Site(
+            attr=w.attr, line=w.line, token=w.token,
+            held=self.depth > 0,
+        ))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            for w in _writes_in_target(target, rebind=True):
+                self._record(w)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            for w in _writes_in_target(node.target, rebind=True):
+                self._record(w)
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        for w in _writes_in_target(node.target, rebind=True):
+            self._record(w)
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            for w in _writes_in_target(target, rebind=True):
+                self._record(w)
+
+    # -- calls, roots, escapes ---------------------------------------------
+
+    def _self_method(self, node: ast.AST) -> str | None:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in self.scan.methods
+        ):
+            return node.attr
+        return None
+
+    def _callback_seeds(self, node: ast.AST) -> list[str]:
+        """Function keys a callback expression hands off (self-method,
+        nested-def name, or the self-methods referenced in a lambda)."""
+        m = self._self_method(node)
+        if m is not None:
+            self._consumed.add(id(node))
+            if m in self.scan.properties:
+                self._add_call(m)
+                return []
+            return [m]
+        if isinstance(node, ast.Name):
+            key = self.scan.resolve_call(self.info.key, node.id)
+            if key is not None:
+                self._consumed.add(id(node))
+                return [key]
+        if isinstance(node, ast.Lambda):
+            seeds = []
+            for sub in ast.walk(node.body):
+                m = self._self_method(sub)
+                if m is not None:
+                    self._consumed.add(id(sub))
+                    seeds.append(m)
+            return seeds
+        return []
+
+    def _add_root(self, kind: str, seeds: list[str]) -> None:
+        for seed in seeds:
+            short = seed.rsplit(".", 1)[-1]
+            self.info.roots.append((f"{kind}:{short}", seed))
+
+    def _add_call(self, callee: str) -> None:
+        held = self.depth > 0
+        # edge is "held" only if EVERY call site in this caller is held
+        self.info.calls[callee] = self.info.calls.get(callee, True) and held
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        self._consumed.add(id(fn))
+        name = _call_name(node)
+
+        # mutator / heapq writes (same shapes the guards family checks)
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATORS:
+            ra = receiver_and_attr(fn.value)
+            if ra is not None and ra[0] == "self":
+                self.info.writes.append(_Site(
+                    attr=ra[1], line=node.lineno,
+                    token=f"self.{ra[1]}.{fn.attr}()",
+                    held=self.depth > 0,
+                ))
+        if (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "heapq"
+            and fn.attr in HEAPQ_FNS
+            and node.args
+        ):
+            ra = receiver_and_attr(node.args[0])
+            if ra is not None and ra[0] == "self":
+                self.info.writes.append(_Site(
+                    attr=ra[1], line=node.lineno,
+                    token=f"heapq.{fn.attr}(self.{ra[1]})",
+                    held=self.depth > 0,
+                ))
+
+        # intra-class call edges
+        m = self._self_method(fn)
+        if m is not None:
+            self._add_call(m)
+        elif isinstance(fn, ast.Name):
+            key = self.scan.resolve_call(self.info.key, fn.id)
+            if key is not None:
+                self._add_call(key)
+
+        # thread-root shapes
+        cb_args: list[ast.AST] = []
+        kind = None
+        if name in _THREAD_CTORS:
+            kind = "thread"
+            cb_args = [kw.value for kw in node.keywords
+                       if kw.arg == "target"]
+        elif name in _SUBMIT_NAMES and node.args:
+            kind = "pool"
+            cb_args = [node.args[0]]
+        elif name in _TIMER_NAMES and len(node.args) >= 2:
+            kind = "timer"
+            cb_args = list(node.args[1:])
+        elif name in _WATCH_NAMES:
+            kind = "watch"
+            cb_args = list(node.args)
+        if kind is not None:
+            for arg in cb_args:
+                self._add_root(kind, self._callback_seeds(arg))
+
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # a bound-method reference in any non-call position escapes —
+        # the specs tables in serving/tasks.py, partials, registrations
+        if id(node) not in self._consumed and isinstance(node.ctx, ast.Load):
+            m = self._self_method(node)
+            if m is not None:
+                if m in self.scan.properties:
+                    self._add_call(m)
+                else:
+                    self._add_root("cb", [m])
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        # same for nested-def names handed off by name
+        if id(node) not in self._consumed and isinstance(node.ctx, ast.Load):
+            key = self.scan.resolve_call(self.info.key, node.id)
+            if key is not None:
+                self._add_root("cb", [key])
+
+
+def _reach(scan: _ClassScan) -> tuple[dict[str, set[str]],
+                                      dict[str, set[str]]]:
+    """Per function key: set of roots reaching it at all, and the subset
+    reaching it through a chain with no lock-held call site."""
+    roots: dict[str, set[str]] = {}           # seed key -> root ids
+    for info in scan.funcs.values():
+        for root_id, seed in info.roots:
+            roots.setdefault(seed, set()).add(root_id)
+    if scan.is_servicer:
+        for m in scan.methods:
+            if not m.startswith("_"):
+                roots.setdefault(m, set()).add(f"grpc:{m}")
+    if roots:
+        # arbitrary request threads enter through the public surface of
+        # any object that already owns background work
+        for m in scan.methods:
+            if not m.startswith("_"):
+                roots.setdefault(m, set()).add("api")
+
+    reach_any: dict[str, set[str]] = {}
+    reach_unheld: dict[str, set[str]] = {}
+    for seed, ids in roots.items():
+        if seed not in scan.funcs:
+            continue
+        for root_id in ids:
+            # 2-state BFS: (key, unheld-chain?)
+            seen: set[tuple[str, bool]] = set()
+            start_unheld = not scan.funcs[seed].node.name.endswith(
+                LOCKED_SUFFIX)
+            frontier = [(seed, start_unheld)]
+            while frontier:
+                key, unheld = frontier.pop()
+                if (key, unheld) in seen:
+                    continue
+                seen.add((key, unheld))
+                reach_any.setdefault(key, set()).add(root_id)
+                if unheld:
+                    reach_unheld.setdefault(key, set()).add(root_id)
+                for callee, all_held in scan.funcs[key].calls.items():
+                    if callee not in scan.funcs:
+                        continue
+                    frontier.append((callee, unheld and not all_held))
+    return reach_any, reach_unheld
+
+
+def check(ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    reg = ctx.registry
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            scan = _ClassScan(mod, ctx, node)
+            reach_any, reach_unheld = _reach(scan)
+            if not reach_any:
+                continue
+            cls = scan.cls
+            exempt = set(reg.class_locks.get(cls, ()))
+            exempt |= set(reg.annotations.get(cls, {}))
+            exempt |= set(reg.funnels.get(cls, {}))
+            exempt |= set(reg.shared_ok.get(cls, {}))
+            # which roots' writing paths touch each attr (held or not)
+            attr_roots: dict[str, set[str]] = {}
+            for key, info in scan.funcs.items():
+                base = key.split(".", 1)[0]
+                if base in EXEMPT_FUNCS:
+                    continue
+                for w in info.writes:
+                    if w.attr in exempt:
+                        continue
+                    attr_roots.setdefault(w.attr, set()).update(
+                        reach_any.get(key, ()))
+            for key, info in scan.funcs.items():
+                base = key.split(".", 1)[0]
+                if base in EXEMPT_FUNCS:
+                    continue
+                unheld_roots = reach_unheld.get(key, set())
+                if not unheld_roots:
+                    continue
+                for w in info.writes:
+                    if w.held or w.attr in exempt:
+                        continue
+                    all_roots = attr_roots.get(w.attr, set())
+                    if len(all_roots) < 2:
+                        continue
+                    findings.append(Finding(
+                        rule=RULE,
+                        path=mod.relpath,
+                        line=w.line,
+                        qualname=f"{cls}.{key}",
+                        token=w.token,
+                        message=(
+                            f"unsynchronized write to {w.token} reachable "
+                            f"from {len(all_roots)} thread roots "
+                            f"({', '.join(sorted(all_roots))}); guard it, "
+                            f"funnel it, or annotate `#: shared-ok: <why>`"
+                        ),
+                    ))
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings

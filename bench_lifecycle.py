@@ -45,6 +45,16 @@ MM_PUBLISH_COALESCE_MS):
                 counted vs cold store loads, which must stay zero); the
                 controller-off twin never scales and censors at the
                 cap.
+  sharded     — placement-group serving (sharded execution): a model
+                bigger than ANY single instance's capacity is planned as
+                a K-shard group, each member pulling its shard through
+                the contended store — time-to-servable covers the plan
+                plus the serialized shard pulls. A member then drains
+                under probe traffic: the group-atomic re-plan pre-copies
+                the leaver's shard to a survivor BEFORE dropping it, so
+                failed probes must be ZERO; with MM_PEER_FETCH the
+                pre-copy streams ~1/K of the bytes shard-to-shard
+                instead of paying another contended store download.
   drain       — zero-downtime reconfiguration (reconfig/drain.py): a
                 16-model instance drains while a peer-side probe thread
                 keeps invoking every model. Measures time-to-drain and
@@ -524,6 +534,229 @@ def _measure_drain(peer_fetch: bool, models: int, fleet: int,
     }
 
 
+SHARD_MODEL_BYTES = 1 << 20          # 128 units: > one instance's capacity
+SHARD_CAPACITY_BYTES = 768 * 1024    # 96 units per instance -> K=2 groups
+SHARD_INFO = ModelInfo(model_type="bench", model_path="mlp://oversized")
+
+
+class _ShardedLoader(ModelLoader):
+    """Placement-group bench loader: an oversized model loads as weight
+    shards (store pulls through the shared contended store), and shards
+    stream peer-to-peer under shard fingerprints — the drain re-plan's
+    pre-copy path. Chunk counts stand in for leaves, like the sim."""
+
+    CHUNKS = 8
+
+    def __init__(self, store: _ContendedStore, load_ms: float,
+                 stream_ms: float = 1.0):
+        self.store = store
+        self.load_ms = load_ms
+        self.stream_ms = stream_ms
+        self.shard_store_loads = 0
+        self.shard_stream_loads = 0
+        self.shard_coords: dict[str, tuple[int, int]] = {}
+
+    def startup(self) -> LocalInstanceParams:
+        return LocalInstanceParams(
+            capacity_bytes=SHARD_CAPACITY_BYTES, load_timeout_ms=60_000,
+            default_model_size_bytes=SHARD_MODEL_BYTES,
+        )
+
+    def load(self, model_id: str, info: ModelInfo) -> LoadedModel:
+        self.store.download(self.load_ms / 1e3)
+        return LoadedModel(handle=model_id, size_bytes=SHARD_MODEL_BYTES)
+
+    def predict_size(self, model_id: str, info: ModelInfo) -> int:
+        return SHARD_MODEL_BYTES
+
+    def unload(self, model_id: str) -> None:
+        self.shard_coords.pop(model_id, None)
+
+    @property
+    def requires_unload(self) -> bool:
+        return False
+
+    @property
+    def supports_weight_streaming(self) -> bool:
+        return True
+
+    @property
+    def supports_sharded_execution(self) -> bool:
+        return True
+
+    def _share(self, shard_count: int) -> int:
+        return -(-SHARD_MODEL_BYTES // max(shard_count, 1))
+
+    def load_shard(self, model_id, info, shard_index, shard_count):
+        self.store.download(self.load_ms / 1e3)
+        self.shard_store_loads += 1
+        self.shard_coords[model_id] = (shard_index, shard_count)
+        return LoadedModel(handle=model_id,
+                           size_bytes=self._share(shard_count))
+
+    def export_shard_weights(self, model_id, handle):
+        from modelmesh_tpu.runtime.spi import WeightChunk
+        from modelmesh_tpu.transfer.protocol import shard_chunk_indices
+
+        coords = self.shard_coords.get(model_id)
+        if coords is None:
+            return None
+        k, count = coords
+        idxs = list(shard_chunk_indices(self.CHUNKS, k, count))
+        payload = b"s" * (self._share(count) // max(len(idxs), 1))
+        return iter([
+            WeightChunk(seq=i, payload=payload, layer=layer,
+                        last=i == len(idxs) - 1)
+            for i, layer in enumerate(idxs)
+        ])
+
+    def load_shard_from_stream(self, model_id, info, shard_index,
+                               shard_count, chunks):
+        from modelmesh_tpu.transfer.protocol import shard_chunk_indices
+
+        seen = set()
+        for chunk in chunks:
+            seen.add(chunk.layer)
+            if self.stream_ms:
+                time.sleep(self.stream_ms / 1e3 / self.CHUNKS)
+        want = set(shard_chunk_indices(self.CHUNKS, shard_index, shard_count))
+        if seen != want:
+            raise RuntimeError(
+                f"shard stream delivered {sorted(seen)}, owns {sorted(want)}"
+            )
+        self.shard_stream_loads += 1
+        self.shard_coords[model_id] = (shard_index, shard_count)
+        return LoadedModel(handle=model_id,
+                           size_bytes=self._share(shard_count))
+
+
+def _sharded_fleet(n, kv, peer_fetch: bool, load_ms: float):
+    store = _ContendedStore()
+    by_endpoint = {}
+
+    def peer_call(endpoint, model_id, method, payload, headers, ctx):
+        return by_endpoint[endpoint].invoke_model(
+            model_id, method, payload, headers, ctx, sync=True
+        )
+
+    def peer_fetch_call(endpoint, model_id, chunk_index, fingerprint):
+        return by_endpoint[endpoint].handle_weight_fetch(
+            model_id, chunk_index, fingerprint
+        )
+
+    loaders, insts = [], []
+    for i in range(n):
+        loader = _ShardedLoader(store, load_ms)
+        loaders.append(loader)
+        inst = ModelMeshInstance(
+            kv,
+            loader,
+            InstanceConfig(
+                instance_id=f"i-{i:02d}", endpoint=f"ep-{i:02d}",
+                load_timeout_s=60, min_churn_age_ms=0,
+                load_fastpath=True, publish_coalesce_ms=0,
+                peer_fetch=peer_fetch, sharded=True,
+            ),
+            peer_call=peer_call,
+            peer_fetch=peer_fetch_call,
+            runtime_call=(
+                lambda ce, method, payload, headers, cancel_event=None:
+                payload
+            ),
+        )
+        by_endpoint[inst.config.endpoint] = inst
+        insts.append(inst)
+    for inst in insts:
+        inst.instances_view.wait_for(lambda v: len(v) >= n, timeout=30)
+    return insts, loaders, store
+
+
+def _measure_sharded(peer_fetch: bool, fleet: int, load_ms: float,
+                     reps: int) -> dict:
+    """Serve a model bigger than any one instance as a placement group,
+    then drain a member under probe traffic. time_to_servable covers
+    group planning + every shard's (serialized, contended) store load;
+    the drain re-plan hands the leaver's shard to a survivor — streamed
+    peer-to-peer (~1/K of the bytes) with peer_fetch, one more contended
+    store download without."""
+    import threading
+
+    from modelmesh_tpu.reconfig.drain import DrainController
+
+    ttfs, drain_ms, gaps, probes = [], [], [], []
+    shards, form_store, replan_stream, replan_store, migrated = \
+        [], [], [], [], []
+    for r in range(reps):
+        kv = InMemoryKV(sweep_interval_s=3600.0)
+        insts, loaders, store = _sharded_fleet(fleet, kv, peer_fetch, load_ms)
+        inst = insts[0]
+        mid = f"big-{r}"
+        inst.register_model(mid, SHARD_INFO)
+        ttfs.append(timed_ms(
+            lambda: inst.invoke_model(mid, "predict", b"x" * 64, [])
+        ))
+        mr = inst.registry.get(mid)
+        assert mr is not None and mr.shard_count >= 2, (
+            f"group never formed: shard_count={getattr(mr, 'shard_count', 0)}"
+        )
+        assert mr.group_complete, "served before the group completed"
+        shards.append(mr.shard_count)
+        form_store.append(sum(ld.shard_store_loads for ld in loaders))
+        members = set(mr.shard_instances)
+        src = next(i for i in insts if i.instance_id in members)
+        via = next(i for i in insts if i.instance_id != src.instance_id)
+        failures, successes = [], [0]
+        stop = threading.Event()
+
+        def probe():
+            while not stop.is_set():
+                try:
+                    via.invoke_model(mid, "p", b"x", [])
+                    successes[0] += 1
+                except Exception as e:  # noqa: BLE001 — the gap metric
+                    failures.append(f"{mid}: {type(e).__name__}")
+                time.sleep(0.0005)
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        reports = []
+        drain_ms.append(timed_ms(
+            lambda: reports.append(
+                DrainController(src, deadline_s=120).drain()
+            )
+        ))
+        stop.set()
+        t.join(timeout=10)
+        report = reports[0]
+        assert mid in report.migrated, (
+            f"shard never re-planned: {report.failed or report.dropped}"
+        )
+        migrated.append(len(report.migrated))
+        gaps.append(len(failures))
+        probes.append(successes[0] + len(failures))
+        replan_stream.append(sum(ld.shard_stream_loads for ld in loaders))
+        replan_store.append(
+            sum(ld.shard_store_loads for ld in loaders) - form_store[-1]
+        )
+        _close(insts, kv)
+    return {
+        "reps": reps,
+        "fleet": fleet,
+        "load_ms": load_ms,
+        "model_bytes": SHARD_MODEL_BYTES,
+        "instance_capacity_bytes": SHARD_CAPACITY_BYTES,
+        "shard_count": min(shards),
+        "time_to_servable_ms": median_ms(ttfs),
+        "formation_store_loads": max(form_store),
+        "drain_ms": median_ms(drain_ms),
+        "replan_stream_loads": min(replan_stream),
+        "replan_store_loads": max(replan_store),
+        "migrated": min(migrated),
+        "probe_requests": min(probes),
+        "failed_requests": max(gaps),
+    }
+
+
 def _counting_metrics():
     """Counter-only metrics sink: per-Metric totals; everything else
     inherits NoopMetrics' no-ops (gauges/histograms are rendered
@@ -837,7 +1070,8 @@ def run(load_ms: float = 80.0, size_ms: float = 80.0, n_copies: int = 4,
         fleet: int = 5, mass_models: int = 500, reps: int = 3,
         crowd_copies: int = 8, crowd_fleet: int = 9,
         drain_models: int = 16, drain_fleet: int = 3,
-        autoscale_fleet: int = 3, autoscale_cap_s: float = 8.0) -> dict:
+        autoscale_fleet: int = 3, autoscale_cap_s: float = 8.0,
+        shard_fleet: int = 3) -> dict:
     serial_fs = _measure_first_serve(False, load_ms, size_ms, reps)
     fast_fs = _measure_first_serve(True, load_ms, size_ms, reps)
     serial_nc = _measure_n_copies(False, n_copies, fleet, load_ms, reps)
@@ -857,6 +1091,8 @@ def run(load_ms: float = 80.0, size_ms: float = 80.0, n_copies: int = 4,
     drain_store = _measure_drain(
         False, drain_models, drain_fleet, load_ms, reps
     )
+    sharded_peer = _measure_sharded(True, shard_fleet, load_ms, reps)
+    sharded_store = _measure_sharded(False, shard_fleet, load_ms, reps)
     as_on = _measure_autoscale_recovery(
         "burn", autoscale_fleet, load_ms, reps, cap_s=autoscale_cap_s
     )
@@ -920,6 +1156,18 @@ def run(load_ms: float = 80.0, size_ms: float = 80.0, n_copies: int = 4,
                 as_off["recovery_ms"] / max(as_on["recovery_ms"], 1e-9), 2
             ),
         },
+        "sharded": {
+            "peer_stream": sharded_peer,
+            "store_fallback": sharded_store,
+            # Group-atomic drain headline: zero failed probes in BOTH
+            # modes (the group keeps a servable holder of every shard
+            # throughout), and the re-plan pre-copy streams ~1/K of the
+            # bytes peer-to-peer instead of another contended store pull.
+            "drain_speedup": round(
+                sharded_store["drain_ms"]
+                / max(sharded_peer["drain_ms"], 1e-9), 2
+            ),
+        },
         "drain": {
             "peer_precopy": drain_peer,
             "store_fallback": drain_store,
@@ -949,12 +1197,13 @@ def main() -> int:
     ap.add_argument("--drain-fleet", type=int, default=3)
     ap.add_argument("--autoscale-fleet", type=int, default=3)
     ap.add_argument("--autoscale-cap-s", type=float, default=8.0)
+    ap.add_argument("--shard-fleet", type=int, default=3)
     args = ap.parse_args()
     print(json.dumps(run(
         args.load_ms, args.size_ms, args.n_copies, args.fleet,
         args.mass_models, args.reps, args.crowd_copies, args.crowd_fleet,
         args.drain_models, args.drain_fleet,
-        args.autoscale_fleet, args.autoscale_cap_s,
+        args.autoscale_fleet, args.autoscale_cap_s, args.shard_fleet,
     )))
     return 0
 

@@ -38,6 +38,25 @@ log = logging.getLogger(__name__)
 PREDICT_METHOD = "/mmtpu.models.JaxPredictor/Predict"
 
 
+def shard_servable(model: ServableModel, mesh) -> ServableModel:
+    """Re-home a built model's parameters onto the serving mesh with the
+    per-family partition spec (parallel/mesh.py): weight matrices
+    column-sharded on the ``mdl`` axis, everything else replicated. The
+    family's jitted apply is reused unchanged — the committed input
+    layouts make jit compile a distributed executable. ``fuse_key`` is
+    cleared: a sharded copy must never stack into a fused group with
+    replicated same-architecture models (the stack would re-gather the
+    shards and defeat the memory split)."""
+    from modelmesh_tpu.parallel.mesh import shard_params
+
+    sharded = ServableModel(
+        model.apply, shard_params(model.params, mesh), model.input_shape,
+        model.input_dtype, family=model.family, fuse_key="",
+        batch_safe=model.batch_safe,
+    )
+    return sharded
+
+
 class JaxModelStore:
     """Loaded-model registry shared by the gRPC and in-process fronts.
 
@@ -84,6 +103,45 @@ class JaxModelStore:
         model = build_model(model_id, model_type, model_path)
         # Materialize + warm the jit before declaring loaded, so first
         # inference latency isn't a compile.
+        import numpy as np
+
+        import jax
+
+        jax.block_until_ready(jax.tree.leaves(model.params))
+        warm = np.zeros((1, *model.input_shape), model.input_dtype)
+        model.predict_bytes(warm.tobytes())
+        with self._lock:
+            self._models[model_id] = model
+        return model.size_bytes
+
+    def load_sharded(
+        self, model_id: str, model_type: str, model_path: str, mesh=None,
+    ) -> int:
+        """Load with pjit/NamedSharding execution over the serving mesh
+        (parallel/mesh.py): parameters are device_put with the per-family
+        partition spec (weight matrices column-sharded on the ``mdl``
+        axis, vectors replicated), and the family's jitted apply then
+        compiles a distributed executable against the committed layouts —
+        XLA inserts the collectives. Restricted to
+        LAYER_STREAMABLE_FAMILIES (their compute is dense per-layer
+        matmuls, so the column split is always valid). On a 1-device
+        mesh the program is bitwise identical to ``load`` (the tier-1
+        parity gate pins this)."""
+        from modelmesh_tpu.models.families import LAYER_STREAMABLE_FAMILIES
+        from modelmesh_tpu.parallel.mesh import serving_mesh
+
+        with self._lock:
+            existing = self._models.get(model_id)
+            if existing is not None:
+                return existing.size_bytes
+        model = build_model(model_id, model_type, model_path)
+        if model.family not in LAYER_STREAMABLE_FAMILIES:
+            raise ValueError(
+                f"family {model.family!r} is not sharded-executable "
+                f"(layer-streamable families only: "
+                f"{sorted(LAYER_STREAMABLE_FAMILIES)})"
+            )
+        model = shard_servable(model, mesh or serving_mesh())
         import numpy as np
 
         import jax
@@ -690,6 +748,143 @@ class InProcessJaxLoader(ModelLoader[ServableModel]):
         model.predict_bytes(warm.tobytes())
         self.store.install(model_id, model)
         return LoadedModel(handle=model, size_bytes=model.size_bytes)
+
+    # -- sharded execution (placement groups) ------------------------------
+    #
+    # In-process runtime semantics: a "shard" here is device-level — the
+    # full parameter set lands SHARDED ACROSS THE LOCAL SERVING MESH
+    # (NamedSharding over parallel/mesh.serving_mesh), and the loader
+    # reports only the shard's SHARE of the bytes (total/shard_count) as
+    # resident, which is exactly what each member of a real multi-host
+    # group holds. Fleet-level slicing (each instance resident with only
+    # 1/K of the leaves) is what the transfer path moves: export for a
+    # shard handle yields only the shard's leaf range, and
+    # load_shard_from_stream grafts those leaves while the deterministic
+    # skeleton supplies the remainder — the same source ``load_shard``'s
+    # store fallback uses, so the stream saves exactly the store egress
+    # a real deployment would save.
+
+    @property
+    def supports_sharded_execution(self) -> bool:
+        return True
+
+    def load_shard(
+        self, model_id: str, info: ModelInfo, shard_index: int,
+        shard_count: int,
+    ) -> LoadedModel[ServableModel]:
+        try:
+            total = self.store.load_sharded(
+                model_id, info.model_type, info.model_path
+            )
+        except Exception as e:  # noqa: BLE001
+            raise ModelLoadException(f"{type(e).__name__}: {e}") from e
+        handle = self.store.get(model_id)
+        handle.shard_index = shard_index
+        handle.shard_count = shard_count
+        share = -(-total // max(shard_count, 1))
+        return LoadedModel(handle=handle, size_bytes=share)
+
+    def export_shard_weights(self, model_id: str, handle: ServableModel):
+        """Chunk stream carrying ONLY this shard's leaf range (the
+        contiguous leaf block from ``shard_chunk_indices`` over the leaf
+        count). ``layer`` stays the GLOBAL leaf index so a same-shard
+        receiver grafts at the right tree positions."""
+        import jax
+        import numpy as np
+
+        from modelmesh_tpu.runtime.spi import WeightChunk
+        from modelmesh_tpu.transfer.protocol import shard_chunk_indices
+        from modelmesh_tpu.utils import envs
+
+        if handle is None:
+            handle = self.store.get(model_id)
+        if handle is None or getattr(handle, "shard_count", 0) <= 0:
+            return None
+        chunk_bytes = max(envs.get_int("MM_TRANSFER_CHUNK_BYTES"), 1)
+        leaves = jax.tree.leaves(handle.params)
+        rng = shard_chunk_indices(
+            len(leaves), handle.shard_index, handle.shard_count
+        )
+
+        def gen():
+            seq = 0
+            idxs = list(rng)
+            for pos, layer in enumerate(idxs):
+                blob = np.asarray(leaves[layer]).tobytes()
+                pieces = [
+                    blob[i: i + chunk_bytes]
+                    for i in range(0, len(blob), chunk_bytes)
+                ] or [b""]
+                for j, piece in enumerate(pieces):
+                    yield WeightChunk(
+                        seq=seq,
+                        payload=piece,
+                        layer=layer,
+                        last=pos == len(idxs) - 1 and j == len(pieces) - 1,
+                    )
+                    seq += 1
+
+        return gen()
+
+    def load_shard_from_stream(
+        self, model_id: str, info: ModelInfo, shard_index: int,
+        shard_count: int, chunks,
+    ) -> LoadedModel[ServableModel]:
+        """Materialize one shard from a stream of ITS leaf range (global
+        leaf indices in ``chunk.layer``); the deterministic skeleton
+        supplies every other leaf. Received leaves are byte-validated
+        against the skeleton exactly like ``load_from_stream``."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from modelmesh_tpu.parallel.mesh import serving_mesh
+        from modelmesh_tpu.transfer.protocol import shard_chunk_indices
+
+        by_layer: dict[int, list[bytes]] = {}
+        for chunk in chunks:
+            by_layer.setdefault(chunk.layer, []).append(chunk.payload)
+        try:
+            skeleton = build_model(model_id, info.model_type, info.model_path)
+        except ValueError as e:
+            raise ModelLoadException(str(e)) from e
+        leaves, treedef = jax.tree.flatten(skeleton.params)
+        want = set(shard_chunk_indices(len(leaves), shard_index, shard_count))
+        if set(by_layer) != want:
+            raise ModelLoadException(
+                f"{model_id}: shard {shard_index}/{shard_count} stream "
+                f"delivered leaves {sorted(by_layer)} but the shard owns "
+                f"{sorted(want)}"
+            )
+        new_leaves = []
+        for i, leaf in enumerate(leaves):
+            if i not in by_layer:
+                new_leaves.append(leaf)
+                continue
+            blob = b"".join(by_layer[i])
+            expect = leaf.size * leaf.dtype.itemsize
+            if len(blob) != expect:
+                raise ModelLoadException(
+                    f"{model_id}: leaf {i} byte length {len(blob)} != "
+                    f"expected {expect} (corrupt shard stream)"
+                )
+            arr = np.frombuffer(blob, dtype=leaf.dtype).reshape(leaf.shape)
+            new_leaves.append(jnp.asarray(arr))
+        params = jax.tree.unflatten(treedef, new_leaves)
+        model = ServableModel(
+            skeleton.apply, params, skeleton.input_shape,
+            skeleton.input_dtype, family=skeleton.family,
+            fuse_key=skeleton.fuse_key, batch_safe=skeleton.batch_safe,
+        )
+        model = shard_servable(model, serving_mesh())
+        model.shard_index = shard_index
+        model.shard_count = shard_count
+        jax.block_until_ready(jax.tree.leaves(model.params))
+        warm = np.zeros((1, *model.input_shape), model.input_dtype)
+        model.predict_bytes(warm.tobytes())
+        self.store.install(model_id, model)
+        share = -(-model.size_bytes // max(shard_count, 1))
+        return LoadedModel(handle=model, size_bytes=share)
 
 
 def main() -> None:

@@ -92,6 +92,15 @@ class Metric(enum.Enum):
     AUTOSCALE_PREWARM_COUNT = ("mm_autoscale_prewarm_count", "counter",
                                "host-tier snapshots staged ahead of "
                                "forecast demand")
+    # Sharded execution (placement groups): plan/load decision counters,
+    # mirrored in the flight recorder's sharded-group events.
+    SHARDED_GROUP_PLAN_COUNT = ("mm_sharded_group_plan_count", "counter",
+                                "placement groups planned (group CAS "
+                                "committed; includes top-up re-plans)")
+    SHARDED_SHARD_LOAD_COUNT = ("mm_sharded_shard_load_count", "counter",
+                                "weight shards loaded locally (any "
+                                "source: peer shard stream, sliced full "
+                                "snapshot, or store)")
     # histograms (ms)
     API_REQUEST_TIME = ("mm_api_request_time_ms", "histogram", "request latency")
     # Per-stage latency decomposition: closed tracing spans export here
@@ -146,6 +155,14 @@ class Metric(enum.Enum):
                       "fraction of windowed requests meeting the class SLO")
     SLO_BURN_RATE = ("mm_slo_burn_rate", "gauge",
                      "error-budget burn rate (1 = burning exactly at budget)")
+    # Sharded execution: group-health gauges (leaderless — each instance
+    # reports the groups it coordinates/participates in from its view).
+    SHARDED_GROUP_COUNT = ("mm_sharded_group_count", "gauge",
+                           "sharded placement groups this instance holds "
+                           "a shard of")
+    SHARDED_GROUP_INCOMPLETE = ("mm_sharded_group_incomplete", "gauge",
+                                "of those, groups missing at least one "
+                                "servable shard (not routable)")
     # Load-feedback view (serving/route_cache.LoadView): per-peer decayed
     # load score (labeled instance="...") and worst feedback staleness.
     ROUTE_LOAD_SCORE = ("mm_route_load_score", "gauge",

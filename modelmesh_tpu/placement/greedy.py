@@ -102,6 +102,53 @@ class GreedyStrategy(PlacementStrategy):
             key=lambda p: (p[1].req_per_minute, -p[1].free_units, p[0]),
         )[0]
 
+    def choose_group_targets(
+        self, req: PlacementRequest, view: ClusterView,
+        shard_count: int, shard_units: int,
+    ) -> Optional[dict[str, int]]:
+        """Group planning with the same candidate filters as
+        ``choose_load_target``: type-constraint preferred labels and the
+        rolling-upgrade upversion bias shape the pool before the
+        capacity-greedy pick; existing same-index members stay sticky
+        (a top-up re-plan must not shuffle landed shards). Atomic: all
+        ``shard_count`` distinct members or None."""
+        keep: dict[str, int] = {}
+        taken: set[int] = set()
+        for iid, idx in req.model.shard_instances.items():
+            if (
+                0 <= idx < shard_count
+                and idx not in taken
+                and iid not in req.exclude
+                and iid in view.live_map
+                and not view.live_map[iid].draining
+            ):
+                keep[iid] = idx
+                taken.add(idx)
+        pool = [
+            (iid, rec) for iid, rec in view.placeable()
+            if iid not in req.exclude and iid not in keep
+            and rec.free_units >= shard_units
+        ]
+        if self.constraints is not None:
+            pref = [
+                (iid, rec) for iid, rec in pool
+                if self.constraints.is_preferred(
+                    req.model.model_type, rec.labels
+                )
+            ]
+            missing_n = shard_count - len(taken)
+            if len(pref) >= missing_n:
+                pool = pref
+        pool = upversion_shortlist(pool)
+        pool.sort(key=lambda p: (-p[1].free_units, p[0]))
+        missing = [i for i in range(shard_count) if i not in taken]
+        if len(pool) < len(missing):
+            return None
+        assignments = dict(keep)
+        for idx, (iid, _) in zip(missing, pool):
+            assignments[iid] = idx
+        return assignments
+
     def choose_serve_target(
         self, model: ModelRecord, view: ClusterView, exclude: frozenset[str]
     ) -> Optional[str]:

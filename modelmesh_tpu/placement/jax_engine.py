@@ -16,6 +16,8 @@ but never bypasses local admission control.
 
 from __future__ import annotations
 
+import copy
+import dataclasses
 import logging
 import threading
 import time
@@ -1752,6 +1754,66 @@ class JaxPlacementStrategy(PlacementStrategy):
                         continue  # already loaded there
                     return LOAD_HERE if iid == req.requesting_instance else iid
         return self.fallback.choose_load_target(req, view)
+
+    def choose_group_targets(
+        self, req: PlacementRequest, view: ClusterView,
+        shard_count: int, shard_units: int,
+    ) -> Optional[dict[str, int]]:
+        """Solver-coplanned group placement: the plan's desired instances
+        for this model become the group's preferred members (the solve
+        already balanced them against fleet capacity — co-location as
+        plan columns, the AutoShard-style precedent), topped up to K via
+        the greedy group planner with plan members excluded from its
+        pool. Group planning stays OUT of the parity-pinned solver
+        kernels: the plan is consumed read-only here, never re-shaped,
+        so the bitwise cost-surface gates are untouched."""
+        keep: dict[str, int] = {}
+        taken: set[int] = set()
+        for iid, idx in req.model.shard_instances.items():
+            if (
+                0 <= idx < shard_count
+                and idx not in taken
+                and iid not in req.exclude
+                and iid in view.live_map
+                and not view.live_map[iid].draining
+            ):
+                keep[iid] = idx
+                taken.add(idx)
+        plan = self._plan
+        if plan is not None and plan.age_ms() <= self.plan_ttl_ms:
+            live = view.live_map
+            missing = [i for i in range(shard_count) if i not in taken]
+            for iid in plan.lookup(req.model_id) or ():
+                if not missing:
+                    break
+                rec = live.get(iid)
+                if (
+                    iid in keep or iid in req.exclude or rec is None
+                    or rec.disabled or rec.draining
+                    or rec.free_units < shard_units
+                ):
+                    continue
+                idx = missing.pop(0)
+                keep[iid] = idx
+                taken.add(idx)
+        if len(taken) == shard_count:
+            return keep
+        # Top up the remainder greedily, with the adopted members held
+        # sticky via a request whose record claims them.
+        merged = dict(req.model.shard_instances)
+        merged.update(keep)
+        model = req.model
+        if merged != model.shard_instances:
+            model = copy.deepcopy(req.model)
+            model.shard_instances = merged
+            # Stickiness in the fallback requires live holders to appear
+            # eligible; instance_ids membership is not consulted there.
+            synth = dataclasses.replace(req, model=model)
+        else:
+            synth = req
+        return self.fallback.choose_group_targets(
+            synth, view, shard_count, shard_units
+        )
 
     def choose_serve_target(
         self, model: ModelRecord, view: ClusterView, exclude: frozenset[str]

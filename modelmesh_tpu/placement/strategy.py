@@ -91,3 +91,51 @@ class PlacementStrategy(abc.ABC):
         exclude: frozenset[str],
     ) -> Optional[str]:
         """Pick a loaded copy to serve a request (cache-hit balancing)."""
+
+    def choose_group_targets(
+        self, req: PlacementRequest, view: ClusterView,
+        shard_count: int, shard_units: int,
+    ) -> Optional[dict[str, int]]:
+        """Plan a PLACEMENT GROUP for a sharded model: assign each shard
+        index 0..shard_count-1 to a DISTINCT instance, each with room for
+        one shard (``shard_units``). Returns {instance_id: shard_index}
+        or None when the fleet cannot host the whole group — group
+        placement is atomic: all K members or nothing (a partial group
+        can never serve, so partially placing one only wastes capacity).
+
+        Existing same-index members in ``req.model.shard_instances``
+        should be kept sticky so a re-plan tops up the missing shards
+        instead of shuffling weights that already landed.
+
+        Default: capacity-greedy — live placeable non-excluded instances
+        ranked by free capacity, sticky members first. Strategies with a
+        global plan override this (the solver co-plans the group as
+        co-location columns in its cost surface).
+        """
+        keep: dict[str, int] = {}
+        taken: set[int] = set()
+        for iid, idx in req.model.shard_instances.items():
+            if (
+                0 <= idx < shard_count
+                and idx not in taken
+                and iid not in req.exclude
+                and iid in view.live_map
+                and not view.live_map[iid].draining
+            ):
+                keep[iid] = idx
+                taken.add(idx)
+        candidates = sorted(
+            (
+                (iid, rec) for iid, rec in view.placeable()
+                if iid not in req.exclude and iid not in keep
+                and rec.free_units >= shard_units
+            ),
+            key=lambda p: (-p[1].free_units, p[0]),
+        )
+        missing = [i for i in range(shard_count) if i not in taken]
+        if len(candidates) < len(missing):
+            return None
+        assignments = dict(keep)
+        for idx, (iid, _) in zip(missing, candidates):
+            assignments[iid] = idx
+        return assignments

@@ -108,6 +108,34 @@ class DrainController:
                 # A copy still loading (or failed) has nothing to hand
                 # off; the final sweep deregisters it.
                 continue
+            if ce.is_shard:
+                # Placement-group member: the generic pre-copy would lie
+                # (ensure_loaded on a complete group just forwards to an
+                # existing member and reports LOADED without moving OUR
+                # shard), and dropping the shard un-replaced tears down
+                # the WHOLE group (records.remove_instance is group-
+                # atomic). Re-plan our index onto a survivor — pre-copy
+                # the shard, wait until the survivor holds it — then
+                # drop the local member; recency is irrelevant because
+                # there is no demote path for shards.
+                inst.flightrec.record("drain", phase="shard-replan",
+                                      model=model_id)
+                if not skip_migration and inst.replan_shard_for_drain(
+                    model_id, deadline
+                ):
+                    report.migrated.append(model_id)
+                    inst._remove_local(model_id)
+                else:
+                    report.failed[model_id] = (
+                        "no survivor took shard "
+                        f"{ce.shard_index}/{ce.shard_count}"
+                    )
+                    log.warning(
+                        "drain: shard re-plan of %s[%d/%d] failed; copy "
+                        "kept until final sweep", model_id,
+                        ce.shard_index, ce.shard_count,
+                    )
+                continue
             if last_used >= recent_cutoff and not skip_migration:
                 inst.flightrec.record("drain", phase="pre-copy",
                                       model=model_id)

@@ -75,6 +75,17 @@ class ModelRecord(Record):
                                  # global solver's cost matrix.
     last_used: int = 0           # lazily persisted (see should_persist_last_used)
     last_unload_ms: int = 0
+    # Sharded multi-device execution (placement GROUPS): a model too big
+    # for one instance is split into shard_count weight shards, each held
+    # by a different instance. shard_instances maps instance_id -> shard
+    # index (two instances MAY hold the same index transiently — that is
+    # exactly the drain pre-copy overlap). The group is routable only
+    # while COMPLETE (every index has a servable holder); group_epoch
+    # increments on every re-plan / membership change so observers can
+    # order group generations. shard_count == 0 means unsharded.
+    shard_count: int = 0
+    shard_instances: dict[str, int] = dataclasses.field(default_factory=dict)
+    group_epoch: int = 0
     version: int = 0
 
     # -- placements ---------------------------------------------------------
@@ -107,7 +118,80 @@ class ModelRecord(Record):
         a = self.instance_ids.pop(instance_id, None) is not None
         b = self.loading_instances.pop(instance_id, None) is not None
         c = self.host_instances.pop(instance_id, None) is not None
+        idx = self.shard_instances.pop(instance_id, None)
+        if idx is not None:
+            self.group_epoch += 1
+            # Atomic group eviction: losing a shard whose index has no
+            # surviving SERVABLE twin (a drain pre-copy leaves one) makes
+            # every other shard dead weight — drop the whole group so
+            # members observe their vanished claims and tear down,
+            # freeing K-1 shards' capacity instead of stranding it. With
+            # a twin present (drain), only the leaver is popped.
+            twin = any(
+                i == idx and other in self.instance_ids
+                for other, i in self.shard_instances.items()
+            )
+            if not twin:
+                for other in list(self.shard_instances):
+                    self.shard_instances.pop(other, None)
+                    self.instance_ids.pop(other, None)
+                    self.loading_instances.pop(other, None)
+                self.shard_count = 0
+        if not self.shard_instances:
+            # Last member gone: the group is absent, not half-present.
+            self.shard_count = 0
         return a or b or c
+
+    # -- shard groups (sharded multi-device execution) -----------------------
+
+    def begin_shard_group(
+        self, assignments: dict[str, int], shard_count: int,
+        ts: Optional[int] = None,
+    ) -> None:
+        """Install (or re-plan) the FULL group atomically inside one CAS:
+        shard assignments, loading claims for every member that is not
+        already servable, and an epoch bump. ``assignments`` for members
+        already holding the right shard are kept as-is (their claims and
+        completion timestamps survive a top-up re-plan)."""
+        ts = ts if ts is not None else now_ms()
+        self.shard_count = int(shard_count)
+        self.group_epoch += 1
+        for iid, idx in assignments.items():
+            prev = self.shard_instances.get(iid)
+            self.shard_instances[iid] = int(idx)
+            if prev == int(idx) and iid in self.instance_ids:
+                continue  # already a servable holder of this very shard
+            self.claim_loading(iid, ts)
+        # Members no longer assigned any shard lose their claims — their
+        # pods observe the vanished claim and tear the local shard down.
+        for iid in [i for i in self.shard_instances if i not in assignments]:
+            self.shard_instances.pop(iid, None)
+            self.instance_ids.pop(iid, None)
+            self.loading_instances.pop(iid, None)
+
+    def shard_index_of(self, instance_id: str) -> Optional[int]:
+        return self.shard_instances.get(instance_id)
+
+    @property
+    def group_complete(self) -> bool:
+        """True when every shard index 0..shard_count-1 has at least one
+        SERVABLE holder (listed in instance_ids). Unsharded models are
+        vacuously complete."""
+        if not self.shard_count:
+            return True
+        held = {
+            idx for iid, idx in self.shard_instances.items()
+            if iid in self.instance_ids
+        }
+        return held >= set(range(self.shard_count))
+
+    def missing_shards(self) -> list[int]:
+        """Shard indices with no holder AT ALL (neither servable nor
+        loading) — the top-up re-plan's work list."""
+        if not self.shard_count:
+            return []
+        held = set(self.shard_instances.values())
+        return [i for i in range(self.shard_count) if i not in held]
 
     def claim_host_copy(self, instance_id: str, ts: Optional[int] = None) -> None:
         """Advertise a host-tier (demoted) snapshot on this instance."""

@@ -204,6 +204,51 @@ class ModelLoader(abc.ABC, Generic[T]):
             f"{type(self).__name__} does not support weight streaming"
         )
 
+    # -- sharded execution (optional capability; placement groups) ---------
+
+    @property
+    def supports_sharded_execution(self) -> bool:
+        """True when this loader can materialize and serve ONE SHARD of a
+        model (``load_shard`` / ``load_shard_from_stream``) — the runtime
+        half of the sharded-execution subsystem. The serving layer only
+        plans multi-instance placement groups for models whose loader
+        declares this; everyone else keeps the single-copy contract (an
+        oversized model simply fails to place, as before)."""
+        return False
+
+    def load_shard(
+        self, model_id: str, info: ModelInfo, shard_index: int,
+        shard_count: int,
+    ) -> "LoadedModel[T]":
+        """Materialize shard ``shard_index`` of ``shard_count`` from the
+        model store. The returned size must be the SHARD's resident
+        bytes (≈ total/shard_count) — that is what the cache accounts.
+        Raise ModelLoadException on failure."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support sharded execution"
+        )
+
+    def load_shard_from_stream(
+        self,
+        model_id: str,
+        info: ModelInfo,
+        shard_index: int,
+        shard_count: int,
+        chunks: Iterator[WeightChunk],
+    ) -> "LoadedModel[T]":
+        """Materialize one shard from a transfer stream carrying ONLY
+        that shard's chunks (a peer holding the same shard, or the
+        shard-sliced subset of a full snapshot). Same error contract as
+        ``load_from_stream``: loader failures raise ModelLoadException,
+        iterator failures propagate unwrapped so the transfer manager
+        can fall back to ``load_shard`` from the store. No
+        ``partial_ready``: a shard is already the minimal servable
+        granule — serve-before-loaded composes at the GROUP level (the
+        group serves when every shard has landed), not within a shard."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support sharded execution"
+        )
+
 
 @dataclasses.dataclass
 class LoadedModel(Generic[T]):

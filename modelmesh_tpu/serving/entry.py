@@ -54,13 +54,24 @@ class EntryState(enum.Enum):
     # loading; finalizes to ACTIVE when the stream completes (or FAILED/
     # REMOVED like any in-flight load).
     PARTIAL = "partial"
+    # One shard of a multi-instance placement GROUP (sharded execution):
+    # this copy holds 1/shard_count of the model's weights and is
+    # servable — but only as a member of a COMPLETE group, a condition
+    # the ROUTING layer enforces from the registry record (the entry
+    # cannot see its peers). Terminal like ACTIVE: the shard is fully
+    # materialized; group membership changes arrive as registry events
+    # that REMOVE the entry, never as state regressions.
+    SHARDED = "sharded"
     ACTIVE = "active"
     FAILED = "failed"
     REMOVED = "removed"
 
     @property
     def is_terminal(self) -> bool:
-        return self in (EntryState.ACTIVE, EntryState.FAILED, EntryState.REMOVED)
+        return self in (
+            EntryState.ACTIVE, EntryState.SHARDED,
+            EntryState.FAILED, EntryState.REMOVED,
+        )
 
     @property
     def is_loading(self) -> bool:
@@ -71,9 +82,12 @@ class EntryState(enum.Enum):
 
     @property
     def is_servable(self) -> bool:
-        """Requests may execute against this copy (fully loaded, or a
-        partial streamed copy past its serve threshold)."""
-        return self in (EntryState.ACTIVE, EntryState.PARTIAL)
+        """Requests may execute against this copy (fully loaded, a
+        partial streamed copy past its serve threshold, or a shard of a
+        complete group — group completeness is the router's check)."""
+        return self in (
+            EntryState.ACTIVE, EntryState.PARTIAL, EntryState.SHARDED,
+        )
 
 
 @racedebug.tracked("state")
@@ -117,6 +131,13 @@ class CacheEntry:
         # True once begin_partial installed a provisional runtime copy
         # (sticky — survives later state transitions; see _load_failed).
         self.partial_started = False
+        # Sharded-execution shard metadata (set at insert time by
+        # _load_local when the registry assigns this instance a shard;
+        # immutable for the entry's lifetime — a re-plan REPLACES the
+        # entry rather than mutating it). shard_index < 0 = unsharded.
+        self.shard_index = -1
+        self.shard_count = 0
+        self.group_epoch = 0
         # Observability linkage, attached by the owning instance at
         # insert time: every state transition is recorded into the
         # flight recorder, and a load inherits the initiating request's
@@ -132,6 +153,10 @@ class CacheEntry:
         # estimate, ModelMesh.java:2641-2797).
         self.avg_latency_ms = 0.0
         self._latency_samples = 0
+
+    @property
+    def is_shard(self) -> bool:
+        return self.shard_index >= 0
 
     # bandwidth_rpm() stays 0 until this many samples — the first call often
     # includes cold-start/compile time and must not collapse the threshold.
@@ -219,6 +244,15 @@ class CacheEntry:
     def complete_load(self, loaded: LoadedModel) -> bool:
         """Finalize to ACTIVE unless removed meanwhile. Returns False if the
         entry was removed — caller must release the runtime copy."""
+        return self._complete(loaded, EntryState.ACTIVE)
+
+    def complete_shard(self, loaded: LoadedModel) -> bool:
+        """Finalize a shard load to SHARDED (the sharded-execution analog
+        of ``complete_load``). Returns False if the entry was removed —
+        caller must release the runtime shard."""
+        return self._complete(loaded, EntryState.SHARDED)
+
+    def _complete(self, loaded: LoadedModel, final: EntryState) -> bool:
         with self._lock:
             if self.state.is_terminal:
                 return False
@@ -229,7 +263,7 @@ class CacheEntry:
                 # already hold slots on it — swapping would leak permits.
                 self.max_concurrency = loaded.max_concurrency
                 self._sem = threading.Semaphore(loaded.max_concurrency)
-            self._transition_locked(EntryState.ACTIVE)
+            self._transition_locked(final)
             return True
 
     def fail(self, message: str) -> None:
@@ -244,13 +278,13 @@ class CacheEntry:
             self._transition_locked(EntryState.REMOVED)
 
     def wait_active(self, timeout_s: float) -> bool:
-        """True if ACTIVE within the timeout; False on timeout. Raises
-        ModelLoadException if the entry FAILED."""
+        """True if ACTIVE (or SHARDED) within the timeout; False on
+        timeout. Raises ModelLoadException if the entry FAILED."""
         if not self._done.wait(timeout_s):
             return False
         if self.state is EntryState.FAILED:
             raise ModelLoadException(self.error or "load failed")
-        return self.state is EntryState.ACTIVE
+        return self.state in (EntryState.ACTIVE, EntryState.SHARDED)
 
     def await_transition(
         self, known: EntryState, timeout_s: float

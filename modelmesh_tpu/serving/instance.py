@@ -187,6 +187,8 @@ class InstanceConfig:
         feedback_decay_ms: Optional[int] = None,
         admission: Optional[bool] = None,
         admission_queue_ms: Optional[int] = None,
+        sharded: Optional[bool] = None,
+        sharded_max_shards: Optional[int] = None,
     ):
         self.instance_id = instance_id or f"i-{uuid.uuid4().hex[:8]}"  # analysis-ok: det-entropy — deliberately unique process identity; every replay-bearing path (sim, scenarios) passes an explicit instance_id
         self.kv_prefix = kv_prefix.rstrip("/")
@@ -302,6 +304,17 @@ class InstanceConfig:
         if admission_queue_ms is None:
             admission_queue_ms = _envs.get_int("MM_ADMISSION_QUEUE_MS")
         self.admission_queue_ms = admission_queue_ms
+        # Sharded execution (MM_SHARDED): models too big for any single
+        # instance place as multi-instance GROUPS (one weight shard per
+        # member, SHARDED entry state; routing targets only complete
+        # groups). MM_SHARDED_MAX_SHARDS bounds the group width. Inert
+        # unless the loader declares supports_sharded_execution.
+        if sharded is None:
+            sharded = _envs.get_bool("MM_SHARDED")
+        self.sharded = sharded
+        if sharded_max_shards is None:
+            sharded_max_shards = _envs.get_int("MM_SHARDED_MAX_SHARDS")
+        self.sharded_max_shards = sharded_max_shards
 
 
 class ModelMeshInstance:
@@ -874,6 +887,20 @@ class ModelMeshInstance:
         self.metrics.set_gauge(
             MX.ROUTE_FEEDBACK_AGE_MS, stale if stale is not None else 0
         )
+        # Sharded placement groups this instance participates in, and how
+        # many of those are incomplete (routing blocked until the group
+        # fills) — per the local registry view, so the numbers converge
+        # within watch latency rather than costing KV reads per scrape.
+        groups = incomplete = 0
+        for _mid, ce, _ts in self.cache.descending_items():
+            if not ce.is_shard:
+                continue
+            groups += 1
+            gmr = self.registry_view.get(ce.model_id)
+            if gmr is None or not gmr.group_complete:
+                incomplete += 1
+        self.metrics.set_gauge(MX.SHARDED_GROUP_COUNT, groups)
+        self.metrics.set_gauge(MX.SHARDED_GROUP_INCOMPLETE, incomplete)
 
     # ------------------------------------------------------------------ #
     # management API                                                     #
@@ -948,6 +975,21 @@ class ModelMeshInstance:
         mr = self.registry.get(model_id)
         if mr is None:
             return "NOT_FOUND", None
+        if mr.shard_count:
+            # Sharded group: LOADED means the WHOLE group is complete and
+            # live — a single landed shard (even ours) is not servable.
+            live = {iid for iid, _ in self.instances_view.items()}
+            held = {
+                idx for iid, idx in mr.shard_instances.items()
+                if iid in mr.instance_ids and iid in live
+            }
+            if held >= set(range(mr.shard_count)):
+                return "LOADED", mr
+            if any(iid in live for iid in mr.loading_instances):
+                return "LOADING", mr
+            if mr.load_exhausted():
+                return "LOADING_FAILED", mr
+            return "NOT_LOADED", mr
         if ce is not None and ce.state.is_servable:
             # PARTIAL counts as LOADED: the copy is admitting requests.
             return "LOADED", mr
@@ -1078,6 +1120,21 @@ class ModelMeshInstance:
                 raise RequestCancelledError(model_id)
             # 1. local fast path
             ce = None if skip_local else self.cache.get(model_id)
+            if ce is not None and ce.is_shard and method is not None:
+                # Group atomicity: a shard serves inference only while
+                # its group is COMPLETE — a partial group is never
+                # routable, so fall through to routing (which re-plans).
+                gmr = self.registry_view.get(model_id)
+                if gmr is None or not gmr.group_complete:
+                    # The watch-fed view lags a group that JUST
+                    # completed — one authoritative read lets the local
+                    # member serve instead of bouncing the request.
+                    try:
+                        gmr = self._registry_get_failfast(model_id)
+                    except ServiceUnavailableError:
+                        gmr = None
+                    if gmr is None or not gmr.group_complete:
+                        ce = None
             if ce is not None and ce.state not in (
                 EntryState.FAILED, EntryState.REMOVED
             ):
@@ -1098,6 +1155,18 @@ class ModelMeshInstance:
                 mr = self._registry_get_failfast(model_id)
             if mr is None:
                 raise ModelNotFoundError(model_id)
+            if mr.shard_count and not mr.group_complete:
+                # Same view-lag heal for routing: a stale record for a
+                # group that already completed would send the request
+                # back through the miss loop (which re-plans the same
+                # group and spins out the iteration budget). A genuinely
+                # incomplete group is unchanged by the re-read.
+                try:
+                    amr = self._registry_get_failfast(model_id)
+                except ServiceUnavailableError:
+                    amr = None
+                if amr is not None and amr.group_complete:
+                    mr = amr
 
             # Registration-out-of-date self-heal: the record lists a copy
             # on THIS instance but the cache has none (lost to a KV-outage
@@ -1196,6 +1265,28 @@ class ModelMeshInstance:
                 exclude=frozenset(strategy_exclude),
                 last_used_ms=ctx.last_used_ms or now_ms(),
             )
+            # Sharded-execution branch: a model too big for ANY single
+            # placeable instance (or one already carrying a shard group)
+            # is placed as a multi-instance placement GROUP instead of a
+            # single copy — the single-copy path below could only fail.
+            if self._sharded_applicable(mr, req.required_units):
+                status = self._place_sharded_group(
+                    model_id, mr, req, ctx,
+                    wait=sync or method is not None,
+                )
+                if status is None:
+                    raise NoCapacityError(
+                        f"no placement group can host sharded {model_id} "
+                        f"(excluded: {sorted(strategy_exclude)})"
+                    )
+                if method is None:
+                    return InvokeResult(b"", self.instance_id, status)
+                if status != "LOADED":
+                    raise ModelLoadException(
+                        f"{model_id}: placement group did not complete "
+                        f"in time", timeout=True,
+                    )
+                continue  # group complete: the serve loop routes to it
             target = self.strategy.choose_load_target(req, self.cluster_view())
             self.flightrec.record(
                 "placement", model=model_id, target=target or "",
@@ -1258,6 +1349,10 @@ class ModelMeshInstance:
         (route_cache.pick); strategies without a candidate-set export
         keep the old single-winner flow.
         """
+        if mr.shard_count and not mr.group_complete:
+            # Sharded model with an incomplete group: no member may serve
+            # (group atomicity) — the miss loop re-plans instead.
+            return None
         exclude = ctx.exclude_serve | ctx.visited | {self.instance_id}
         cache = self.route_cache
         rank = getattr(self.strategy, "rank_serve_candidates", None)
@@ -1618,6 +1713,245 @@ class ModelMeshInstance:
             log.debug("chained fan-out of %s stopped: %s", model_id, e)
 
     # ------------------------------------------------------------------ #
+    # sharded placement groups                                           #
+    # ------------------------------------------------------------------ #
+
+    def _sharded_applicable(self, mr: ModelRecord, required_units: int) -> bool:
+        """Should the miss loop plan a placement GROUP for this model?
+        Yes for models already carrying a group (keep coordinating it)
+        and for layer-streamable models too big for ANY single placeable
+        instance — gated on the knob and the loader capability, so a
+        store-only deployment keeps the old fail-to-place behavior."""
+        if not self.config.sharded:
+            return False
+        if not getattr(self.loader, "supports_sharded_execution", False):
+            return False
+        if mr.shard_count:
+            return True
+        from modelmesh_tpu.transfer.protocol import is_layer_streamable
+
+        if not is_layer_streamable(mr.model_type, mr.model_path):
+            return False
+        caps = [
+            rec.capacity_units for _, rec in self.cluster_view().placeable()
+        ]
+        return bool(caps) and required_units > max(caps)
+
+    @staticmethod
+    def _group_missing(mr: ModelRecord, live: set) -> list[int]:
+        """Shard indices with no LIVE holder or claimer — the signal that
+        a group needs (re-)planning rather than just more patience."""
+        if not mr.shard_count:
+            return []
+        missing = []
+        for idx in range(mr.shard_count):
+            if not any(
+                i == idx and iid in live
+                and (iid in mr.instance_ids or iid in mr.loading_instances)
+                for iid, i in mr.shard_instances.items()
+            ):
+                missing.append(idx)
+        return missing
+
+    def _place_sharded_group(
+        self, model_id: str, mr: ModelRecord, req: PlacementRequest,
+        ctx: RoutingContext, wait: bool,
+    ) -> Optional[str]:
+        """Coordinate a sharded placement group: pick K distinct members
+        with the strategy (smallest K whose per-shard share fits, up to
+        MM_SHARDED_MAX_SHARDS), commit the WHOLE group in ONE registry
+        CAS (``begin_shard_group`` — assignments, claims, epoch bump),
+        then poke each member with a normal LOAD_LOCAL_ONLY placement op
+        (no new wire surface; each member reads its own shard index from
+        the record). Returns "LOADED" once the group is complete,
+        "LOADING" when placed but not yet complete (or wait=False), None
+        when the fleet cannot host the group."""
+        view = self.cluster_view()
+        live = set(view.live_map)
+        shard_count = mr.shard_count
+        if not shard_count or self._group_missing(mr, live):
+            choose = getattr(self.strategy, "choose_group_targets", None)
+            if choose is None:
+                return None
+            caps = [rec.capacity_units for _, rec in view.placeable()]
+            if not caps:
+                return None
+            max_shards = max(int(self.config.sharded_max_shards), 2)
+            k_lo = max(2, -(-req.required_units // max(caps)), shard_count)
+            assignments: Optional[dict[str, int]] = None
+            for k in range(k_lo, max_shards + 1):
+                shard_units = max(1, -(-req.required_units // k))
+                plan = choose(req, view, k, shard_units)
+                if plan:
+                    assignments, shard_count = plan, k
+                    break
+            if assignments is None:
+                return None
+            ts = now_ms()
+
+            def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
+                if cur is None:
+                    return None
+                cur.begin_shard_group(assignments, shard_count, ts)
+                return cur
+
+            try:
+                mr = self.registry.update_or_create(model_id, mutate)
+            except CasFailed:
+                # A concurrent coordinator committed its own plan — ride
+                # that group instead of fighting over epochs.
+                self.flightrec.record("cas-failed", op="shard-group",
+                                      model=model_id)
+                mr = self.registry.get(model_id)
+            if mr is None:
+                raise ModelNotFoundError(model_id)
+            if mr.shard_count:
+                shard_count = mr.shard_count
+            self.metrics.inc(MX.SHARDED_GROUP_PLAN_COUNT, model_id=model_id)
+            self.flightrec.record(
+                "sharded-group", op="plan", model=model_id,
+                shards=shard_count, epoch=mr.group_epoch,
+                members=",".join(sorted(mr.shard_instances)),
+            )
+        # Poke every member that is not yet a servable holder of its
+        # shard. Remote pokes block until the remote shard is servable,
+        # so they run concurrently; the self-poke only enqueues.
+        pending = [
+            iid for iid in mr.shard_instances
+            if iid not in mr.instance_ids
+        ]
+        record = mr
+
+        def poke_ctx() -> RoutingContext:
+            return RoutingContext(
+                hop=RoutingContext.INTERNAL,
+                known_size_bytes=ctx.known_size_bytes,
+                last_used_ms=ctx.last_used_ms,
+            )
+
+        if self.instance_id in pending:
+            self._load_local(model_id, record, poke_ctx())
+            pending.remove(self.instance_id)
+
+        def poke(target: str) -> None:
+            try:
+                self._forward(
+                    target, model_id, None, b"", [], poke_ctx(),
+                    hop=RoutingContext.LOAD_LOCAL_ONLY,
+                )
+            except Exception as e:  # noqa: BLE001 — group converges via re-plan
+                self.flightrec.record(
+                    "sharded-group", op="poke-failed", model=model_id,
+                    target=target, err=type(e).__name__,
+                )
+                log.debug("shard poke of %s to %s failed: %s",
+                          model_id, target, e)
+
+        for target in pending:
+            threading.Thread(
+                target=poke, args=(target,),
+                name=f"shard-poke-{model_id}-{target}", daemon=True,
+            ).start()
+        if not wait:
+            return "LOADING"
+        clock = get_clock()
+        budget_s = (self.params.load_timeout_ms or 120_000) / 1000.0
+        deadline = clock.monotonic() + budget_s
+        while True:
+            cur = self.registry.get(model_id)
+            if cur is None:
+                raise ModelNotFoundError(model_id)
+            if cur.shard_count and cur.group_complete:
+                return "LOADED"
+            if cur.load_exhausted():
+                raise ModelLoadException(
+                    f"{model_id}: shard load failed on "
+                    f"{sorted(cur.load_failures)}"
+                )
+            if clock.monotonic() >= deadline:
+                return "LOADING"
+            clock.sleep(0.05)
+
+    def replan_shard_for_drain(
+        self, model_id: str, deadline_mono: float,
+    ) -> bool:
+        """Drain-time group move: pre-copy OUR shard to a survivor before
+        this member drops it — the group keeps a servable holder of every
+        index throughout, so a half-drained group never stops serving.
+        The survivor is CASed in as a SECOND holder of our shard index
+        (``shard_instances`` allows the overlap); only after it is
+        servable does the caller drop the local copy, whose
+        ``remove_instance`` then pops just us (the twin keeps the group
+        alive). Returns True when the survivor copy is servable."""
+        mr = self.registry.get(model_id)
+        if mr is None or not mr.shard_count:
+            return False
+        my_idx = mr.shard_index_of(self.instance_id)
+        if my_idx is None:
+            return True  # re-planned away already: nothing to hand off
+        shard_units = max(
+            1,
+            -(-bytes_to_units(self._predict_size_bytes(model_id, mr))
+              // mr.shard_count),
+        )
+        view = self.cluster_view()
+        members = set(mr.shard_instances)
+        cands = sorted(
+            (
+                (iid, rec) for iid, rec in view.placeable()
+                if iid not in members and iid != self.instance_id
+                and rec.free_units >= shard_units
+            ),
+            key=lambda p: (-p[1].free_units, p[0]),
+        )
+        if not cands:
+            return False
+        survivor = cands[0][0]
+        ts = now_ms()
+
+        def mutate(cur: Optional[ModelRecord]) -> Optional[ModelRecord]:
+            if cur is None:
+                return None
+            if cur.shard_index_of(self.instance_id) != my_idx:
+                return cur  # group re-planned mid-drain; nothing owed
+            cur.shard_instances[survivor] = my_idx
+            cur.claim_loading(survivor, ts)
+            return cur
+
+        try:
+            if self.registry.update_or_create(model_id, mutate) is None:
+                return True  # unregistered: nothing to hand off
+        except CasFailed:
+            return False
+        self.flightrec.record(
+            "sharded-group", op="drain-replan", model=model_id,
+            shard=my_idx, target=survivor,
+        )
+        try:
+            self._forward(
+                survivor, model_id, None, b"", [],
+                RoutingContext(hop=RoutingContext.INTERNAL),
+                hop=RoutingContext.LOAD_LOCAL_ONLY,
+            )
+        except Exception as e:  # noqa: BLE001 — poll below decides
+            log.debug("drain shard poke of %s to %s failed: %s",
+                      model_id, survivor, e)
+        clock = get_clock()
+        while clock.monotonic() < deadline_mono:
+            cur = self.registry.get(model_id)
+            if cur is None:
+                return True
+            if cur.shard_index_of(self.instance_id) != my_idx:
+                return True  # re-planned away mid-wait
+            if (
+                survivor in cur.instance_ids
+                and cur.shard_index_of(survivor) == my_idx
+            ):
+                return True
+            clock.sleep(0.05)
+        return False
+
+    # ------------------------------------------------------------------ #
     # local load lifecycle                                               #
     # ------------------------------------------------------------------ #
 
@@ -1655,6 +1989,28 @@ class ModelMeshInstance:
         if not ctx.known_size_bytes:
             ctx.known_size_bytes = self._predict_size_bytes(model_id, mr)
         units = bytes_to_units(ctx.known_size_bytes)
+        # Sharded group member: this instance loads ONE SHARD, accounted
+        # at its share of the model. The watch-fed view can lag the group
+        # CAS that assigned us — when the full size would not even fit,
+        # one authoritative re-read closes that window before the load is
+        # misrecorded as a capacity failure.
+        shard_index = (
+            mr.shard_index_of(self.instance_id) if mr.shard_count else None
+        )
+        if shard_index is None and units > self.cache.capacity:
+            try:
+                fresh = self.registry.get(model_id)
+            except Exception:  # noqa: BLE001 — KV hiccup: keep the view
+                fresh = None
+            if fresh is not None:
+                mr = fresh
+                shard_index = (
+                    mr.shard_index_of(self.instance_id)
+                    if mr.shard_count else None
+                )
+        shard_count = mr.shard_count if shard_index is not None else 0
+        if shard_index is not None:
+            units = max(1, -(-units // shard_count))
         if not self._local_load_allowed(units):
             return None
         if units > self.cache.capacity:
@@ -1666,6 +2022,13 @@ class ModelMeshInstance:
         last_used = ctx.last_used_ms or now_ms()
         ce = CacheEntry(model_id, info, weight_units=units, last_used=last_used)
         ce.chain_load_count = ctx.chain_load_count
+        if shard_index is not None:
+            ce.shard_index = shard_index
+            ce.shard_count = shard_count
+            ce.group_epoch = mr.group_epoch
+            # Chains place extra FULL copies; a shard scales by group
+            # re-planning instead.
+            ce.chain_load_count = 0
         # Observability linkage: state transitions flow into the flight
         # recorder, and the load (which runs on a pool thread with no
         # request context) inherits the initiating request's trace id +
@@ -1772,6 +2135,23 @@ class ModelMeshInstance:
             self.metrics.observe(
                 MX.QUEUE_DELAY, ce.load_started_ms - queued_ms, model_id
             )
+            if ce.is_shard:
+                # Sharded group member: materialize OUR shard (same-shard
+                # peer stream, sliced full snapshot, or store) and settle
+                # in SHARDED. No sizing phase — the shard share is the
+                # loader's deterministic fraction of the measured total.
+                loaded, _source = self.transfer.load_shard_weights(ce)
+                if self.probation is not None:
+                    self.probation.record_success()
+                if loaded.size_bytes:
+                    new_units = bytes_to_units(loaded.size_bytes)
+                    if new_units != ce.weight_units and (
+                        self.cache.update_weight(model_id, new_units)
+                        is not None
+                    ):
+                        ce.weight_units = new_units
+                self._activate_shard(ce, loaded)
+                return
             # Weight-source resolution (transfer/): host-tier re-warm or
             # peer stream when available, model store otherwise — with
             # in-manager fallback to the store on any mid-transfer error.
@@ -1827,6 +2207,31 @@ class ModelMeshInstance:
             elapsed = now_ms() - ce.load_started_ms
             self.metrics.observe(MX.LOAD_TIME, elapsed, model_id)
             self.time_stats.record(ce.info.model_type, elapsed)
+        if not published:
+            self.publish_instance_record()
+        return True
+
+    def _activate_shard(self, ce: CacheEntry, loaded) -> bool:
+        """Finalize a shard load: SHARDED state (terminal and servable —
+        but routable only once the whole group is complete), registry
+        promotion (which is what completes the group when the last shard
+        lands), load metrics. No chained loads: groups scale by re-plan."""
+        model_id = ce.model_id
+        if not ce.complete_shard(loaded):
+            self.loader.unload(model_id)
+            return False
+        published = self._promote_loaded(model_id, size_units=ce.weight_units)
+        self.metrics.inc(MX.LOAD_COUNT, model_id=model_id)
+        self.metrics.inc(MX.SHARDED_SHARD_LOAD_COUNT, model_id=model_id)
+        if ce.load_started_ms:
+            elapsed = now_ms() - ce.load_started_ms
+            self.metrics.observe(MX.LOAD_TIME, elapsed, model_id)
+            self.time_stats.record(ce.info.model_type, elapsed)
+        self.flightrec.record(
+            "sharded-group", op="shard-loaded", model=model_id,
+            shard=ce.shard_index, shards=ce.shard_count,
+            epoch=ce.group_epoch,
+        )
         if not published:
             self.publish_instance_record()
         return True
@@ -2119,9 +2524,14 @@ class ModelMeshInstance:
                 MX.EVICT_AGE, (now_ms() - last_used) / 1000.0, model_id
             )
         was_active = ce.state is EntryState.ACTIVE
+        # SHARDED holds device memory like ACTIVE does (its shard of the
+        # group) — eviction must unload it; it just never demotes into the
+        # host tier (a shard snapshot under the full-model fingerprint
+        # would poison peer fetches).
+        was_resident = was_active or ce.state is EntryState.SHARDED
         ce.remove()
         units = ce.weight_units
-        do_unload = was_active and self.loader.requires_unload
+        do_unload = was_resident and self.loader.requires_unload
         if do_unload:
             self.unload_tracker.unload_started(units)
 
@@ -2265,6 +2675,23 @@ class ModelMeshInstance:
         # cache from holding routes for deleted models.
         self.route_cache.invalidate(model_id)
         if event is not TableEvent.DELETED:
+            # Sharded-group membership is registry-authoritative: if an
+            # update shows OUR shard claim gone or re-indexed (group torn
+            # down by atomic eviction, re-planned to another holder), the
+            # local shard is dead weight — queue its teardown. Keyed on
+            # the claim itself, not the group epoch: epoch is advisory.
+            ce = self.cache.get_quietly(model_id)
+            if (
+                ce is not None and ce.is_shard and record is not None
+                and (
+                    not record.shard_count
+                    or record.shard_index_of(self.instance_id)
+                    != ce.shard_index
+                )
+            ):
+                self._cleanup_pool.submit(
+                    self._teardown_stale_shard, model_id, ce
+                )
             return
         # A deleted model's host-tier snapshot is dead weight (the record
         # that advertised it is gone): release the RAM promptly.
@@ -2304,6 +2731,30 @@ class ModelMeshInstance:
         except Exception:  # noqa: BLE001 — best-effort; demand-load covers
             pass
 
+    def _teardown_stale_shard(self, model_id: str, ce: CacheEntry) -> None:
+        """Drop a local shard whose registry claim vanished or moved.
+
+        Watch events lag and re-plans race: re-read the authoritative
+        record and keep the shard if our claim is intact after all."""
+        try:
+            mr = self.registry.get(model_id)
+        except Exception:  # noqa: BLE001 — KV hiccup: next event retries
+            return
+        if (
+            mr is not None
+            and mr.shard_count == ce.shard_count
+            and mr.shard_index_of(self.instance_id) == ce.shard_index
+        ):
+            return  # claim intact — the watch event was stale
+        if self.cache.get_quietly(model_id) is not ce:
+            return  # entry already replaced/removed; nothing owed
+        if self._remove_local(model_id):
+            self.flightrec.record(
+                "sharded-group", op="teardown", model=model_id,
+                shard=ce.shard_index, shards=ce.shard_count,
+            )
+            self.publish_instance_record()
+
     def _remove_local(self, model_id: str, demote: bool = False) -> bool:
         # Deliberate removal (unregister / deletion cleanup / shutdown
         # migration) drops the host-tier snapshot too — unlike capacity
@@ -2334,11 +2785,11 @@ class ModelMeshInstance:
             self.transfer.drop_host_copy(model_id)
         if not self.cache.remove_if_value(model_id, ce):
             return False
-        was_active = ce.state is EntryState.ACTIVE
+        was_resident = ce.state in (EntryState.ACTIVE, EntryState.SHARDED)
         ce.remove()
         self._drop_model_rate(model_id)
         self._deregister(model_id, demoted=demoted)
-        if was_active and self.loader.requires_unload:
+        if was_resident and self.loader.requires_unload:
             self._async_unload(model_id, ce.weight_units)
         return True
 

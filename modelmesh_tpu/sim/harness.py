@@ -17,6 +17,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import logging
+import re
 import threading
 from typing import Optional
 
@@ -55,6 +56,10 @@ log = logging.getLogger(__name__)
 FAIL_LOAD_PREFIX = "fail-load-"
 SLOW_LOAD_PREFIX = "slow-load-"
 
+# "big<N>x-" ids are N x the loader's default size — the sharded-group
+# scenarios' way to mint a model no single sim device can hold.
+_BIG_PREFIX_RE = re.compile(r"^big(\d+)x-")
+
 
 class SimLoader(ModelLoader):
     """In-process loader with virtual-time load delays and fault hooks.
@@ -85,8 +90,13 @@ class SimLoader(ModelLoader):
         self.transfer_chunks = max(int(transfer_chunks), 1)
         self.transfer_chunk_delay_ms = transfer_chunk_delay_ms
         self.loaded_models: dict[str, int] = {}  #: guarded-by: _lock
+        # model_id -> (shard_index, shard_count) for copies materialized
+        # through the sharded SPI (invariants cross-check these against
+        # the registry's group claims).
+        self.shard_coords: dict[str, tuple[int, int]] = {}  #: guarded-by: _lock
         self.load_count = 0  #: guarded-by: _lock
         self.stream_load_count = 0  #: guarded-by: _lock
+        self.shard_load_count = 0  #: guarded-by: _lock
         self.unload_count = 0  #: guarded-by: _lock
         # model_id -> extra virtual load delay (the slow-loadModel fault).
         self.slow_models: dict[str, float] = {}  #: guarded-by: _lock
@@ -131,6 +141,7 @@ class SimLoader(ModelLoader):
     def unload(self, model_id: str) -> None:
         with self._lock:
             self.loaded_models.pop(model_id, None)
+            self.shard_coords.pop(model_id, None)
             self.unload_count += 1
 
     def is_loaded(self, model_id: str) -> bool:
@@ -146,6 +157,9 @@ class SimLoader(ModelLoader):
             self.fail_models.add(model_id)
 
     def _size_for(self, model_id: str) -> int:
+        m = _BIG_PREFIX_RE.match(model_id)
+        if m:
+            return self.default_size_bytes * int(m.group(1))
         # Deterministic per-id size (stable across runs — hash() is
         # salted per process, so use a real digest).
         import zlib
@@ -209,6 +223,92 @@ class SimLoader(ModelLoader):
             self.loaded_models[model_id] = size
             self.stream_load_count += 1
         return LoadedModel(handle=model_id, size_bytes=size)
+
+    # -- sharded execution -------------------------------------------------
+
+    @property
+    def supports_sharded_execution(self) -> bool:
+        return True
+
+    def _shard_share(self, model_id: str, shard_count: int) -> int:
+        return -(-self._size_for(model_id) // max(shard_count, 1))
+
+    def load_shard(
+        self, model_id: str, info: ModelInfo, shard_index: int,
+        shard_count: int,
+    ) -> LoadedModel:
+        with self._lock:
+            delay_ms = self.load_delay_ms + self.slow_models.get(model_id, 0)
+            fail = model_id in self.fail_models or model_id.startswith(
+                FAIL_LOAD_PREFIX
+            )
+        if delay_ms:
+            _clock.sleep(delay_ms / 1000.0)
+        if fail:
+            with self._lock:
+                self.fail_models.discard(model_id)
+            raise ModelLoadException(f"injected load failure: {model_id}")
+        share = self._shard_share(model_id, shard_count)
+        with self._lock:
+            self.loaded_models[model_id] = share
+            self.shard_coords[model_id] = (shard_index, shard_count)
+            self.load_count += 1
+            self.shard_load_count += 1
+        return LoadedModel(handle=model_id, size_bytes=share)
+
+    def export_shard_weights(self, model_id: str, handle):
+        """Synthetic shard stream: ``transfer_chunks`` stands in for the
+        model's leaf count, so this shard's slice of it (global layer
+        indices, like the real loader) is what goes on the wire."""
+        from modelmesh_tpu.runtime.spi import WeightChunk
+        from modelmesh_tpu.transfer.protocol import shard_chunk_indices
+
+        with self._lock:
+            if model_id not in self.loaded_models:
+                return None
+            coords = self.shard_coords.get(model_id)
+        if coords is None:
+            return None
+        layers = list(shard_chunk_indices(self.transfer_chunks, *coords))
+
+        def gen():
+            for pos, layer in enumerate(layers):
+                yield WeightChunk(
+                    seq=pos,
+                    payload=f"{model_id}:{layer}".encode(),
+                    layer=layer,
+                    last=pos == len(layers) - 1,
+                )
+
+        return gen()
+
+    def load_shard_from_stream(
+        self, model_id: str, info: ModelInfo, shard_index: int,
+        shard_count: int, chunks,
+    ) -> LoadedModel:
+        from modelmesh_tpu.transfer.protocol import shard_chunk_indices
+
+        seen: set[int] = set()
+        for chunk in chunks:
+            if self.transfer_chunk_delay_ms:
+                _clock.sleep(self.transfer_chunk_delay_ms / 1000.0)
+            seen.add(chunk.layer)
+        want = set(
+            shard_chunk_indices(self.transfer_chunks, shard_index,
+                                shard_count)
+        )
+        if seen != want:
+            raise ModelLoadException(
+                f"{model_id}: shard {shard_index}/{shard_count} stream "
+                f"delivered layers {sorted(seen)}, expected {sorted(want)}"
+            )
+        share = self._shard_share(model_id, shard_count)
+        with self._lock:
+            self.loaded_models[model_id] = share
+            self.shard_coords[model_id] = (shard_index, shard_count)
+            self.stream_load_count += 1
+            self.shard_load_count += 1
+        return LoadedModel(handle=model_id, size_bytes=share)
 
 
 class SimPod:
@@ -679,10 +779,14 @@ class SimCluster:
             raise RuntimeError("no live instances")
         return pods[0]
 
-    def register(self, model_id: str, model_type: str = "sim") -> None:
+    def register(self, model_id: str, model_type: str = "sim",
+                 scheme: str = "mem") -> None:
+        # ``scheme`` picks the model-path family: "mem" (default) is a
+        # store-only spec, a layer-streamable family name (e.g. "mlp")
+        # makes the model eligible for sharded placement groups.
         try:
             self.first_live().instance.register_model(
-                model_id, ModelInfo(model_type, f"mem://{model_id}")
+                model_id, ModelInfo(model_type, f"{scheme}://{model_id}")
             )
         except Exception as e:  # noqa: BLE001 — registration may race faults
             log.debug("sim register(%s) raced a fault: %s", model_id, e)

@@ -34,6 +34,9 @@ Catalog (see docs/testing.md for the rationale of each):
   per-model ceiling (``TaskConfig.max_copies``): the autoscale
   controller's hard cap, and the first place a runaway scale-up loop
   would show.
+- ``group_complete_or_absent`` — sharded placement groups are
+  all-or-nothing: every record either carries no group or a complete
+  one whose live members' local entries agree with the claims.
 
 ``slo_attained(spec)`` is a FACTORY, not part of the standard suite:
 scenarios attach it via ``extra_checks`` with their own objective spec.
@@ -124,8 +127,10 @@ def registry_cache_convergence(cluster: "SimCluster") -> list[str]:
             if pod is None:
                 continue  # dead holders are no_dead_placements' concern
             ce = pod.instance.cache.get_quietly(mid)
+            # Servable covers ACTIVE plus the other promoted-to-registry
+            # states (PARTIAL mid-stream, SHARDED group members).
             if ce is None or (
-                ce.state is not EntryState.ACTIVE
+                not ce.state.is_servable
                 and not ce.state.is_loading
             ):
                 out.append(
@@ -270,6 +275,59 @@ def copy_bounds(cluster: "SimCluster") -> list[str]:
     return out
 
 
+def group_complete_or_absent(cluster: "SimCluster") -> list[str]:
+    """Sharded placement groups are all-or-nothing at quiescence: a
+    record either carries NO group (``shard_count`` 0 and no shard
+    claims) or a COMPLETE one — every shard index 0..K-1 held by a live,
+    promoted member whose LOCAL cache entry agrees on its coordinates.
+    A lingering partial group means the atomic plan/evict rules lost a
+    member without tearing the group down (exactly the state routing
+    must never see)."""
+    out: list[str] = []
+    inst = cluster.first_live().instance
+    live = {p.iid: p for p in cluster.live_pods()}
+    for mid, mr in inst.registry.items():
+        count = getattr(mr, "shard_count", 0)
+        shards = dict(getattr(mr, "shard_instances", {}) or {})
+        if not count:
+            if shards:
+                out.append(
+                    f"record {mid} carries shard claims "
+                    f"{sorted(shards.items())} with shard_count=0"
+                )
+            continue
+        held = {
+            idx for iid, idx in shards.items()
+            if iid in mr.instance_ids and iid in live
+        }
+        missing = sorted(set(range(count)) - held)
+        if missing:
+            out.append(
+                f"record {mid} {count}-way group incomplete: no live "
+                f"holder for indices {missing} "
+                f"(claims={sorted(shards.items())})"
+            )
+        for iid, idx in sorted(shards.items()):
+            pod = live.get(iid)
+            if pod is None or iid not in mr.instance_ids:
+                continue  # loading claim / dead holder: judged above
+            ce = pod.instance.cache.get_quietly(mid)
+            if (
+                ce is None or not ce.is_shard
+                or ce.shard_index != idx or ce.shard_count != count
+            ):
+                got = (
+                    f"shard {ce.shard_index}/{ce.shard_count}"
+                    if ce is not None and ce.is_shard
+                    else (ce.state.value if ce is not None else "none")
+                )
+                out.append(
+                    f"record {mid} claims shard {idx}/{count} on {iid} "
+                    f"but the local entry is {got}"
+                )
+    return out
+
+
 def slo_attained(spec: str, window_ms: int = 10_000, min_requests: int = 1,
                  model_filter=None, slo_class: str = "",
                  judge_after_ms: int = 0):
@@ -385,4 +443,5 @@ def check_all(
         "host_claims_converged": host_claims_converged(cluster),
         "draining_deregistered": draining_deregistered(cluster),
         "copy_bounds": copy_bounds(cluster),
+        "group_complete_or_absent": group_complete_or_absent(cluster),
     }

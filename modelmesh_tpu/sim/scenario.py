@@ -50,9 +50,13 @@ Event kinds:
                           turns on dual-read before its workload starts
   migrate_live            run the fenced live registry migration
                           (kv/migrate.py) against the serving cluster
-  register <model> [type] register a model (type = model_type = SLO
+  register <model> [type] [scheme]
+                          register a model (type = model_type = SLO
                           class, default "sim" — admission scenarios
-                          register typed classes)
+                          register typed classes; scheme picks the
+                          model-path family, a layer-streamable one
+                          like "mlp" makes the model eligible for
+                          sharded placement groups)
   ensure/unregister <model>   workload
 """
 
@@ -274,8 +278,9 @@ class ScenarioRunner:
         elif kind == "register":
             # Optional second arg: the model_type ("register m hi") —
             # model_type is the SLO class, so admission scenarios need
-            # typed registrations.
-            target, targs = cluster.register, tuple(args[:2])
+            # typed registrations. Optional third: the path scheme
+            # (family) — "mlp" makes the model shardable.
+            target, targs = cluster.register, tuple(args[:3])
         elif kind == "unregister":
             target, targs = cluster.unregister, (args[0],)
         elif kind == "ensure":

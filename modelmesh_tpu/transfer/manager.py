@@ -34,6 +34,7 @@ into the registry so it is advertised/routable mid-transfer.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time as _time
 from typing import TYPE_CHECKING, Optional
@@ -49,6 +50,8 @@ from modelmesh_tpu.transfer.protocol import (
     TransferUnavailable,
     is_layer_streamable,
     model_fingerprint,
+    shard_chunk_indices,
+    shard_fingerprint,
     snapshot_reply,
 )
 from modelmesh_tpu.utils.clock import get_clock
@@ -207,6 +210,223 @@ class WeightTransferManager:
         self.metrics.inc(MX.LOAD_FROM_STORE_COUNT, model_id=ce.model_id)
         return loaded, "store"
 
+    # ------------------------------------------------------------------ #
+    # receiver side: shard loads (sharded placement groups)              #
+    # ------------------------------------------------------------------ #
+
+    def load_shard_weights(self, ce: "CacheEntry") -> tuple[LoadedModel, str]:
+        """Materialize shard ``ce.shard_index`` of ``ce.shard_count`` for
+        a placement-group member. Source order:
+
+        1. **same-shard peer** — a live group member holding OUR shard
+           index (drain pre-copy, group re-plan): stream its shard
+           snapshot under the shard fingerprint (~total/K bytes).
+        2. **full-copy slice** — a live FULL copy (or full host-tier
+           snapshot): fetch only the shard's leaf range out of the full
+           snapshot. Chunks are leaf-ordered, so the range is one
+           contiguous chunk block found by binary-searching the chunk
+           index on the ``layer`` field (each probe costs one chunk).
+        3. **store** — ``loader.load_shard``, like any other fallback.
+
+        Same no-raise contract as ``load_weights`` for transfer faults."""
+        inst = self.instance
+        model_id = ce.model_id
+        loader = inst.loader
+        if (
+            not loader.supports_weight_streaming
+            or not self.cfg.peer_fetch
+            or inst.peer_fetch_transport is None
+        ):
+            return self._shard_store_load(ce)
+        failed: set[str] = set()
+        for resolve, stream in (
+            (self._same_shard_sender, self._stream_shard_from),
+            (self._full_copy_sender, self._stream_shard_slice_from),
+        ):
+            attempts = 0
+            while attempts < MAX_PEER_ATTEMPTS:
+                sender = resolve(ce, failed)
+                if sender is None:
+                    break
+                iid, endpoint = sender
+                attempts += 1
+                try:
+                    return stream(endpoint, iid, ce)
+                except TransferUnavailable as e:
+                    inst.flightrec.record(
+                        "transfer-fault", model=model_id, sender=iid,
+                        fatal=False, error=str(e)[:120],
+                    )
+                    failed.add(iid)
+                except Exception as e:  # noqa: BLE001 — peer death etc.
+                    self.metrics.inc(
+                        MX.TRANSFER_FALLBACK_COUNT, model_id=model_id
+                    )
+                    inst.flightrec.record(
+                        "transfer-fault", model=model_id, sender=iid,
+                        fatal=True, error=str(e)[:120],
+                    )
+                    log.warning(
+                        "shard stream of %s[%d/%d] from %s failed (%s); "
+                        "trying the next source", model_id, ce.shard_index,
+                        ce.shard_count, iid, e,
+                    )
+                    failed.add(iid)
+        return self._shard_store_load(ce)
+
+    def _shard_store_load(self, ce: "CacheEntry") -> tuple[LoadedModel, str]:
+        loaded = self.instance.loader.load_shard(
+            ce.model_id, ce.info, ce.shard_index, ce.shard_count
+        )
+        self.metrics.inc(MX.LOAD_FROM_STORE_COUNT, model_id=ce.model_id)
+        return loaded, "store"
+
+    def _same_shard_sender(
+        self, ce: "CacheEntry", exclude: set[str],
+    ) -> Optional[tuple[str, str]]:
+        """A live group member that HOLDS our shard index (promoted, not
+        mid-load). Exists during drain pre-copy and index re-plans."""
+        inst = self.instance
+        mr = inst.registry_view.get(ce.model_id)
+        if mr is None or not getattr(mr, "shard_instances", None):
+            return None
+        live = self._live_ids()
+        ranked = sorted(
+            (ts, iid) for iid, ts in mr.instance_ids.items()
+            if iid != inst.instance_id and iid not in exclude and iid in live
+            and iid not in mr.loading_instances
+            and mr.shard_instances.get(iid) == ce.shard_index
+        )
+        for _, iid in ranked:
+            return iid, self._endpoint_for(iid)
+        return None
+
+    def _full_copy_sender(
+        self, ce: "CacheEntry", exclude: set[str],
+    ) -> Optional[tuple[str, str]]:
+        return self._ready_sender(
+            ce.model_id, model_fingerprint(ce.info), exclude
+        )
+
+    def _stream_shard_from(
+        self, endpoint: str, sender_iid: str, ce: "CacheEntry",
+    ) -> tuple[LoadedModel, str]:
+        """Stream OUR shard from a same-shard holder (its snapshot is
+        exported under the shard fingerprint and carries exactly the
+        shard's leaf range)."""
+        inst = self.instance
+        model_id, info = ce.model_id, ce.info
+        sfp = shard_fingerprint(info, ce.shard_index, ce.shard_count)
+        with inst.tracer.span(
+            "peer-stream", model=model_id, sender=sender_iid,
+        ) as sp:
+            replies = self._fetch_replies(endpoint, sender_iid, model_id, sfp)
+            first = next(replies)
+            rx = {"bytes": 0}
+            t0 = _time.perf_counter()  #: wall-clock: perf_counter transfer-throughput metric
+
+            def chunks():
+                rx["bytes"] += len(first.payload)
+                yield first.to_chunk()
+                for r in replies:
+                    rx["bytes"] += len(r.payload)
+                    yield r.to_chunk()
+
+            loaded = inst.loader.load_shard_from_stream(
+                model_id, info, ce.shard_index, ce.shard_count, chunks(),
+            )
+            sp["chunks"] = first.total_chunks
+            sp["bytes"] = rx["bytes"]
+        self._record_transfer(
+            model_id, MX.LOAD_FROM_PEER_COUNT, rx["bytes"],
+            _time.perf_counter() - t0,  #: wall-clock: perf_counter transfer-throughput metric
+        )
+        return loaded, "peer"
+
+    def _stream_shard_slice_from(
+        self, endpoint: str, sender_iid: str, ce: "CacheEntry",
+    ) -> tuple[LoadedModel, str]:
+        """Fetch only OUR shard's leaf range out of a FULL snapshot.
+
+        The full export never splits a chunk across leaves and emits
+        leaves in canonical order, so the shard's leaves occupy one
+        contiguous chunk block; binary search on the replies' ``layer``
+        field finds its start in O(log chunks) probe fetches."""
+        inst = self.instance
+        model_id, info = ce.model_id, ce.info
+        fp = model_fingerprint(info)
+        fetch = inst.peer_fetch_transport
+
+        def checked(i: int) -> FetchReply:
+            r = fetch(endpoint, model_id, i, fp)
+            if not r.ok:
+                raise TransferUnavailable(
+                    f"{sender_iid} lost the snapshot at chunk {i}"
+                )
+            return r
+
+        with inst.tracer.span(
+            "peer-stream", model=model_id, sender=sender_iid,
+        ) as sp:
+            first = checked(0)
+            total, layers = first.total_chunks, first.total_layers
+            want = shard_chunk_indices(
+                layers, ce.shard_index, ce.shard_count
+            )
+            if layers <= 0 or len(want) == 0:
+                raise TransferUnavailable(
+                    f"{sender_iid} snapshot has no leaf for shard "
+                    f"{ce.shard_index}/{ce.shard_count}"
+                )
+            leaf_lo, leaf_hi = want[0], want[-1]
+
+            def consistent(r: FetchReply) -> FetchReply:
+                if r.fingerprint != first.fingerprint or (
+                    r.total_chunks != total
+                ):
+                    raise TransferUnavailable(
+                        f"{sender_iid} restarted the snapshot mid-stream"
+                    )
+                return r
+
+            # Smallest chunk index whose layer >= leaf_lo.
+            start = 0
+            if first.layer < leaf_lo:
+                lo, hi, start = 1, total - 1, total
+                while lo <= hi:
+                    mid = (lo + hi) // 2
+                    probe = consistent(checked(mid))
+                    if probe.layer >= leaf_lo:
+                        start, hi = mid, mid - 1
+                    else:
+                        lo = mid + 1
+            if start >= total:
+                raise TransferUnavailable(
+                    f"{sender_iid} snapshot ended before leaf {leaf_lo}"
+                )
+            rx = {"bytes": 0, "chunks": 0}
+            t0 = _time.perf_counter()  #: wall-clock: perf_counter transfer-throughput metric
+
+            def chunks():
+                for i in range(start, total):
+                    r = consistent(checked(i))
+                    if r.layer > leaf_hi:
+                        return
+                    rx["bytes"] += len(r.payload)
+                    rx["chunks"] += 1
+                    yield r.to_chunk()
+
+            loaded = inst.loader.load_shard_from_stream(
+                model_id, info, ce.shard_index, ce.shard_count, chunks(),
+            )
+            sp["chunks"] = rx["chunks"]
+            sp["bytes"] = rx["bytes"]
+        self._record_transfer(
+            model_id, MX.LOAD_FROM_PEER_COUNT, rx["bytes"],
+            _time.perf_counter() - t0,  #: wall-clock: perf_counter transfer-throughput metric
+        )
+        return loaded, "peer"
+
     def _partial_callback(self, ce: "CacheEntry"):
         """Arm serve-before-fully-loaded only for families that declared
         layer-streamability — everyone else serves at ACTIVE."""
@@ -325,10 +545,14 @@ class WeightTransferManager:
         if mr is None:
             return None
         live = self._live_ids()
+        # Placement-group members hold ONE SHARD, not a full copy — they
+        # are listed in instance_ids (routable as a group) but can never
+        # serve a full-fingerprint stream.
+        shards = getattr(mr, "shard_instances", {}) or {}
         ranked = sorted(
             (ts, iid) for iid, ts in mr.instance_ids.items()
             if iid != inst.instance_id and iid not in exclude and iid in live
-            and iid not in mr.loading_instances
+            and iid not in mr.loading_instances and iid not in shards
         )
         hosts = sorted(
             (ts, iid)
@@ -515,14 +739,24 @@ class WeightTransferManager:
         if not loader.supports_weight_streaming or not self.host_tier.enabled:
             return None
         ce = inst.cache.get_quietly(model_id)
-        if (
-            ce is None
-            or ce.state is not EntryState.ACTIVE
-            or ce.loaded is None
-        ):
+        if ce is None or ce.loaded is None:
             return None
-        if fingerprint and model_fingerprint(ce.info) != fingerprint:
-            return None
+        # A SHARDED entry exports ONLY its own shard, ONLY under the shard
+        # fingerprint (a full-fingerprint fetch against a shard holder
+        # answers NOT_AVAILABLE — it does not hold the full weights).
+        is_shard = ce.state is EntryState.SHARDED and ce.is_shard
+        if is_shard:
+            exporter = getattr(loader, "export_shard_weights", None)
+            if exporter is None or fingerprint != shard_fingerprint(
+                ce.info, ce.shard_index, ce.shard_count
+            ):
+                return None
+        else:
+            if ce.state is not EntryState.ACTIVE:
+                return None
+            exporter = loader.export_weights
+            if fingerprint and model_fingerprint(ce.info) != fingerprint:
+                return None
         with self._export_lock_for(model_id):
             snap = self.host_tier.peek(model_id)
             if snap is not None and (
@@ -530,7 +764,7 @@ class WeightTransferManager:
             ):
                 return snap
             try:
-                it = loader.export_weights(model_id, ce.loaded.handle)
+                it = exporter(model_id, ce.loaded.handle)
             except Exception as e:  # noqa: BLE001 — runtime export failure
                 log.warning("weight export of %s failed: %s", model_id, e)
                 return None
@@ -541,6 +775,8 @@ class WeightTransferManager:
                 model_id, ce.info, chunks,
                 total_bytes=self._snapshot_bytes(ce, chunks),
             )
+            if is_shard:
+                snap = dataclasses.replace(snap, fingerprint=fingerprint)
             if not self.host_tier.put(model_id, snap, snap.total_bytes):
                 # Too big for the host budget: refuse rather than hold an
                 # unaccounted export alive — receiver uses the store.
@@ -570,6 +806,9 @@ class WeightTransferManager:
             not loader.supports_weight_streaming
             or not self.host_tier.enabled
             or ce.loaded is None
+            or ce.is_shard  # a shard snapshot under the full-model
+            # fingerprint would poison peer fetches; shards re-materialize
+            # via the group, not the host tier
         ):
             return False
         if self.host_tier.peek(model_id) is not None:

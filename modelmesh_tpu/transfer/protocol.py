@@ -51,6 +51,39 @@ def model_fingerprint(info: ModelInfo) -> str:
     return h.hexdigest()[:16]
 
 
+def shard_fingerprint(info: ModelInfo, shard_index: int,
+                      shard_count: int) -> str:
+    """Content identity of ONE WEIGHT SHARD: the model fingerprint salted
+    with the shard coordinates. A sharded holder exports its snapshot
+    under this fingerprint, so a receiver loading shard k can never be
+    served shard j's bytes by a same-model peer — the mismatch answers
+    NOT_AVAILABLE instead of corrupting the graft. Full-copy snapshots
+    keep the plain model fingerprint (receivers slice those by chunk
+    index instead, see ``shard_chunk_indices``)."""
+    h = hashlib.sha1()
+    h.update(model_fingerprint(info).encode())
+    h.update(f"|shard {shard_index}/{shard_count}".encode())
+    return h.hexdigest()[:16]
+
+
+def shard_chunk_indices(
+    total_chunks: int, shard_index: int, shard_count: int
+) -> range:
+    """The contiguous chunk-index block shard ``shard_index`` owns inside
+    a FULL snapshot of ``total_chunks`` chunks: chunks are emitted in
+    canonical leaf order, so an even contiguous split assigns each shard
+    a leaf-prefix-to-leaf-suffix slice — each receiver fetches only its
+    own block (~total/shard_count of the bytes) instead of the whole
+    stream. The first ``total_chunks % shard_count`` shards absorb the
+    remainder, mirroring how the loader splits leaves."""
+    if shard_count <= 0:
+        return range(total_chunks)
+    base, extra = divmod(total_chunks, shard_count)
+    start = shard_index * base + min(shard_index, extra)
+    size = base + (1 if shard_index < extra else 0)
+    return range(start, start + size)
+
+
 @dataclasses.dataclass(frozen=True)
 class TransferSnapshot:
     """Immutable chunked serialization of one loaded model copy (the

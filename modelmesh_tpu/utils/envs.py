@@ -263,6 +263,28 @@ REGISTRY: dict[str, EnvVar] = {
                "how long a recorded load failure excludes an instance "
                "from re-load placement (default 15 min; reference "
                "ModelMesh.java:219-224)", "records.py"),
+        # MM_SHARDED_*: sharded multi-device execution (placement groups).
+        EnvVar("MM_SHARDED", "bool", "1",
+               "sharded execution for oversized models: a model too big "
+               "for any single instance is placed as a multi-instance "
+               "GROUP (one weight shard per member, co-planned by the "
+               "placement strategy) and served through the SHARDED entry "
+               "state; routing targets only COMPLETE groups. Inert for "
+               "loaders without supports_sharded_execution — without it "
+               "an oversized model fails to place exactly as before",
+               "serving/instance.py"),
+        EnvVar("MM_SHARDED_MAX_SHARDS", "int", "8",
+               "ceiling on placement-group width: an oversized model "
+               "shards into the SMALLEST K whose per-shard share fits "
+               "the fleet, up to this many members; a model needing "
+               "more fails to place", "serving/instance.py"),
+        EnvVar("MM_SHARDED_MESH_DEVICES", "int", "0",
+               "local serving-mesh width for sharded execution "
+               "(parallel/mesh.py serving_mesh): weight matrices are "
+               "column-sharded across this many local devices; 0 "
+               "(default) = every visible device. On CPU tier-1 the "
+               "conftest's xla_force_host_platform_device_count "
+               "emulation provides the pool", "parallel/mesh.py"),
         # MM_SOLVER_*: operator overrides of the placement solver's
         # SolveConfig (empty = compiled default). Read ONCE at strategy
         # construction (process start) — not live-reloaded.

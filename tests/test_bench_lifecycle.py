@@ -90,6 +90,26 @@ class TestBenchLifecycleSmoke:
         assert dr["store_fallback"]["failed_requests"] == 0
         assert dr["store_fallback"]["probe_requests"] > 0
 
+        # Sharded placement groups: deterministic counter contracts.
+        # BOTH modes form a K>=2 group with one contended store pull per
+        # shard, drain group-atomically with ZERO failed probes, and
+        # really migrate + really probe (non-vacuity). The re-plan
+        # pre-copy is the mode split: peer streaming hands the shard
+        # over shard-to-shard with no extra store pull; the fallback
+        # pays exactly one more store download and never streams.
+        sh = out["sharded"]
+        for mode in ("peer_stream", "store_fallback"):
+            assert sh[mode]["shard_count"] >= 2
+            assert sh[mode]["formation_store_loads"] == sh[mode]["shard_count"]
+            assert sh[mode]["time_to_servable_ms"] > 0
+            assert sh[mode]["failed_requests"] == 0
+            assert sh[mode]["migrated"] >= 1
+            assert sh[mode]["probe_requests"] > 0
+        assert sh["peer_stream"]["replan_stream_loads"] >= 1
+        assert sh["peer_stream"]["replan_store_loads"] == 0
+        assert sh["store_fallback"]["replan_stream_loads"] == 0
+        assert sh["store_fallback"]["replan_store_loads"] >= 1
+
         # Autoscale: structural contract only here (the retried floor
         # test below carries the behavioral assertions).
         asr = out["autoscale"]
@@ -116,6 +136,24 @@ class TestBenchLifecycleSmoke:
                 return
         raise AssertionError(
             f"n_copies fan-out ordering (fast, serial) not met "
+            f"after 3 attempts: {last}"
+        )
+
+    def test_sharded_drain_handoff_ordering(self):
+        """Retried ordering gate (the PR-11/13 convention): the drain
+        re-plan's shard pre-copy over the peer stream (~1ms of copy)
+        must beat the store-fallback twin (a 20ms contended store
+        download), but a single descheduled thread under full-suite
+        load can invert one sample."""
+        last = None
+        for attempt in range(3):
+            peer = bench_lifecycle._measure_sharded(True, 3, 20.0, reps=1)
+            store = bench_lifecycle._measure_sharded(False, 3, 20.0, reps=1)
+            last = (peer["drain_ms"], store["drain_ms"])
+            if peer["drain_ms"] < store["drain_ms"]:
+                return
+        raise AssertionError(
+            f"sharded drain handoff ordering (peer, store) not met "
             f"after 3 attempts: {last}"
         )
 

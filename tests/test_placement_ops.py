@@ -128,7 +128,12 @@ class TestAuction:
                "unchanged, because 64x8 sits below the auto-sparse gate "
                "(m_pad >= 192) and still routes through the dense tier, "
                "so the sparse default never touches this shape's cost "
-               "surface. The fix remains a deliberate cost-surface "
+               "surface. RE-MEASURED at PR 20 after sharded placement "
+               "groups landed: still exactly 46/64 (0.72) — group "
+               "planning lives in strategy-level choose_group_targets "
+               "and never enters assemble_cost, so the solver's cost "
+               "matrix (and PR-11's bitwise parity gates) is bit-"
+               "identical. The fix remains a deliberate cost-surface "
                "change (risks invalidating PR-11's bitwise parity "
                "gates), deferred to its own PR. strict=False: a solver "
                "change that happens to fix it should not turn tier-1 "

@@ -1,0 +1,321 @@
+"""Sharded multi-device execution: serving-mesh provider, pjit parity,
+per-shard weight streaming, and placement-group atomicity (records +
+entry + scripted sim scenario).
+
+The tier-1 parity gate (ISSUE-20 acceptance): on a 1-device mesh the
+sharded execution path is BITWISE identical to the plain path — the
+mesh/NamedSharding plumbing must be a no-op when it degenerates to a
+single device. conftest forces 8 virtual CPU devices, so the
+multi-device cases run real distributed executables in-process.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from modelmesh_tpu.parallel.mesh import (
+    MODEL_AXIS,
+    param_pspec,
+    serving_mesh,
+    shard_params,
+)
+from modelmesh_tpu.records import ModelRecord
+from modelmesh_tpu.runtime.spi import ModelInfo
+from modelmesh_tpu.serving.entry import CacheEntry, EntryState
+from modelmesh_tpu.transfer.protocol import (
+    model_fingerprint,
+    shard_chunk_indices,
+    shard_fingerprint,
+)
+
+SPEC = "transformer://layers=2,d_model=64,heads=4,seed=3"
+INFO = ModelInfo(model_type="jax", model_path=SPEC)
+
+
+def _fresh_loader():
+    from modelmesh_tpu.models.server import InProcessJaxLoader
+
+    return InProcessJaxLoader(capacity_bytes=64 << 20)
+
+
+def _input_bytes(model, seed=7):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((1, *model.input_shape)).astype(model.input_dtype)
+    return x.tobytes()
+
+
+# --------------------------------------------------------------------- #
+# transfer protocol helpers                                             #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("total,count", [(10, 2), (10, 3), (7, 7), (5, 8),
+                                         (64, 4), (1, 2)])
+def test_shard_chunk_indices_partition(total, count):
+    """The shard blocks tile [0, total) exactly: disjoint, contiguous,
+    ordered, sizes differing by at most one with the remainder absorbed
+    by the FIRST shards."""
+    blocks = [list(shard_chunk_indices(total, k, count)) for k in range(count)]
+    flat = [i for b in blocks for i in b]
+    assert flat == list(range(total))
+    sizes = [len(b) for b in blocks]
+    assert max(sizes) - min(sizes) <= 1
+    assert sizes == sorted(sizes, reverse=True)
+
+
+def test_shard_fingerprint_distinct_per_coordinate():
+    full = model_fingerprint(INFO)
+    fps = {shard_fingerprint(INFO, k, 4) for k in range(4)}
+    assert len(fps) == 4, "shard fingerprints collide across indices"
+    assert full not in fps, "a shard fingerprint equals the full one"
+    assert shard_fingerprint(INFO, 0, 4) != shard_fingerprint(INFO, 0, 2), (
+        "same index under different counts must not collide"
+    )
+
+
+# --------------------------------------------------------------------- #
+# mesh provider + partition specs                                       #
+# --------------------------------------------------------------------- #
+
+def test_serving_mesh_sizes_and_cache():
+    m1 = serving_mesh(1)
+    assert m1.devices.size == 1
+    assert m1.axis_names == (MODEL_AXIS,)
+    assert serving_mesh(1) is m1, "mesh must be cached per size (pjit keys)"
+    m4 = serving_mesh(4)
+    assert m4.devices.size == 4  # conftest forces 8 virtual devices
+
+
+def test_param_pspec_shards_only_divisible_matrix_axes():
+    w = np.zeros((8, 64), np.float32)
+    assert param_pspec(w, 4) == jax.sharding.PartitionSpec(None, MODEL_AXIS)
+    # Non-dividing last axis, vectors, and 1-device meshes replicate.
+    assert param_pspec(np.zeros((8, 63), np.float32), 4) == (
+        jax.sharding.PartitionSpec()
+    )
+    assert param_pspec(np.zeros((64,), np.float32), 4) == (
+        jax.sharding.PartitionSpec()
+    )
+    assert param_pspec(w, 1) == jax.sharding.PartitionSpec()
+
+
+def test_shard_params_places_leaves_on_mesh():
+    mesh = serving_mesh(4)
+    params = {"w": np.ones((4, 64), np.float32),
+              "b": np.ones((64,), np.float32)}
+    out = shard_params(params, mesh)
+    w_shards = out["w"].sharding
+    assert w_shards.mesh.devices.size == 4
+    assert out["w"].sharding.spec == jax.sharding.PartitionSpec(
+        None, MODEL_AXIS
+    )
+    assert np.asarray(out["w"]).sum() == 4 * 64  # values untouched
+
+
+# --------------------------------------------------------------------- #
+# pjit execution: the 1-device bitwise parity gate + multi-device run   #
+# --------------------------------------------------------------------- #
+
+def test_sharded_execution_bitwise_parity_on_one_device_mesh():
+    """ISSUE-20 acceptance gate: sharded execution pinned bitwise
+    against single-device on a 1-device mesh."""
+    plain = _fresh_loader()
+    sharded = _fresh_loader()
+    plain.store.load("m-plain", INFO.model_type, INFO.model_path)
+    sharded.store.load_sharded(
+        "m-shard", INFO.model_type, INFO.model_path, mesh=serving_mesh(1)
+    )
+    x = _input_bytes(plain.store.get("m-plain"))
+    assert plain.store.get("m-plain").predict_bytes(x) == (
+        sharded.store.get("m-shard").predict_bytes(x)
+    ), "1-device sharded execution diverged bitwise from the plain path"
+
+
+def test_sharded_execution_multi_device_allclose():
+    plain = _fresh_loader()
+    sharded = _fresh_loader()
+    plain.store.load("m-plain", INFO.model_type, INFO.model_path)
+    sharded.store.load_sharded(
+        "m-shard", INFO.model_type, INFO.model_path, mesh=serving_mesh(4)
+    )
+    model = sharded.store.get("m-shard")
+    assert model.fuse_key == "", "sharded copies must never fuse-stack"
+    x = _input_bytes(plain.store.get("m-plain"))
+    a = np.frombuffer(plain.store.get("m-plain").predict_bytes(x),
+                      np.float32)
+    b = np.frombuffer(model.predict_bytes(x), np.float32)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_load_sharded_rejects_non_streamable_family():
+    loader = _fresh_loader()
+    with pytest.raises(ValueError, match="not sharded-executable"):
+        loader.store.load_sharded("m-lin", "linear", "linear://in=8,out=2")
+
+
+def test_load_shard_reports_share_of_bytes():
+    loader = _fresh_loader()
+    lm = loader.load_shard("m", INFO, shard_index=1, shard_count=3)
+    total = loader.store.get("m").size_bytes
+    assert lm.size_bytes == -(-total // 3)
+    assert lm.handle.shard_index == 1 and lm.handle.shard_count == 3
+
+
+# --------------------------------------------------------------------- #
+# per-shard weight streaming round-trip                                 #
+# --------------------------------------------------------------------- #
+
+def test_export_shard_weights_yields_only_owned_leaf_range():
+    loader = _fresh_loader()
+    lm = loader.load_shard("m", INFO, shard_index=0, shard_count=2)
+    n_leaves = len(jax.tree.leaves(lm.handle.params))
+    want = set(shard_chunk_indices(n_leaves, 0, 2))
+    layers = {c.layer for c in loader.export_shard_weights("m", lm.handle)}
+    assert layers == want, (
+        f"shard 0 exported leaves {sorted(layers)}, owns {sorted(want)}"
+    )
+
+
+def test_shard_stream_round_trip_matches_store_load():
+    """A shard grafted from a peer stream serves identically to one
+    loaded from the store (same skeleton + same bytes)."""
+    sender = _fresh_loader()
+    receiver = _fresh_loader()
+    lm = sender.load_shard("m", INFO, shard_index=1, shard_count=2)
+    chunks = list(sender.export_shard_weights("m", lm.handle))
+    got = receiver.load_shard_from_stream("m", INFO, 1, 2, iter(chunks))
+    assert got.size_bytes == lm.size_bytes
+    x = _input_bytes(lm.handle)
+    a = np.frombuffer(sender.store.get("m").predict_bytes(x), np.float32)
+    b = np.frombuffer(receiver.store.get("m").predict_bytes(x), np.float32)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_shard_stream_rejects_wrong_leaf_range():
+    from modelmesh_tpu.runtime.spi import ModelLoadException
+
+    sender = _fresh_loader()
+    receiver = _fresh_loader()
+    lm = sender.load_shard("m", INFO, shard_index=0, shard_count=2)
+    chunks = list(sender.export_shard_weights("m", lm.handle))
+    with pytest.raises(ModelLoadException, match="shard 1/2"):
+        # Shard 0's leaves offered against a shard-1 graft: reject, never
+        # corrupt.
+        receiver.load_shard_from_stream("m", INFO, 1, 2, iter(chunks))
+
+
+# --------------------------------------------------------------------- #
+# ModelRecord group atomicity                                           #
+# --------------------------------------------------------------------- #
+
+def _group_record(k=3):
+    mr = ModelRecord(model_type="jax", model_path=SPEC)
+    mr.begin_shard_group({f"i{j}": j for j in range(k)}, k, ts=100)
+    return mr
+
+
+def test_partial_group_never_complete():
+    mr = _group_record(3)
+    assert not mr.group_complete
+    mr.promote_loaded("i0", 200)
+    mr.promote_loaded("i1", 200)
+    assert not mr.group_complete, "2/3 shards must not be routable"
+    mr.promote_loaded("i2", 200)
+    assert mr.group_complete
+    assert mr.missing_shards() == []
+
+
+def test_member_eviction_tears_down_whole_group():
+    mr = _group_record(3)
+    for j in range(3):
+        mr.promote_loaded(f"i{j}", 200)
+    epoch = mr.group_epoch
+    mr.remove_instance("i1")
+    assert mr.shard_count == 0 and not mr.shard_instances, (
+        "losing an unreplaced shard must clear the ENTIRE group"
+    )
+    assert not mr.instance_ids, "surviving members must lose their claims"
+    assert mr.group_epoch > epoch
+    assert mr.group_complete  # vacuously: group absent, not half-present
+
+
+def test_drain_twin_keeps_group_alive():
+    mr = _group_record(2)
+    mr.promote_loaded("i0", 200)
+    mr.promote_loaded("i1", 200)
+    # Drain pre-copy: a survivor becomes a SECOND holder of shard 0.
+    mr.shard_instances["i2"] = 0
+    mr.promote_loaded("i2", 300)
+    mr.remove_instance("i0")
+    assert mr.shard_count == 2, "twin-covered departure must not nuke group"
+    assert mr.group_complete
+    assert mr.shard_index_of("i2") == 0 and mr.shard_index_of("i0") is None
+
+
+def test_replan_bumps_epoch_and_drops_unassigned_members():
+    mr = _group_record(2)
+    mr.promote_loaded("i0", 200)
+    mr.promote_loaded("i1", 200)
+    epoch = mr.group_epoch
+    mr.begin_shard_group({"i0": 0, "i9": 1}, 2, ts=400)
+    assert mr.group_epoch == epoch + 1
+    assert mr.shard_index_of("i1") is None
+    assert "i1" not in mr.instance_ids
+    # The kept member's servable completion survives the re-plan.
+    assert mr.instance_ids.get("i0") == 200
+    assert "i9" in mr.loading_instances
+
+
+# --------------------------------------------------------------------- #
+# CacheEntry shard lifecycle                                            #
+# --------------------------------------------------------------------- #
+
+def test_complete_shard_entry_is_servable_and_invokable():
+    """Regression: the SHARDED entry must carry the full invocation
+    machinery (inflight gate, latency EWMA) exactly like ACTIVE — a
+    constructor refactor once orphaned those fields and every probe of a
+    completed group died with AttributeError."""
+    from modelmesh_tpu.runtime.spi import LoadedModel
+
+    ce = CacheEntry("m", INFO, weight_units=4)
+    ce.shard_index, ce.shard_count, ce.group_epoch = 1, 2, 5
+    assert ce.is_shard
+    assert ce.inflight == 0 and ce.total_invocations == 0
+    assert ce.complete_shard(LoadedModel(handle=object(), size_bytes=8,
+                                         max_concurrency=2))
+    assert ce.state is EntryState.SHARDED
+    assert ce.state.is_servable
+    assert ce.wait_active(0.1)
+    assert ce.before_invoke(timeout_s=0.2)
+    assert ce.inflight == 1
+    ce.after_invoke()
+    assert ce.inflight == 0
+
+
+def test_complete_shard_loses_to_eviction():
+    from modelmesh_tpu.runtime.spi import LoadedModel
+
+    ce = CacheEntry("m", INFO)
+    ce.shard_index, ce.shard_count = 0, 2
+    ce.remove()
+    assert not ce.complete_shard(LoadedModel(handle=object(), size_bytes=8))
+    assert ce.state is EntryState.REMOVED
+
+
+# --------------------------------------------------------------------- #
+# scripted sim scenario: replay pin                                     #
+# --------------------------------------------------------------------- #
+
+def test_sharded_group_drain_replays_bit_for_bit():
+    """The ISSUE-20 gate scenario (12x-oversized model served by a
+    placement group, group-atomically drained with zero failed probes)
+    replays deterministically from its seed."""
+    from modelmesh_tpu.sim import scenarios
+    from modelmesh_tpu.sim.scenario import run_scenario
+
+    first = run_scenario(scenarios.sharded_group_drain_zero_gap(),
+                         step_ms=1_000)
+    second = run_scenario(scenarios.sharded_group_drain_zero_gap(),
+                          step_ms=1_000)
+    assert first.ok, first.render()
+    assert first.trace_lines() == second.trace_lines()

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Local CI: the two gates a change must clear before commit, as one step.
+#
+#   1. static analysis on files changed vs HEAD (tools/analysis) —
+#      zero unsuppressed findings, including the lock-order drift check
+#      (regenerate with `python -m tools.analysis --write-lock-order`
+#      when a deliberate lock addition trips it);
+#   2. the tier-1 test suite (the exact ROADMAP.md command).
+#
+# Usage: tools/check.sh [--full-analysis]
+#   --full-analysis  analyze the whole tree instead of only changed files
+set -u
+
+cd "$(dirname "$0")/.."
+
+scope="--changed"
+if [ "${1:-}" = "--full-analysis" ]; then
+    scope=""
+fi
+
+echo "== static analysis (${scope:-full tree}) =="
+findings=$(python -m tools.analysis $scope --format json) || {
+    echo "$findings"
+    echo "FAIL: static analysis reported unsuppressed findings" >&2
+    exit 1
+}
+echo "OK: no unsuppressed findings"
+
+echo "== tier-1 tests =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
